@@ -1,0 +1,72 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: /root/reference, see SURVEY.md).
+
+The user-facing API mirrors ``paddle.fluid``: build a Program with
+``layers.*``, differentiate with ``optimizer.minimize`` (graph-level
+autodiff), run with ``Executor`` / ``ParallelExecutor``.  Underneath,
+whole program blocks lower to single XLA computations (core/lowering.py);
+data parallelism is a sharded jit over a ``jax.sharding.Mesh`` rather than
+NCCL op-handles.
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data("x", [784])
+    y = fluid.layers.data("y", [1], dtype="int64")
+    pred = fluid.layers.fc(x, 10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+    fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={...}, fetch_list=[loss])
+"""
+from __future__ import annotations
+
+# register all op lowerings first
+from . import ops  # noqa: F401
+
+from . import clip  # noqa: F401
+from . import initializer  # noqa: F401
+from . import io  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from .core.backward import append_backward  # noqa: F401
+from .core.executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .core.program import (  # noqa: F401
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from .core import unique_name  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+
+class CPUPlace:
+    """Host-device tag (platform/place.h:36 analogue)."""
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    """TPU device tag (the CUDAPlace analogue; platform/place.h:51)."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# reference-compat alias: programs written for fluid's CUDAPlace run on TPU
+CUDAPlace = TPUPlace
+
+
+def tpu_places():
+    import jax
+    return [TPUPlace(i) for i in range(len(jax.devices()))]
+
+
+__version__ = "0.1.0"

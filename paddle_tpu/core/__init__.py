@@ -1,0 +1,10 @@
+from . import types, unique_name  # noqa: F401
+from .program import (  # noqa: F401
+    Block,
+    Operator,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)

@@ -1,0 +1,173 @@
+"""Scope + Executor: the single-device runtime.
+
+Reference: ``paddle/fluid/framework/scope.h:41`` (hierarchical name→Variable
+map) and ``executor.cc`` / ``python/paddle/fluid/executor.py:256-474``.
+
+TPU-native redesign: ``Executor.run`` does NOT interpret ops.  It analyzes
+the requested (program, feed-signature, fetch-list) once, lowers the whole
+block to a pure JAX function (core/lowering.py), ``jax.jit``s it with the
+updated persistable state *donated* (so parameters update in-place in HBM),
+and caches the compiled executable — the analogue of the reference's
+program cache (``executor.py:207`` ``_get_program_cache_key``) plus kernel
+dispatch, replaced by one XLA compile.  Feed batches with new shapes
+trigger a recompile (cached per shape bucket), which is the
+static-shape/recompile-cache policy SURVEY.md §7 calls out.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .lowering import analyze_block, build_block_fn
+from .program import Program, Variable, default_main_program
+from .types import np_dtype
+
+RNG_STATE_VAR = "@RNG_STATE@"
+
+
+class Scope:
+    """Name → device-value map with parent fallback (scope.h:41)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.vars: Dict[str, object] = {}
+        self.parent = parent
+        self.kids: List[Scope] = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self) -> None:
+        self.kids.clear()
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def set_var(self, name: str, value) -> None:
+        self.vars[name] = value
+
+    def erase(self, name: str) -> None:
+        self.vars.pop(name, None)
+
+    def local_names(self) -> List[str]:
+        return list(self.vars)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def scope_guard(scope: Scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        saved = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = saved
+
+    return guard()
+
+
+def _as_device_array(value, var: Optional[Variable]):
+    if isinstance(value, (jax.Array,)):
+        return value
+    arr = np.asarray(value)
+    if var is not None and var.dtype is not None:
+        arr = arr.astype(np_dtype(var.dtype), copy=False)
+    return jnp.asarray(arr)
+
+
+class Executor:
+    """Single-device program runner (executor.py:256 equivalent).
+
+    ``place`` is advisory — JAX owns device placement; pass
+    ``paddle_tpu.TPUPlace()`` / ``CPUPlace()`` for API parity.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict = {}
+
+    # -- public API --------------------------------------------------------
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, object]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
+        program = program if program is not None else default_main_program()
+        feed = feed or {}
+        fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])]
+        scope = scope or global_scope()
+
+        feed_names = sorted(feed)
+        block = program.global_block
+        feed_vals = []
+        for n in feed_names:
+            var = block.var_or_none(n)
+            feed_vals.append(_as_device_array(feed[n], var))
+
+        sig = tuple((n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_vals))
+        key = (id(program), program._version, sig, tuple(fetch_names))
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            plan = analyze_block(program, 0, feed_names, fetch_names)
+            fn = build_block_fn(program, plan)
+            jitted = jax.jit(fn, donate_argnums=(1,))
+            entry = (plan, jitted)
+            if use_program_cache:
+                self._cache[key] = entry
+        plan, jitted = entry
+
+        donated_state = [self._state_val(scope, block, n) for n in plan.donated_reads]
+        const_state = [self._state_val(scope, block, n) for n in plan.const_reads]
+        rng = scope.find_var(RNG_STATE_VAR)
+        if rng is None:
+            rng = jax.random.PRNGKey(program.random_seed or 0)
+
+        fetches, new_state, rng_out = jitted(feed_vals, donated_state, const_state, rng)
+
+        for name, val in zip(plan.persist_writes, new_state):
+            scope.set_var(name, val)
+        if plan.has_stateful:
+            scope.set_var(RNG_STATE_VAR, rng_out)
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    # -- helpers -----------------------------------------------------------
+    def _state_val(self, scope: Scope, block, name: str):
+        val = scope.find_var(name)
+        if val is None:
+            raise RuntimeError(
+                f"variable {name!r} is not initialized in the scope — run the "
+                f"startup program first (fluid.default_startup_program())"
+            )
+        return _as_device_array(val, block.var_or_none(name))
+
+    def close(self) -> None:
+        self._cache.clear()

@@ -1,0 +1,206 @@
+"""Op registry: op type → XLA lowering rule (+ optional custom grad rule).
+
+TPU-native replacement for the reference's OperatorWithKernel registry
+(``paddle/fluid/framework/op_registry.h:43,124`` and the OpKernelType
+dispatch in ``operator.cc:686-723``).  There is no runtime kernel dispatch:
+each op type registers a *lowering rule* — a pure function from JAX values
+to JAX values — and whole blocks are traced through these rules into one
+XLA computation (see ``core/lowering.py``).  Hot ops may register a Pallas
+implementation; the rule decides internally (the reference's
+library_type={Plain,cuDNN,MKLDNN} analogue).
+
+Gradients: the default grad rule applies ``jax.vjp`` to the forward rule —
+XLA CSE merges the re-traced forward with the original, so this costs no
+extra FLOPs inside a jitted block.  Ops whose lowering consumes randomness
+or host state must register an explicit ``grad`` rule (reference analogue:
+GradOpDescMaker, ``grad_op_desc_maker.h``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+GRAD_OP_SUFFIX = "_grad"
+
+# in/out values passed to lowering rules: dict slot -> list[jax.Array]
+SlotVals = Dict[str, List[Any]]
+
+
+class LowerContext:
+    """Per-block lowering context handed to every rule.
+
+    Provides split PRNG keys (rng is threaded through the block as hidden
+    state — the functional translation of the reference's per-op ``seed``
+    attrs), access to the block being lowered (for sub-block control flow),
+    and mesh info for parallel lowering.
+    """
+
+    def __init__(self, block=None, mesh=None, lower_block_fn=None, training=True):
+        self.block = block
+        self.mesh = mesh
+        self.training = training
+        self._rng_key = None
+        self._rng_used = False
+        self._lower_block_fn = lower_block_fn  # (block_idx, env) -> env
+
+    def set_rng(self, key):
+        self._rng_key = key
+        self._rng_used = False
+
+    def prng(self):
+        """Split off a fresh PRNG key (marks rng as consumed)."""
+        if self._rng_key is None:
+            raise RuntimeError("op requires randomness but no rng state was provided")
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        self._rng_used = True
+        return sub
+
+    @property
+    def rng_key(self):
+        return self._rng_key
+
+    def lower_sub_block(self, block_idx: int, env: dict) -> dict:
+        if self._lower_block_fn is None:
+            raise RuntimeError("sub-block lowering not available in this context")
+        return self._lower_block_fn(block_idx, env)
+
+
+class OpDef:
+    def __init__(
+        self,
+        type: str,
+        lower: Callable[[LowerContext, SlotVals, dict], SlotVals],
+        grad: Optional[Callable] = None,
+        stateful: bool = False,
+        input_slots: Optional[Sequence[str]] = None,
+        output_slots: Optional[Sequence[str]] = None,
+        no_grad_slots: Sequence[str] = (),
+        infer_shape: Optional[Callable] = None,
+    ):
+        self.type = type
+        self.lower = lower
+        self.grad = grad            # custom grad lowering, else vjp default
+        self.stateful = stateful    # consumes rng / host state → needs custom grad
+        self.input_slots = list(input_slots) if input_slots else None
+        self.output_slots = list(output_slots) if output_slots else None
+        self.no_grad_slots = set(no_grad_slots)  # input slots never differentiated
+        self.infer_shape = infer_shape
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(
+    type: str,
+    *,
+    grad=None,
+    stateful: bool = False,
+    input_slots=None,
+    output_slots=None,
+    no_grad_slots=(),
+    infer_shape=None,
+):
+    """Decorator: register a lowering rule for ``type``."""
+
+    def deco(fn):
+        _REGISTRY[type] = OpDef(
+            type,
+            fn,
+            grad=grad,
+            stateful=stateful,
+            input_slots=input_slots,
+            output_slots=output_slots,
+            no_grad_slots=no_grad_slots,
+            infer_shape=infer_shape,
+        )
+        return fn
+
+    return deco
+
+
+def register_grad(type: str):
+    """Decorator: attach a custom grad rule to an already-registered op.
+
+    Signature: ``grad(ctx, ins, attrs) -> {in_slot + '@GRAD': [vals]}`` where
+    ``ins`` contains the forward ins, forward outs, and ``slot@GRAD`` entries.
+    """
+
+    def deco(fn):
+        _REGISTRY[type].grad = fn
+        return fn
+
+    return deco
+
+
+def get(type: str) -> OpDef:
+    if type not in _REGISTRY:
+        raise KeyError(f"no lowering registered for op type {type!r}")
+    return _REGISTRY[type]
+
+
+def has(type: str) -> bool:
+    return type in _REGISTRY
+
+
+def all_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Default (vjp-based) grad lowering
+# ---------------------------------------------------------------------------
+
+def vjp_grad(opdef: OpDef, ctx: LowerContext, ins: SlotVals, attrs: dict) -> SlotVals:
+    """Differentiate the forward lowering rule with jax.vjp.
+
+    ``ins`` holds the forward input slots, forward output slots, and
+    ``slot@GRAD`` cotangents for outputs that received gradients.  Returns
+    ``slot@GRAD`` for each differentiable forward input slot.  Integer and
+    ``no_grad_slots`` inputs are held constant.  The forward is re-traced
+    inside vjp; within one jitted block XLA CSE merges it with the original
+    forward, so there is no duplicated compute at run time.
+    """
+    fwd_out_slots = set(attrs.get("__fwd_out_slots__", ()))
+    if opdef.output_slots:
+        fwd_out_slots |= set(opdef.output_slots)
+    in_slots = [
+        s for s in ins
+        if not s.endswith("@GRAD")
+        and (opdef.input_slots is None or s in opdef.input_slots)
+        and s not in fwd_out_slots
+    ]
+    diff_slots = [
+        s for s in in_slots
+        if s not in opdef.no_grad_slots
+        and all(jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact) for v in ins[s])
+    ]
+    const_vals = {s: ins[s] for s in in_slots if s not in diff_slots}
+    if not diff_slots:
+        return {}
+
+    def fwd(d: dict):
+        full = {k: list(v) for k, v in d.items()}
+        full.update(const_vals)
+        fwd_attrs = {k: v for k, v in attrs.items() if not k.startswith("__")}
+        return opdef.lower(ctx, full, fwd_attrs)
+
+    primals_out, vjp_fn = jax.vjp(fwd, {s: ins[s] for s in diff_slots})
+
+    def make_cot(path_slot, j, primal):
+        g_list = ins.get(path_slot + "@GRAD")
+        if g_list is not None and j < len(g_list) and g_list[j] is not None:
+            return g_list[j]
+        if jnp.issubdtype(jnp.asarray(primal).dtype, jnp.inexact):
+            return jnp.zeros_like(primal)
+        import numpy as _np
+        return _np.zeros(jnp.shape(primal), dtype=jax.dtypes.float0)
+
+    cot = {
+        s: [make_cot(s, j, p) for j, p in enumerate(vals)]
+        for s, vals in primals_out.items()
+    }
+    (grads,) = vjp_fn(cot)
+    return {s + "@GRAD": list(v) for s, v in grads.items()}

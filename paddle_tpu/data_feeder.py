@@ -1,0 +1,66 @@
+"""DataFeeder: python minibatches → feed dict of dense arrays.
+
+Reference: ``python/paddle/fluid/data_feeder.py:83`` — converts lists of
+per-example tuples into per-place LoDTensor batches.  Here a variable-length
+(``lod_level=1``) feed becomes a padded ``[B, T, ...]`` array plus the
+``<name>@LEN`` int32 lengths vector (the padded-sequence contract; see
+layers/nn.py).  Padding T to a bucket boundary keeps XLA recompiles bounded.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.program import Variable, default_main_program
+from .core.types import np_dtype
+
+# pad sequence length up to the next multiple (recompile-bucketing policy)
+SEQ_LEN_BUCKET = 16
+
+
+def _bucket(n: int, bucket: int = SEQ_LEN_BUCKET) -> int:
+    return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.program = program or default_main_program()
+        self.feed_vars: List[Variable] = [
+            v if isinstance(v, Variable) else self.program.global_block.var(v)
+            for v in feed_list
+        ]
+        self.place = place
+
+    def feed(self, iterable) -> dict:
+        """iterable: list of per-example tuples aligned with feed_list."""
+        rows = list(iterable)
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            col = [row[i] for row in rows]
+            if var.lod_level >= 1:
+                data, lens = self._pad(col, var)
+                out[var.name] = data
+                out[var.name + "@LEN"] = lens
+            else:
+                arr = np.asarray(col)
+                arr = arr.astype(np_dtype(var.dtype), copy=False)
+                want = var.shape
+                if want is not None and len(want) == arr.ndim + 1 and want[-1] == 1:
+                    arr = arr[..., None]  # reference-style trailing label dim
+                out[var.name] = arr
+        return out
+
+    def _pad(self, col, var: Variable):
+        seqs = [np.asarray(s) for s in col]
+        lens = np.asarray([len(s) for s in seqs], dtype=np.int32)
+        T = _bucket(int(lens.max()) if len(lens) else 1)
+        feat = seqs[0].shape[1:] if seqs[0].ndim > 1 else ()
+        want_feat = tuple(var.shape[2:]) if var.shape is not None else feat
+        if not feat and want_feat == (1,):
+            feat = (1,)
+            seqs = [s[:, None] for s in seqs]
+        data = np.zeros((len(seqs), T) + feat, dtype=np_dtype(var.dtype))
+        for j, s in enumerate(seqs):
+            data[j, : len(s)] = s
+        return data, lens

@@ -1,0 +1,137 @@
+"""Initializers — emitted as ops into the startup program.
+
+Reference: ``python/paddle/fluid/initializer.py`` (Constant/Uniform/Normal/
+TruncatedNormal/Xavier/MSRA/NumpyArray, each appending a startup-program op).
+The startup program is itself lowered and jitted; random initializer ops
+draw from the threaded PRNG state, so initialization is reproducible from
+``program.random_seed``.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+    def _fan_in_out(self, var):
+        shape = var.shape
+        if len(shape) < 2:
+            return int(shape[0]) if shape else 1, int(shape[0]) if shape else 1
+        receptive = 1
+        for s in shape[2:]:
+            receptive *= int(s)
+        fan_in = int(shape[0]) * receptive if len(shape) > 2 else int(shape[0])
+        fan_out = int(shape[1]) * receptive if len(shape) > 2 else int(shape[1])
+        # conv filters are OIHW: O=out, I=in
+        if len(shape) > 2:
+            fan_in = int(shape[1]) * receptive
+            fan_out = int(shape[0]) * receptive
+        return fan_in, fan_out
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, var, block):
+        block.append_op(
+            "fill_constant", {}, {"Out": [var.name]},
+            {"shape": list(var.shape), "dtype": var.dtype, "value": self.value},
+        )
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        block.append_op(
+            "uniform_random", {}, {"Out": [var.name]},
+            {"shape": list(var.shape), "dtype": var.dtype,
+             "min": self.low, "max": self.high, "seed": self.seed},
+        )
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        block.append_op(
+            "gaussian_random", {}, {"Out": [var.name]},
+            {"shape": list(var.shape), "dtype": var.dtype,
+             "mean": self.loc, "std": self.scale, "seed": self.seed},
+        )
+
+
+class TruncatedNormalInitializer(NormalInitializer):
+    def __call__(self, var, block):
+        block.append_op(
+            "truncated_gaussian_random", {}, {"Out": [var.name]},
+            {"shape": list(var.shape), "dtype": var.dtype,
+             "mean": self.loc, "std": self.scale, "seed": self.seed},
+        )
+
+
+class XavierInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block):
+        fi, fo = self._fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = self._fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / fi)
+            NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value: np.ndarray):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        block.append_op(
+            "assign_value", {}, {"Out": [var.name]},
+            {"shape": list(self.value.shape), "dtype": var.dtype,
+             "values": self.value.reshape(-1).tolist()},
+        )
+
+
+# reference-compatible aliases (initializer.py tail)
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+
+
+def _global_weight_initializer():
+    return XavierInitializer()
+
+
+def _global_bias_initializer():
+    return ConstantInitializer(0.0)

@@ -1,0 +1,127 @@
+"""Checkpoint save/load + inference-model export.
+
+Reference: ``python/paddle/fluid/io.py`` (save_vars:89, save_persistables:252,
+load_persistables:464, save_inference_model:544, load_inference_model:669)
+driven by save/load ops (``operators/save_op.cc``).
+
+TPU-native storage: persistable vars are device arrays in the Scope; they are
+staged to host and written as one ``.npz`` per save_combine (or one ``.npy``
+per var for save_vars), with the pruned program serialized as ``__model__``
+JSON — same layout contract as the reference's ``__model__`` + param files.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import Scope, global_scope
+from .core.program import Program, Variable, default_main_program
+
+MODEL_FILENAME = "__model__"
+PARAMS_FILENAME = "__params__.npz"
+
+
+def _persistable_vars(program: Program) -> List[Variable]:
+    return [v for v in program.global_block.vars.values()
+            if v.persistable and v.name != "@RNG_STATE@"]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.global_block.vars.values()
+                if predicate is None or predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    if filename is None:
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is None:
+                continue
+            np.save(os.path.join(dirname, v.name.replace("/", "__")), np.asarray(val))
+    else:
+        arrays = {}
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is not None:
+                arrays[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **arrays)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    save_vars(executor, dirname, program, vars=_persistable_vars(program),
+              filename=filename or PARAMS_FILENAME)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    params = [v for v in program.global_block.vars.values() if v.is_parameter]
+    save_vars(executor, dirname, program, vars=params,
+              filename=filename or PARAMS_FILENAME)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.global_block.vars.values()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    if filename is None:
+        for v in vars:
+            path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+            if os.path.exists(path):
+                scope.set_var(v.name, np.load(path))
+    else:
+        data = np.load(os.path.join(dirname, filename))
+        for v in vars:
+            if v.name in data:
+                scope.set_var(v.name, data[v.name])
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    load_vars(executor, dirname, program, vars=_persistable_vars(program),
+              filename=filename or PARAMS_FILENAME)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_persistables(executor, dirname, main_program, filename)
+
+
+def save_inference_model(dirname, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable], executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """Prune to the inference subgraph and save program + params
+    (reference io.py:544)."""
+    program = (main_program or default_main_program()).clone()
+    pruned = program.prune([v.name for v in target_vars])
+    os.makedirs(dirname, exist_ok=True)
+    meta = pruned.to_dict()
+    meta["feed_var_names"] = list(feeded_var_names)
+    meta["fetch_var_names"] = [v.name for v in target_vars]
+    import json
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned,
+                      filename=params_filename or PARAMS_FILENAME)
+    return [v.name for v in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    import json
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
+        meta = json.load(f)
+    program = Program.from_dict({"version": meta.get("version", 1),
+                                 "blocks": meta["blocks"]})
+    load_persistables(executor, dirname, program,
+                      filename=params_filename or PARAMS_FILENAME)
+    feed_names = meta.get("feed_var_names", [])
+    fetch_vars = [program.global_block.var(n) for n in meta.get("fetch_var_names", [])]
+    return program, feed_names, fetch_vars

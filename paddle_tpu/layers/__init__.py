@@ -1,0 +1,15 @@
+from . import io, learning_rate_scheduler, nn, ops, tensor  # noqa: F401
+from .io import data  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    assign,
+    create_global_var,
+    create_tensor,
+    fill_constant,
+    fill_constant_batch_size_like,
+    increment,
+    ones,
+    zeros,
+    zeros_like,
+)

@@ -1,0 +1,6 @@
+"""Importing this package registers every op lowering rule."""
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401

@@ -1,0 +1,310 @@
+"""Math op lowerings: elementwise (with axis-broadcast), activations,
+reductions, matmul family, misc scalar math.
+
+Reference coverage: ``paddle/fluid/operators/elementwise_*.cc``,
+``activation_op.cc`` (30+ activations), ``reduce_*.cc``, ``mul_op.cc``,
+``matmul_op.cc``, ``scale_op.cc``, ``sum_op.cc``, ``clip_op.cc``,
+``cast_op.cc``, ``mean_op.cc``.  Each lowers to jnp/lax ops that XLA fuses
+into surrounding computations (no per-op kernels needed on TPU); matmuls hit
+the MXU via ``jnp.matmul`` with preferred_element_type left to the input
+dtype policy.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, register_grad
+
+
+# ---------------------------------------------------------------------------
+# elementwise family with the reference's axis-broadcast semantics
+# (elementwise_op_function.h: Y broadcasts to X along a contiguous dim span
+# starting at `axis`; axis=-1 aligns trailing dims)
+# ---------------------------------------------------------------------------
+
+def broadcast_y(x, y, axis: int):
+    if x.shape == y.shape:
+        return y
+    if axis == -1 or axis is None:
+        return y  # trailing alignment == numpy broadcasting
+    # align y's dims at `axis` within x's rank, pad 1s on the right
+    new_shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        new_shape[axis + i] = s
+    return y.reshape(new_shape)
+
+
+def _ew(fn):
+    def lower(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [fn(x, broadcast_y(x, y, attrs.get("axis", -1)))]}
+    return lower
+
+
+register("elementwise_add")(_ew(jnp.add))
+register("elementwise_sub")(_ew(jnp.subtract))
+register("elementwise_mul")(_ew(jnp.multiply))
+register("elementwise_div")(_ew(jnp.divide))
+register("elementwise_max")(_ew(jnp.maximum))
+register("elementwise_min")(_ew(jnp.minimum))
+register("elementwise_pow")(_ew(jnp.power))
+register("elementwise_mod")(_ew(jnp.mod))
+register("elementwise_floordiv")(_ew(jnp.floor_divide))
+
+
+# ---------------------------------------------------------------------------
+# activations (activation_op.cc / activation_op.h functor zoo)
+# ---------------------------------------------------------------------------
+
+def _act(fn, needs_attrs=False):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        return {"Out": [fn(x, attrs) if needs_attrs else fn(x)]}
+    return lower
+
+
+register("relu")(_act(jax.nn.relu))
+register("sigmoid")(_act(jax.nn.sigmoid))
+register("logsigmoid")(_act(jax.nn.log_sigmoid))
+register("tanh")(_act(jnp.tanh))
+register("tanh_shrink")(_act(lambda x: x - jnp.tanh(x)))
+register("exp")(_act(jnp.exp))
+register("log")(_act(jnp.log))
+register("square")(_act(jnp.square))
+register("sqrt")(_act(jnp.sqrt))
+register("rsqrt")(_act(lax.rsqrt))
+register("abs")(_act(jnp.abs))
+register("ceil")(_act(jnp.ceil))
+register("floor")(_act(jnp.floor))
+register("round")(_act(jnp.round))
+register("reciprocal")(_act(jnp.reciprocal))
+register("sin")(_act(jnp.sin))
+register("cos")(_act(jnp.cos))
+register("softplus")(_act(jax.nn.softplus))
+register("softsign")(_act(jax.nn.soft_sign))
+register("softshrink")(
+    _act(lambda x, a: jnp.where(x > a["lambda"], x - a["lambda"],
+                                jnp.where(x < -a["lambda"], x + a["lambda"], 0.0)),
+         needs_attrs=True)
+)
+register("relu6")(_act(lambda x: jnp.clip(x, 0.0, 6.0)))
+register("leaky_relu")(_act(lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 0.02) * x), needs_attrs=True))
+register("elu")(_act(lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)), needs_attrs=True))
+register("gelu")(_act(lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate", True)), needs_attrs=True))
+register("swish")(_act(lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x), needs_attrs=True))
+register("hard_sigmoid")(
+    _act(lambda x, a: jnp.clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0), needs_attrs=True)
+)
+register("brelu")(_act(lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)), needs_attrs=True))
+register("pow")(_act(lambda x, a: jnp.power(x, a.get("factor", 1.0)), needs_attrs=True))
+register("stanh")(
+    _act(lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 0.67) * x), needs_attrs=True)
+)
+register("hard_shrink")(
+    _act(lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0), needs_attrs=True)
+)
+register("thresholded_relu")(
+    _act(lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0), needs_attrs=True)
+)
+register("maxout")(_act(
+    lambda x, a: x.reshape(x.shape[0], a["groups"], x.shape[1] // a["groups"], *x.shape[2:]).max(axis=1),
+    needs_attrs=True,
+))
+
+
+@register("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+@register("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+# ---------------------------------------------------------------------------
+# reductions (reduce_op.h: dim / keep_dim / reduce_all attrs)
+# ---------------------------------------------------------------------------
+
+def _reduce(fn):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            axes = None
+        else:
+            dim = attrs.get("dim", [0])
+            axes = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+        return {"Out": [fn(x, axis=axes, keepdims=attrs.get("keep_dim", False))]}
+    return lower
+
+
+register("reduce_sum")(_reduce(jnp.sum))
+register("reduce_mean")(_reduce(jnp.mean))
+register("reduce_max")(_reduce(jnp.max))
+register("reduce_min")(_reduce(jnp.min))
+register("reduce_prod")(_reduce(jnp.prod))
+
+
+@register("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+# ---------------------------------------------------------------------------
+# matmul family — the MXU path.  `mul` is the reference's FC core
+# (mul_op.cc:181: flattens X to 2-D by x_num_col_dims).
+# ---------------------------------------------------------------------------
+
+def flatten_to_2d(x, num_col_dims: int):
+    lead = 1
+    for s in x.shape[:num_col_dims]:
+        lead *= s
+    rest = 1
+    for s in x.shape[num_col_dims:]:
+        rest *= s
+    return x.reshape(lead, rest)
+
+
+@register("mul")
+def _mul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    x2 = flatten_to_2d(x, xnc)
+    y2 = flatten_to_2d(y, ync)
+    out = jnp.matmul(x2, y2)
+    out_shape = x.shape[:xnc] + y.shape[ync:]
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+@register("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = jnp.asarray(attrs.get("scale", 1.0), x.dtype)
+    bias = jnp.asarray(attrs.get("bias", 0.0), x.dtype)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * scale + bias]}
+    return {"Out": [(x + bias) * scale]}
+
+
+@register("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register("cast", no_grad_slots=())
+def _cast(ctx, ins, attrs):
+    from ..core.types import np_dtype
+    return {"Out": [ins["X"][0].astype(np_dtype(attrs["out_dtype"]))]}
+
+
+@register_grad("cast")
+def _cast_grad(ctx, ins, attrs):
+    g = ins["Out@GRAD"][0]
+    x = ins["X"][0]
+    return {"X@GRAD": [g.astype(x.dtype)]}
+
+
+@register("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs.get("min"), attrs.get("max"))]}
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    factor = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * factor.astype(x.dtype)]}
+
+
+@register("isfinite")
+def _isfinite(ctx, ins, attrs):
+    # reference isfinite_op: reduces all inputs to one bool-ish scalar
+    ok = jnp.asarray(True)
+    for x in ins["X"]:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {"Out": [ok]}
+
+
+@register("sign")
+def _sign(ctx, ins, attrs):
+    return {"Out": [jnp.sign(ins["X"][0])]}
+
+
+@register("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+        if attrs.get("exclusive", False):
+            out = out - x
+    return {"Out": [out]}
+
+
+# logical / comparison (compare_op.cc, logical_op.cc)
+def _cmp(fn):
+    def lower(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [fn(x, broadcast_y(x, y, attrs.get("axis", -1)))]}
+    return lower
+
+
+register("less_than", no_grad_slots=("X", "Y"))(_cmp(jnp.less))
+register("less_equal", no_grad_slots=("X", "Y"))(_cmp(jnp.less_equal))
+register("greater_than", no_grad_slots=("X", "Y"))(_cmp(jnp.greater))
+register("greater_equal", no_grad_slots=("X", "Y"))(_cmp(jnp.greater_equal))
+register("equal", no_grad_slots=("X", "Y"))(_cmp(jnp.equal))
+register("not_equal", no_grad_slots=("X", "Y"))(_cmp(jnp.not_equal))
+register("logical_and", no_grad_slots=("X", "Y"))(_cmp(jnp.logical_and))
+register("logical_or", no_grad_slots=("X", "Y"))(_cmp(jnp.logical_or))
+register("logical_xor", no_grad_slots=("X", "Y"))(_cmp(jnp.logical_xor))
+
+
+@register("logical_not", no_grad_slots=("X",))
+def _logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+# helpers for GradientClipByGlobalNorm (clip.py)
+@register("__global_norm_sq__", no_grad_slots=("X",))
+def _global_norm_sq(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(jnp.square(x.astype(jnp.float32)))]}
+
+
+@register("__global_norm_factor__", no_grad_slots=("X",))
+def _global_norm_factor(ctx, ins, attrs):
+    total_sq = ins["X"][0]
+    clip_norm = attrs["clip_norm"]
+    norm = jnp.sqrt(total_sq)
+    return {"Out": [clip_norm / jnp.maximum(norm, clip_norm)]}

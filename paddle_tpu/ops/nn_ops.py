@@ -1,0 +1,379 @@
+"""Neural-net op lowerings: conv, pool, normalization, losses, recurrent
+cells.
+
+Reference coverage: ``conv_op.cc``/``conv_cudnn_op.cu.cc``, ``pool_op.cc``,
+``batch_norm_op.cc``, ``layer_norm_op.cc``, ``cross_entropy_op.cc``,
+``softmax_with_cross_entropy_op.cc``, ``accuracy_op.cc``, ``lstm_op.cc`` +
+``math/lstm_compute``, ``gru_op.cc``, ``conv2d_transpose``, ``norm_op.cc``,
+``huber_loss``/``square_error_cost``-style losses.
+
+TPU mapping: convs/matmuls go through lax.conv_general_dilated / jnp.matmul
+(MXU); recurrences are ``lax.scan`` over padded [B,T,...] tensors with a
+length mask — the static-shape replacement for the reference's LoDTensor
+batch⇄sequence machinery (``math/sequence2batch.h``).  Gradients come from
+the vjp default rule (scan differentiates to reverse-scan, the functional
+equivalent of the reference's recurrent grad machinery).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register, register_grad
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _stat_dtype(x):
+    """Statistics dtype: at least f32 (bf16 inputs promote), keep f64."""
+    return jnp.promote_types(x.dtype, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+# ---------------------------------------------------------------------------
+
+@register("conv2d", no_grad_slots=())
+def _conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return {"Output": [out]}
+
+
+register("depthwise_conv2d")(
+    lambda ctx, ins, attrs: _conv2d(
+        ctx, ins, {**attrs, "groups": ins["Input"][0].shape[1]}
+    )
+)
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    out = lax.conv_transpose(
+        x, w,
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    return {"Output": [out]}
+
+
+@register("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        ks = x.shape[2:4]
+        strides, pads = ks, (0, 0)
+    else:
+        ks = _pair(attrs["ksize"])
+        strides = _pair(attrs.get("strides", [1, 1]))
+        pads = _pair(attrs.get("paddings", [0, 0]))
+    window = (1, 1) + tuple(ks)
+    strides_full = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides_full, padding)
+    else:
+        summed = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window, strides_full, padding)
+        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones(x.shape[2:4], jnp.float32)[None, None]
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, padding)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ks))
+        out = out.astype(x.dtype)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register("batch_norm", no_grad_slots=("Mean", "Variance"))
+def _batch_norm(ctx, ins, attrs):
+    """batch_norm_op.cc semantics: training mode uses batch statistics and
+    exponentially updates the running Mean/Variance (persistable state — the
+    executor writes MeanOut/VarianceOut back to the same scope vars);
+    is_test uses the running stats."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or not ctx.training
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    bshape = [1] * x.ndim
+    bshape[1 if layout == "NCHW" else x.ndim - 1] = -1
+
+    sdt = _stat_dtype(x)
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_inv_std = lax.rsqrt(var + eps)
+    else:
+        xf = x.astype(sdt)
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.var(xf, axis=axes)
+        mean_out = mean * momentum + use_mean * (1.0 - momentum)
+        var_out = var * momentum + use_var * (1.0 - momentum)
+        saved_mean = use_mean
+        saved_inv_std = lax.rsqrt(use_var + eps)
+
+    inv = lax.rsqrt(use_var.astype(sdt) + eps)
+    y = (x.astype(sdt) - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_inv_std],
+    }
+
+
+@register("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    """layer_norm_op.cc: normalize over dims [begin_norm_axis:], affine with
+    flattened Scale/Bias.  Stats in fp32 for bf16 inputs (TPU numeric
+    policy)."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    bna = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(bna, x.ndim))
+    xf = x.astype(_stat_dtype(x))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    bshape = [1] * bna + list(x.shape[bna:])
+    if "Scale" in ins and ins["Scale"]:
+        y = y * ins["Scale"][0].reshape(bshape)
+    if "Bias" in ins and ins["Bias"]:
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "Mean": [mean.reshape(x.shape[:bna])],
+        "Variance": [var.reshape(x.shape[:bna])],
+    }
+
+
+@register("norm")
+def _norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _squeeze_label(label):
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        return label.squeeze(-1)
+    return label
+
+
+@register("cross_entropy", no_grad_slots=("Label",))
+def _cross_entropy(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        li = _squeeze_label(label)
+        p = jnp.take_along_axis(x, li[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(p + eps)
+    return {"Y": [loss]}
+
+
+@register("softmax_with_cross_entropy", no_grad_slots=("Label",))
+def _softmax_xent(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    sdt = _stat_dtype(logits)
+    lse = jax.nn.logsumexp(logits.astype(sdt), axis=-1, keepdims=True)
+    log_softmax = logits.astype(sdt) - lse
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * log_softmax, axis=-1, keepdims=True)
+    else:
+        li = _squeeze_label(label).astype(jnp.int32)
+        picked = jnp.take_along_axis(log_softmax, li[..., None], axis=-1)
+        if attrs.get("ignore_index", -100) != -100:
+            mask = (li[..., None] != attrs["ignore_index"]).astype(log_softmax.dtype)
+            picked = picked * mask
+        loss = -picked
+    return {"Softmax": [jnp.exp(log_softmax).astype(logits.dtype)],
+            "Loss": [loss.astype(logits.dtype)]}
+
+
+@register("square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register("sigmoid_cross_entropy_with_logits", no_grad_slots=("Label",))
+def _sce_logits(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+@register("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    d = x - y
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / sigma2, 0.5 * d * d * sigma2, a - 0.5 / sigma2)
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, x.ndim)), keepdims=False)[..., None]],
+            "Diff": [d]}
+
+
+# ---------------------------------------------------------------------------
+# metrics (accuracy_op.cc; used by fluid.layers.accuracy)
+# ---------------------------------------------------------------------------
+
+@register("accuracy", no_grad_slots=("Out", "Indices", "Label"))
+def _accuracy(ctx, ins, attrs):
+    idx = ins["Indices"][0]
+    label = _squeeze_label(ins["Label"][0])
+    correct = jnp.any(idx == label[..., None], axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    acc = num_correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": [acc], "Correct": [num_correct], "Total": [total]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent cells — scan over padded [B,T,*] + length mask.
+# Gate order: i, f, c(candidate), o — documented contract for Weight layout.
+# ---------------------------------------------------------------------------
+
+def _length_mask(seq_len, B, T, dtype):
+    if seq_len is None:
+        return jnp.ones((B, T), dtype)
+    t = jnp.arange(T)[None, :]
+    return (t < seq_len[:, None]).astype(dtype)
+
+
+@register("lstm", no_grad_slots=("SeqLen",))
+def _lstm(ctx, ins, attrs):
+    """Fused LSTM over a padded batch (lstm_op.cc + math/lstm_compute
+    re-designed for XLA: lax.scan with [B,4H] gate matmuls per step — the
+    recurrent matmul rides the MXU, elementwise gates fuse on the VPU).
+
+    Inputs: Input [B,T,4H] (x·Wx + b precomputed by the layer), Weight
+    [H,4H] recurrent weights, optional H0/C0 [B,H], optional SeqLen [B].
+    Outputs: Hidden [B,T,H], Cell [B,T,H], LastH, LastC [B,H].
+    """
+    xproj = ins["Input"][0]
+    w = ins["Weight"][0]
+    B, T, H4 = xproj.shape
+    H = H4 // 4
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), xproj.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), xproj.dtype)
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    mask = _length_mask(seq_len, B, T, xproj.dtype)
+    reverse = attrs.get("is_reverse", False)
+
+    xs = jnp.swapaxes(xproj, 0, 1)  # [T,B,4H]
+    ms = jnp.swapaxes(mask, 0, 1)[..., None]  # [T,B,1]
+    if reverse:
+        xs, ms = jnp.flip(xs, 0), jnp.flip(ms, 0)
+
+    def step(carry, inp):
+        h, c = carry
+        x_t, m_t = inp
+        gates = x_t + jnp.matmul(h, w)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        c_new = m_t * c_new + (1 - m_t) * c
+        h_new = m_t * h_new + (1 - m_t) * h
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_last, c_last), (hs, cs) = lax.scan(step, (h0, c0), (xs, ms))
+    if reverse:
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+        "LastH": [h_last],
+        "LastC": [c_last],
+    }
+
+
+@register("gru", no_grad_slots=("SeqLen",))
+def _gru(ctx, ins, attrs):
+    """Fused GRU over a padded batch (gru_op.cc + math/gru_compute).
+    Input [B,T,3H] (x-projection), Weight [H,3H] as [update|reset|candidate].
+    """
+    xproj = ins["Input"][0]
+    w = ins["Weight"][0]
+    B, T, H3 = xproj.shape
+    H = H3 // 3
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), xproj.dtype)
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    mask = _length_mask(seq_len, B, T, xproj.dtype)
+    reverse = attrs.get("is_reverse", False)
+
+    w_uz = w[:, : 2 * H]
+    w_c = w[:, 2 * H :]
+    xs = jnp.swapaxes(xproj, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[..., None]
+    if reverse:
+        xs, ms = jnp.flip(xs, 0), jnp.flip(ms, 0)
+
+    def step(h, inp):
+        x_t, m_t = inp
+        x_uz, x_c = x_t[:, : 2 * H], x_t[:, 2 * H :]
+        uz = jax.nn.sigmoid(x_uz + jnp.matmul(h, w_uz))
+        u, r = uz[:, :H], uz[:, H:]
+        c = jnp.tanh(x_c + jnp.matmul(r * h, w_c))
+        h_new = u * h + (1 - u) * c
+        h_new = m_t * h_new + (1 - m_t) * h
+        return h_new, h_new
+
+    h_last, hs = lax.scan(step, h0, (xs, ms))
+    if reverse:
+        hs = jnp.flip(hs, 0)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}

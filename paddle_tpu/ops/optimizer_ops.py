@@ -1,0 +1,176 @@
+"""Optimizer op lowerings — per-parameter device-side updates.
+
+Reference coverage: ``sgd_op``, ``momentum_op``, ``adam_op``, ``adagrad_op``,
+``adamax_op``, ``adadelta_op``, ``rmsprop_op``, ``ftrl_op``,
+``decayed_adagrad_op``, ``lars_momentum`` (paddle/fluid/operators/*.cc).
+
+These ops write to persistable vars (ParamOut aliases Param etc.); the
+executor detects the writes and returns updated state — functional in-place
+updates with donated buffers, so XLA reuses the parameter's HBM allocation.
+Accumulator math runs in the accumulator's own dtype (keep fp32 accumulators
+under bf16 params — the standard TPU mixed-precision recipe).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+def _lr(ins, dtype=None):
+    lr = ins["LearningRate"][0]
+    lr = lr.reshape(()) if hasattr(lr, "reshape") else lr
+    return lr.astype(dtype) if dtype is not None else lr
+
+
+@register("sgd")
+def _sgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": [p - _lr(ins, p.dtype) * g.astype(p.dtype)]}
+
+
+@register("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = jnp.asarray(attrs.get("mu", 0.9), v.dtype)
+    lr = _lr(ins, v.dtype)
+    g = g.astype(v.dtype)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new).astype(p.dtype) * lr.astype(p.dtype)
+    else:
+        p_new = p - (lr * v_new).astype(p.dtype)
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register("adam")
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    beta1 = jnp.asarray(attrs.get("beta1", 0.9), m1.dtype)
+    beta2 = jnp.asarray(attrs.get("beta2", 0.999), m2.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-8), m1.dtype)
+    gf = g.astype(m1.dtype)
+    m1n = beta1 * m1 + (1 - beta1) * gf
+    m2n = beta2 * m2 + (1 - beta2) * gf * gf
+    lr = _lr(ins, m1.dtype) * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    step = lr * m1n / (jnp.sqrt(m2n) + eps)
+    return {
+        "ParamOut": [(p.astype(m1.dtype) - step).astype(p.dtype)],
+        "Moment1Out": [m1n],
+        "Moment2Out": [m2n],
+        "Beta1PowOut": [b1p * beta1],
+        "Beta2PowOut": [b2p * beta2],
+    }
+
+
+@register("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = jnp.asarray(attrs.get("epsilon", 1e-6), mom.dtype)
+    gf = g.astype(mom.dtype)
+    mom_new = mom + gf * gf
+    p_new = p - (_lr(ins, mom.dtype) * gf / (jnp.sqrt(mom_new) + eps)).astype(p.dtype)
+    return {"ParamOut": [p_new], "MomentOut": [mom_new]}
+
+
+@register("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = jnp.asarray(attrs.get("decay", 0.95), mom.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-6), mom.dtype)
+    gf = g.astype(mom.dtype)
+    mom_new = decay * mom + (1 - decay) * gf * gf
+    p_new = p - (_lr(ins, mom.dtype) * gf / (jnp.sqrt(mom_new) + eps)).astype(p.dtype)
+    return {"ParamOut": [p_new], "MomentOut": [mom_new]}
+
+
+@register("adamax")
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    beta1 = jnp.asarray(attrs.get("beta1", 0.9), m.dtype)
+    beta2 = jnp.asarray(attrs.get("beta2", 0.999), m.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-8), m.dtype)
+    gf = g.astype(m.dtype)
+    m_new = beta1 * m + (1 - beta1) * gf
+    inf_new = jnp.maximum(beta2 * inf, jnp.abs(gf))
+    lr = _lr(ins, m.dtype) / (1 - b1p.reshape(()))
+    p_new = p - (lr * m_new / (inf_new + eps)).astype(p.dtype)
+    return {"ParamOut": [p_new], "MomentOut": [m_new], "InfNormOut": [inf_new],
+            "Beta1PowOut": [b1p * beta1]}
+
+
+@register("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = jnp.asarray(attrs.get("rho", 0.95), avg_sq_g.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-6), avg_sq_g.dtype)
+    gf = g.astype(avg_sq_g.dtype)
+    asg_new = rho * avg_sq_g + (1 - rho) * gf * gf
+    update = -jnp.sqrt((avg_sq_u + eps) / (asg_new + eps)) * gf
+    asu_new = rho * avg_sq_u + (1 - rho) * update * update
+    return {"ParamOut": [(p.astype(gf.dtype) + update).astype(p.dtype)],
+            "AvgSquaredGradOut": [asg_new], "AvgSquaredUpdateOut": [asu_new]}
+
+
+@register("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    rho = jnp.asarray(attrs.get("decay", 0.95), ms.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-6), ms.dtype)
+    momentum = jnp.asarray(attrs.get("momentum", 0.0), ms.dtype)
+    gf = g.astype(ms.dtype)
+    ms_new = rho * ms + (1 - rho) * gf * gf
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_new = rho * mg + (1 - rho) * gf
+        denom = ms_new - mg_new * mg_new + eps
+    else:
+        mg_new = None
+        denom = ms_new + eps
+    mom_new = momentum * mom + _lr(ins, ms.dtype) * gf * lax.rsqrt(denom)
+    out = {"ParamOut": [(p.astype(gf.dtype) - mom_new).astype(p.dtype)],
+           "MeanSquareOut": [ms_new], "MomentOut": [mom_new]}
+    if mg_new is not None:
+        out["MeanGradOut"] = [mg_new]
+    return out
+
+
+@register("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq_acc, lin_acc = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = jnp.asarray(attrs.get("l1", 0.0), sq_acc.dtype)
+    l2 = jnp.asarray(attrs.get("l2", 0.0), sq_acc.dtype)
+    lr_power = jnp.asarray(attrs.get("lr_power", -0.5), sq_acc.dtype)
+    lr = _lr(ins, sq_acc.dtype)
+    gf = g.astype(sq_acc.dtype)
+    new_sq = sq_acc + gf * gf
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq_acc, -lr_power)) / lr
+    lin_new = lin_acc + gf - sigma * p.astype(sq_acc.dtype)
+    x = jnp.clip(lin_new, -l1, l1) - lin_new
+    y = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    p_new = (x / y).astype(p.dtype)
+    return {"ParamOut": [p_new], "SquaredAccumOut": [new_sq], "LinearAccumOut": [lin_new]}
+
+
+@register("lars_momentum")
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = jnp.asarray(attrs.get("mu", 0.9), v.dtype)
+    lars_coeff = attrs.get("lars_coeff", 1e-3)
+    lars_wd = attrs.get("lars_weight_decay", 5e-4)
+    lr = _lr(ins, v.dtype)
+    gf = g.astype(v.dtype)
+    pf = p.astype(v.dtype)
+    p_norm = jnp.sqrt(jnp.sum(pf * pf))
+    g_norm = jnp.sqrt(jnp.sum(gf * gf))
+    local_lr = lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + 1e-12)
+    v_new = mu * v + local_lr * (gf + lars_wd * pf)
+    return {"ParamOut": [(pf - v_new).astype(p.dtype)], "VelocityOut": [v_new]}

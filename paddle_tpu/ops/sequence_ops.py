@@ -1,0 +1,107 @@
+"""Sequence op lowerings over the padded [B,T,...] + lengths contract.
+
+Reference coverage: the LoD sequence op family
+(``paddle/fluid/operators/sequence_*`` ~25 ops + ``math/sequence_pooling``,
+``math/sequence2batch``).  The reference packs ragged sequences with LoD
+offsets; here sequences are padded dense tensors with an explicit length
+vector, so these ops lower to masked reductions / gathers that XLA fuses —
+no scatter-heavy batch⇄sequence reordering needed on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _mask(x, seq_len):
+    """[B,T] validity mask broadcastable to x [B,T,...]."""
+    B, T = x.shape[0], x.shape[1]
+    m = jnp.arange(T)[None, :] < seq_len[:, None]
+    return m.reshape((B, T) + (1,) * (x.ndim - 2))
+
+
+@register("sequence_pool", no_grad_slots=("SeqLen",))
+def _sequence_pool(ctx, ins, attrs):
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    if seq_len is None:
+        m = jnp.ones(x.shape[:2] + (1,) * (x.ndim - 2), x.dtype)
+        lens = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    else:
+        m = _mask(x, seq_len).astype(x.dtype)
+        lens = seq_len
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        denom = lens.reshape((-1,) + (1,) * (x.ndim - 2)).astype(x.dtype)
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(denom, 1)
+    elif ptype == "SQRT":
+        denom = jnp.sqrt(lens.reshape((-1,) + (1,) * (x.ndim - 2)).astype(x.dtype))
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(denom, 1)
+    elif ptype == "MAX":
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@register("sequence_softmax", no_grad_slots=("SeqLen",))
+def _sequence_softmax(ctx, ins, attrs):
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    if seq_len is None:
+        return {"Out": [jax.nn.softmax(x, axis=1)]}
+    m = _mask(x, seq_len)
+    neg = jnp.asarray(-1e9, jnp.float32)
+    logits = jnp.where(m, x.astype(jnp.float32), neg)
+    out = jax.nn.softmax(logits, axis=1) * m.astype(jnp.float32)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register("sequence_expand", no_grad_slots=("SeqLen",))
+def _sequence_expand(ctx, ins, attrs):
+    # expand [B, D] (or [B,1,D]) to [B, T, D] following Y's layout
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == y.ndim:
+        return {"Out": [jnp.broadcast_to(x, y.shape[:2] + x.shape[2:])]}
+    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])]}
+
+
+@register("sequence_reverse", no_grad_slots=("SeqLen",))
+def _sequence_reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    T = x.shape[1]
+    if seq_len is None:
+        return {"Out": [jnp.flip(x, axis=1)]}
+    # per-row reversal of the valid prefix: index (len-1-t) mod T for t<len
+    t = jnp.arange(T)[None, :]
+    idx = jnp.where(t < seq_len[:, None], seq_len[:, None] - 1 - t, t)
+    out = jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+    return {"Out": [out]}
+
+
+@register("sequence_concat", no_grad_slots=("SeqLen",))
+def _sequence_concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=1)]}
+
+
+@register("sequence_first_step", no_grad_slots=("SeqLen",))
+def _sequence_first_step(ctx, ins, attrs):
+    return {"Out": [ins["X"][0][:, 0]]}
+
+
+@register("sequence_last_step", no_grad_slots=("SeqLen",))
+def _sequence_last_step(ctx, ins, attrs):
+    return _sequence_pool(ctx, ins, {"pooltype": "LAST"})

@@ -1,0 +1,296 @@
+"""Tensor manipulation / creation op lowerings.
+
+Reference coverage: ``reshape_op.cc``, ``transpose_op.cc``, ``concat_op.cc``,
+``split_op.cc``, ``stack_op``, ``slice_op.cc``, ``expand_op.cc``,
+``gather_op.cc``, ``scatter_op.cc``, ``lookup_table_op.cc``,
+``fill_constant_op.cc``, ``uniform_random_op.cc``, ``gaussian_random_op.cc``,
+``assign_op.cc``, ``shape_op.cc``, ``one_hot_op.cc``, ``top_k_op.cc``,
+``arg_max_op``, ``cast_op``, ``pad_op.cc``, ``squeeze/unsqueeze``,
+``fill_constant_batch_size_like_op.cc``, ``increment_op``, ``dropout_op.cc``.
+Random ops consume PRNG keys threaded through the block (ctx.prng()), the
+functional replacement for the reference's per-op seed attrs + cuRAND.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, register_grad
+from ..core.types import np_dtype
+
+
+@register("reshape")
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # reference semantics: 0 means copy input dim; -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": [x.reshape(shape)]}
+
+
+register("reshape2")(_reshape)  # alias; reference reshape2 also outputs XShape
+
+
+@register("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+register("transpose2")(_transpose)
+
+
+@register("squeeze")
+def _squeeze(ctx, ins, attrs):
+    axes = attrs.get("axes", [])
+    x = ins["X"][0]
+    return {"Out": [jnp.squeeze(x, axis=tuple(axes) if axes else None)]}
+
+
+@register("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    for ax in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, ax)
+    return {"Out": [x]}
+
+
+@register("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    sections = attrs.get("sections", [])
+    num = attrs.get("num", 0)
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes, starts, ends = attrs["axes"], attrs["starts"], attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        en = max(en + dim, 0) if en < 0 else min(en, dim)
+        idx[ax] = slice(st, en)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register("expand")
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # flat [before0, after0, before1, after1, ...]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register("gather", no_grad_slots=("Index",))
+def _gather(ctx, ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, index, axis=attrs.get("axis", 0))]}
+
+
+@register("scatter", no_grad_slots=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if attrs.get("overwrite", True):
+        return {"Out": [x.at[ids].set(updates)]}
+    return {"Out": [x.at[ids].add(updates)]}
+
+
+@register("lookup_table", no_grad_slots=("Ids",))
+def _lookup_table(ctx, ins, attrs):
+    """Embedding gather (lookup_table_op.cc).  Ids may carry a trailing
+    [..., 1] dim like the reference; padding_idx rows produce zeros.
+    On TPU this is a plain XLA gather; the distributed/sharded-table path
+    lives in the transpiler + pserver layers, not here."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids.squeeze(-1)
+    out = jnp.take(w, ids, axis=0)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        mask = (ids != pad)[..., None].astype(out.dtype)
+        out = out * mask
+    return {"Out": [out]}
+
+
+@register("one_hot", no_grad_slots=("X",))
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    return {"Out": [jax.nn.one_hot(x, attrs["depth"], dtype=np_dtype(attrs.get("dtype", "float32")))]}
+
+
+@register("shape", no_grad_slots=("Input",))
+def _shape(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["Input"][0].shape, dtype=jnp.int32)]}
+
+
+@register("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register("increment")
+def _increment(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
+
+
+@register("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(attrs["shape"]), attrs["value"], dtype=dt)]}
+
+
+@register("fill_constant_batch_size_like", no_grad_slots=("Input",))
+def _fill_cbsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(shape), attrs["value"], dtype=dt)]}
+
+
+@register("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register("uniform_random", stateful=True)
+def _uniform_random(ctx, ins, attrs):
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs["shape"])
+    key = _seed_key(ctx, attrs)
+    return {"Out": [jax.random.uniform(key, shape, dtype=jnp.float32,
+                                       minval=attrs.get("min", -1.0),
+                                       maxval=attrs.get("max", 1.0)).astype(dt)]}
+
+
+@register("gaussian_random", stateful=True)
+def _gaussian_random(ctx, ins, attrs):
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs["shape"])
+    key = _seed_key(ctx, attrs)
+    x = jax.random.normal(key, shape, dtype=jnp.float32)
+    return {"Out": [(x * attrs.get("std", 1.0) + attrs.get("mean", 0.0)).astype(dt)]}
+
+
+@register("truncated_gaussian_random", stateful=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs["shape"])
+    key = _seed_key(ctx, attrs)
+    x = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=jnp.float32)
+    return {"Out": [(x * attrs.get("std", 1.0) + attrs.get("mean", 0.0)).astype(dt)]}
+
+
+def _seed_key(ctx, attrs):
+    seed = attrs.get("seed", 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.prng()
+
+
+@register("dropout", stateful=True)
+def _dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or not ctx.training
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        if impl == "upscale_in_train":
+            return {"Out": [x], "Mask": [jnp.ones_like(x)]}
+        return {"Out": [x * jnp.asarray(1.0 - p, x.dtype)],
+                "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(_seed_key(ctx, attrs), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        scale = jnp.asarray(1.0 / max(1.0 - p, 1e-8), x.dtype)
+        mask = keep.astype(x.dtype) * scale
+    else:
+        mask = keep.astype(x.dtype)
+    return {"Out": [x * mask], "Mask": [mask]}
+
+
+@register_grad("dropout")
+def _dropout_grad(ctx, ins, attrs):
+    return {"X@GRAD": [ins["Out@GRAD"][0] * ins["Mask"][0]]}
+
+
+@register("top_k", no_grad_slots=("X",))
+def _top_k(ctx, ins, attrs):
+    vals, idx = lax.top_k(ins["X"][0], attrs["k"])
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("arg_max", no_grad_slots=("X",))
+def _arg_max(ctx, ins, attrs):
+    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+
+
+@register("arg_min", no_grad_slots=("X",))
+def _arg_min(ctx, ins, attrs):
+    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+
+
+@register("range", no_grad_slots=("Start", "End", "Step"))
+def _range(ctx, ins, attrs):
+    if "Start" in ins:
+        st, en, sp = ins["Start"][0], ins["End"][0], ins["Step"][0]
+        # XLA needs static sizes; range via attrs preferred
+        raise NotImplementedError("dynamic range not supported under XLA; use attrs")
+    dt = np_dtype(attrs.get("dtype", "int64"))
+    return {"Out": [jnp.arange(attrs["start"], attrs["end"], attrs["step"], dtype=dt)]}
+
+
+@register("where", no_grad_slots=("Condition",))
+def _where(ctx, ins, attrs):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+@register("print")
+def _print(ctx, ins, attrs):
+    x = ins["In"][0]
+    jax.debug.print(attrs.get("message", "") + " {}", x)
+    return {"Out": [x]}
+
+
+@register("assign_value")
+def _assign_value(ctx, ins, attrs):
+    import numpy as _np
+    arr = _np.asarray(attrs["values"], dtype=np_dtype(attrs.get("dtype", "float32")))
+    return {"Out": [jnp.asarray(arr.reshape(attrs["shape"]))]}

@@ -1,0 +1,21 @@
+"""Test env: virtual 8-device CPU mesh + x64 for numeric-gradient checks.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-device tests run
+against ``--xla_force_host_platform_device_count=8`` in one process, the way
+the reference exercised multi-GPU op handles with several Places in one
+process (details/broadcast_op_handle_test.cc).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_ENABLE_X64"] = "1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+# env JAX_PLATFORMS alone is not honored once the axon TPU plugin registers;
+# force the CPU backend explicitly so tests run on the virtual 8-device mesh
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,118 @@
+"""Numeric-gradient op test harness.
+
+The TPU-native port of the reference's workhorse
+``python/paddle/fluid/tests/unittests/op_test.py``: build a small program
+around one op/layer, compare the graph-level autodiff gradients
+(append_backward → <op>_grad lowered via jax.vjp) against central-difference
+numeric gradients (op_test.py ``get_numeric_gradient:43`` /
+``check_grad:400`` semantics).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.initializer import NumpyArrayInitializer
+
+
+def check_grad(
+    build_fn: Callable[[Dict[str, "fluid.Variable"]], "fluid.Variable"],
+    inputs: Dict[str, np.ndarray],
+    wrt: Optional[List[str]] = None,
+    eps: float = 1e-4,
+    rtol: float = 1e-3,
+    atol: float = 1e-4,
+    max_coords: int = 6,
+    seed: int = 1234,
+):
+    """Compare analytic vs numeric d(sum(out*cot))/d(input) for each input
+    in ``wrt``.  Float inputs become trainable parameters; integer inputs
+    become constant persistable vars."""
+    rng = np.random.RandomState(seed)
+    wrt = wrt if wrt is not None else [
+        k for k, v in inputs.items() if np.issubdtype(np.asarray(v).dtype, np.floating)
+    ]
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        in_vars = {}
+        gb = prog.global_block
+        for name, arr in inputs.items():
+            arr = np.asarray(arr)
+            if np.issubdtype(arr.dtype, np.floating):
+                v = gb.create_parameter(name, list(arr.shape), str(arr.dtype))
+                sv = startup.global_block.create_parameter(
+                    name, list(arr.shape), str(arr.dtype))
+                NumpyArrayInitializer(arr)(sv, startup.global_block)
+            else:
+                v = gb.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype),
+                                  persistable=True, stop_gradient=True)
+                sv = startup.global_block.create_var(
+                    name=name, shape=arr.shape, dtype=str(arr.dtype),
+                    persistable=True)
+                NumpyArrayInitializer(arr)(sv, startup.global_block)
+            in_vars[name] = v
+        out = build_fn(in_vars)
+        cot = rng.uniform(0.5, 1.5, size=[s for s in out.shape]).astype("float64")
+        cot_v = fluid.layers.assign(cot.astype(np.dtype(out.dtype)))
+        prod = fluid.layers.elementwise_mul(out, cot_v)
+        loss = fluid.layers.reduce_sum(prod)
+        pairs = fluid.append_backward(loss, parameter_list=wrt)
+
+    grad_of = {p.name: g.name for p, g in pairs}
+    scope = Scope()
+    exe = Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        fetch = [loss.name] + [grad_of[n] for n in wrt]
+        vals = exe.run(prog, fetch_list=fetch)
+        analytic = dict(zip(wrt, vals[1:]))
+
+        for name in wrt:
+            arr = np.asarray(inputs[name]).copy()
+            flat = arr.reshape(-1)
+            n = flat.size
+            coords = rng.choice(n, size=min(max_coords, n), replace=False)
+            for c in coords:
+                orig = flat[c]
+                flat[c] = orig + eps
+                scope.set_var(name, arr.reshape(inputs[name].shape))
+                (lp,) = exe.run(prog, fetch_list=[loss.name])
+                flat[c] = orig - eps
+                scope.set_var(name, arr.reshape(inputs[name].shape))
+                (lm,) = exe.run(prog, fetch_list=[loss.name])
+                flat[c] = orig
+                scope.set_var(name, arr.reshape(inputs[name].shape))
+                numeric = (float(lp) - float(lm)) / (2 * eps)
+                got = float(np.asarray(analytic[name]).reshape(-1)[c])
+                np.testing.assert_allclose(
+                    got, numeric, rtol=rtol, atol=atol,
+                    err_msg=f"grad mismatch for {name}[{c}]",
+                )
+
+
+def run_forward(build_fn, inputs: Dict[str, np.ndarray], fetch=None):
+    """Run a single forward program; returns fetched numpy values."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        in_vars = {}
+        gb = prog.global_block
+        for name, arr in inputs.items():
+            arr = np.asarray(arr)
+            v = gb.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype),
+                              persistable=True)
+            sv = startup.global_block.create_var(
+                name=name, shape=arr.shape, dtype=str(arr.dtype), persistable=True)
+            NumpyArrayInitializer(arr)(sv, startup.global_block)
+            in_vars[name] = v
+        out = build_fn(in_vars)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+    scope = Scope()
+    exe = Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        return exe.run(prog, fetch_list=[o.name for o in outs])

@@ -1,0 +1,140 @@
+"""Executor/Scope tests (reference executor tests + book/fit_a_line)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+
+def test_fit_a_line_converges():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [13])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    scope = Scope()
+    exe = Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        w = rng.randn(13, 1).astype("float32")
+        for _ in range(300):
+            xb = rng.randn(32, 13).astype("float32")
+            yb = xb @ w + 0.7
+            (l,) = exe.run(prog, feed={"x": xb, "y": yb.astype("float32")},
+                           fetch_list=[loss])
+        assert float(l) < 0.05
+
+
+def test_scope_isolation():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [2])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="w_iso"))
+    s1, s2 = Scope(), Scope()
+    exe = Executor()
+    with scope_guard(s1):
+        exe.run(startup)
+    with scope_guard(s2):
+        exe.run(startup)
+        s2.set_var("w_iso", np.zeros((2, 1), dtype="float32"))
+        out2 = exe.run(prog, feed={"x": np.ones((1, 2), "float32")},
+                       fetch_list=[pred])
+    with scope_guard(s1):
+        out1 = exe.run(prog, feed={"x": np.ones((1, 2), "float32")},
+                       fetch_list=[pred])
+    assert np.allclose(out2[0], 0.0)
+    assert not np.allclose(out1[0], 0.0)
+
+
+def test_program_cache_and_shape_bucket():
+    """Different batch sizes recompile but produce consistent results."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [3])
+        out = fluid.layers.scale(x, scale=2.0)
+    exe = Executor()
+    scope = Scope()
+    with scope_guard(scope):
+        for bs in (4, 8, 4):
+            xb = np.ones((bs, 3), "float32")
+            (o,) = exe.run(prog, feed={"x": xb}, fetch_list=[out])
+            assert o.shape == (bs, 3) and np.allclose(o, 2.0)
+    assert len(exe._cache) == 2  # two shape buckets
+
+
+def test_persistable_state_updates():
+    """batch_norm running stats update across runs (write-back of MeanOut)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        bn = fluid.layers.batch_norm(x, moving_mean_name="bn_mean_test")
+    exe = Executor()
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        m0 = np.asarray(scope.find_var("bn_mean_test")).copy()
+        xb = np.full((8, 4), 5.0, "float32")
+        exe.run(prog, feed={"x": xb}, fetch_list=[bn])
+        m1 = np.asarray(scope.find_var("bn_mean_test"))
+        assert not np.allclose(m0, m1)
+        assert np.all(m1 > 0)  # moving toward batch mean of 5
+
+
+def test_rng_state_advances():
+    """Two dropout runs draw different masks (threaded PRNG state)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [64])
+        d = fluid.layers.dropout(x, 0.5)
+    exe = Executor()
+    scope = Scope()
+    with scope_guard(scope):
+        xb = np.ones((2, 64), "float32")
+        (a,) = exe.run(prog, feed={"x": xb}, fetch_list=[d])
+        (b,) = exe.run(prog, feed={"x": xb}, fetch_list=[d])
+        assert not np.allclose(a, b)
+
+
+def test_save_load_persistables(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [3])
+        pred = fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(name="sl_w"),
+                               bias_attr=fluid.ParamAttr(name="sl_b"))
+    exe = Executor()
+    s1 = Scope()
+    with scope_guard(s1):
+        exe.run(startup)
+        fluid.io.save_persistables(exe, str(tmp_path), prog)
+        w = np.asarray(s1.find_var("sl_w"))
+    s2 = Scope()
+    with scope_guard(s2):
+        fluid.io.load_persistables(exe, str(tmp_path), prog)
+        w2 = np.asarray(s2.find_var("sl_w"))
+    assert np.allclose(w, w2)
+
+
+def test_save_load_inference_model(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [3])
+        pred = fluid.layers.fc(x, 2, act="softmax")
+    exe = Executor()
+    s1 = Scope()
+    xb = np.ones((2, 3), "float32")
+    with scope_guard(s1):
+        exe.run(startup)
+        with program_guard(prog, startup):
+            fluid.io.save_inference_model(str(tmp_path), ["x"], [pred], exe, prog)
+        (ref,) = exe.run(prog, feed={"x": xb}, fetch_list=[pred])
+    s2 = Scope()
+    with scope_guard(s2):
+        iprog, feeds, fetches = fluid.io.load_inference_model(str(tmp_path), exe)
+        assert feeds == ["x"]
+        (got,) = exe.run(iprog, feed={"x": xb}, fetch_list=fetches)
+    assert np.allclose(ref, got, atol=1e-6)

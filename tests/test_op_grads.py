@@ -1,0 +1,215 @@
+"""Numeric-gradient checks for the core op set (op_test.py equivalents,
+reference tests/unittests/test_mul_op.py, test_conv2d_op.py, etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_harness import check_grad
+
+L = fluid.layers
+rng = np.random.RandomState(7)
+
+
+def f64(*shape):
+    return rng.uniform(-1, 1, shape).astype("float64")
+
+
+def test_mul_grad():
+    check_grad(lambda v: L.mul(v["x"], v["y"]),
+               {"x": f64(4, 6), "y": f64(6, 5)})
+
+
+def test_matmul_transpose_grad():
+    check_grad(
+        lambda v: L.matmul(v["x"], v["y"], transpose_y=True),
+        {"x": f64(3, 4, 6), "y": f64(3, 5, 6)})
+
+
+def test_elementwise_add_broadcast_axis():
+    check_grad(
+        lambda v: L.elementwise_add(v["x"], v["y"], axis=1),
+        {"x": f64(2, 3, 4), "y": f64(3,)})
+
+
+def test_elementwise_mul_grad():
+    check_grad(lambda v: L.elementwise_mul(v["x"], v["y"]),
+               {"x": f64(3, 4), "y": f64(3, 4)})
+
+
+def test_elementwise_div_grad():
+    check_grad(lambda v: L.elementwise_div(v["x"], v["y"]),
+               {"x": f64(3, 4), "y": f64(3, 4) + 2.0})
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "square",
+                                 "softplus", "gelu", "swish", "elu"])
+def test_activation_grads(act):
+    # shift away from relu kink for stable numeric diff
+    x = f64(4, 5) + 0.1
+    check_grad(lambda v: getattr(L, act)(v["x"]), {"x": x})
+
+
+def test_softmax_grad():
+    check_grad(lambda v: L.softmax(v["x"]), {"x": f64(4, 7)})
+
+
+def test_reduce_sum_grad():
+    check_grad(lambda v: L.reduce_sum(v["x"], dim=1, keep_dim=True),
+               {"x": f64(3, 4, 2)})
+
+
+def test_reduce_mean_grad():
+    check_grad(lambda v: L.reduce_mean(v["x"], dim=[0, 2]),
+               {"x": f64(3, 4, 2)})
+
+
+def test_reduce_max_grad():
+    check_grad(lambda v: L.reduce_max(v["x"], dim=1), {"x": f64(3, 5)})
+
+
+def test_transpose_reshape_concat_grad():
+    def build(v):
+        t = L.transpose(v["x"], [1, 0, 2])
+        r = L.reshape(t, [4, 6])
+        return L.concat([r, v["y"]], axis=1)
+    check_grad(build, {"x": f64(2, 4, 3), "y": f64(4, 2)})
+
+
+def test_split_grad():
+    def build(v):
+        a, b = L.split(v["x"], 2, dim=1)
+        return L.elementwise_mul(a, b)
+    check_grad(build, {"x": f64(3, 8)})
+
+
+def test_conv2d_grad():
+    check_grad(
+        lambda v: L.conv2d(v["x"], 4, 3, padding=1, bias_attr=False,
+                           param_attr=fluid.ParamAttr(name="convw")),
+        {"x": f64(2, 3, 8, 8)},
+        wrt=["x"], rtol=5e-3, atol=5e-4)
+
+
+def test_pool2d_avg_grad():
+    check_grad(lambda v: L.pool2d(v["x"], 2, "avg", 2), {"x": f64(2, 3, 6, 6)})
+
+
+def test_pool2d_max_grad():
+    check_grad(lambda v: L.pool2d(v["x"], 2, "max", 2), {"x": f64(2, 3, 6, 6)})
+
+
+def test_layer_norm_grad():
+    check_grad(
+        lambda v: L.layer_norm(v["x"], begin_norm_axis=1),
+        {"x": f64(4, 6)}, rtol=5e-3, atol=5e-4)
+
+
+def test_batch_norm_grad():
+    # training-mode BN: grads flow through batch statistics
+    check_grad(
+        lambda v: L.batch_norm(v["x"]),
+        {"x": f64(4, 3, 5, 5)}, rtol=5e-3, atol=5e-4)
+
+
+def test_cross_entropy_grad():
+    probs = rng.uniform(0.1, 1.0, (4, 5)).astype("float64")
+    probs /= probs.sum(-1, keepdims=True)
+    labels = rng.randint(0, 5, (4, 1)).astype("int32")
+    check_grad(
+        lambda v: L.cross_entropy(v["x"], v["label"]),
+        {"x": probs, "label": labels}, wrt=["x"])
+
+
+def test_softmax_with_cross_entropy_grad():
+    logits = f64(4, 6)
+    labels = rng.randint(0, 6, (4, 1)).astype("int32")
+    check_grad(
+        lambda v: L.softmax_with_cross_entropy(v["x"], v["label"]),
+        {"x": logits, "label": labels}, wrt=["x"])
+
+
+def test_lookup_table_grad():
+    ids = rng.randint(0, 10, (4, 1)).astype("int32")
+
+    def build(v):
+        # embed via the op directly against the provided table param
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("emb_test")
+        out = helper.create_variable_for_type_inference("float64", shape=(4, 3))
+        helper.append_op("lookup_table", {"W": [v["w"]], "Ids": [v["ids"]]},
+                         {"Out": [out]}, {"padding_idx": -1})
+        return out
+
+    check_grad(build, {"w": f64(10, 3), "ids": ids}, wrt=["w"])
+
+
+def test_lstm_grad():
+    def build(v):
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("lstm_test")
+        B, T, H = 2, 3, 4
+        hidden = helper.create_variable_for_type_inference("float64", shape=(B, T, H))
+        cell = helper.create_variable_for_type_inference("float64", shape=(B, T, H))
+        lh = helper.create_variable_for_type_inference("float64", shape=(B, H))
+        lc = helper.create_variable_for_type_inference("float64", shape=(B, H))
+        helper.append_op(
+            "lstm", {"Input": [v["x"]], "Weight": [v["w"]]},
+            {"Hidden": [hidden], "Cell": [cell], "LastH": [lh], "LastC": [lc]},
+            {})
+        return hidden
+    check_grad(build, {"x": f64(2, 3, 16), "w": f64(4, 16)},
+               rtol=5e-3, atol=5e-4)
+
+
+def test_gru_grad():
+    def build(v):
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("gru_test")
+        B, T, H = 2, 3, 4
+        hidden = helper.create_variable_for_type_inference("float64", shape=(B, T, H))
+        lh = helper.create_variable_for_type_inference("float64", shape=(B, H))
+        helper.append_op(
+            "gru", {"Input": [v["x"]], "Weight": [v["w"]]},
+            {"Hidden": [hidden], "LastH": [lh]}, {})
+        return hidden
+    check_grad(build, {"x": f64(2, 3, 12), "w": f64(4, 12)},
+               rtol=5e-3, atol=5e-4)
+
+
+def test_sequence_pool_grad():
+    lens = np.array([2, 3], dtype=np.int32)
+
+    def build(v):
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("sp_test")
+        out = helper.create_variable_for_type_inference("float64", shape=(2, 4))
+        helper.append_op("sequence_pool",
+                         {"X": [v["x"]], "SeqLen": [v["len"]]},
+                         {"Out": [out]}, {"pooltype": "AVERAGE"})
+        return out
+    check_grad(build, {"x": f64(2, 3, 4), "len": lens}, wrt=["x"])
+
+
+def test_scale_clip_grad():
+    def build(v):
+        return L.clip(L.scale(v["x"], scale=2.0, bias=0.3), -0.5, 0.5)
+    x = f64(3, 4)
+    # keep away from clip kinks
+    x = np.where(np.abs(2 * x + 0.3) - 0.5 < 0.05, x + 0.2, x)
+    check_grad(build, {"x": x})
+
+
+def test_gather_grad():
+    idx = np.array([0, 2, 1, 2], dtype=np.int32)
+    check_grad(lambda v: L.gather(v["x"], v["i"]),
+               {"x": f64(3, 4), "i": idx}, wrt=["x"])
+
+
+def test_dropout_grad_via_mask():
+    """dropout grad rule uses the saved mask — train mode, fixed seed."""
+    x = f64(6, 6)
+
+    def build(v):
+        return L.dropout(v["x"], dropout_prob=0.4, seed=42,
+                         dropout_implementation="upscale_in_train")
+    check_grad(build, {"x": x})

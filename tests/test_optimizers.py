@@ -1,0 +1,126 @@
+"""Every optimizer reduces a quadratic loss (reference
+tests/unittests/test_optimizer.py + per-optimizer op tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+OPTIMIZERS = [
+    lambda: fluid.optimizer.SGD(0.1),
+    lambda: fluid.optimizer.Momentum(0.1, momentum=0.9),
+    lambda: fluid.optimizer.Adam(0.1),
+    lambda: fluid.optimizer.Adagrad(0.5),
+    lambda: fluid.optimizer.Adamax(0.1),
+    lambda: fluid.optimizer.DecayedAdagrad(0.5),
+    lambda: fluid.optimizer.Adadelta(1.0, rho=0.9),
+    lambda: fluid.optimizer.RMSProp(0.05),
+    lambda: fluid.optimizer.Ftrl(0.5),
+    lambda: fluid.optimizer.LarsMomentum(1.0, momentum=0.9, lars_coeff=0.5),
+]
+
+
+@pytest.mark.parametrize("make_opt", OPTIMIZERS,
+                         ids=[o().type for o in OPTIMIZERS])
+def test_optimizer_reduces_quadratic(make_opt):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square(pred))
+        make_opt().minimize(loss)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xb = np.ones((8, 4), "float32")
+        losses = [float(exe.run(prog, feed={"x": xb}, fetch_list=[loss])[0])
+                  for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_lr_scheduler_noam():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        lr = fluid.layers.learning_rate_scheduler.noam_decay(512, 100)
+        x = fluid.layers.data("x", [2])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square(pred))
+        fluid.optimizer.SGD(lr).minimize(loss)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xb = np.ones((4, 2), "float32")
+        lrs = [float(exe.run(prog, feed={"x": xb}, fetch_list=[lr])[0])
+               for _ in range(5)]
+    # warmup phase: lr increases with step
+    assert lrs[1] > lrs[0] and lrs[4] > lrs[3]
+
+
+def test_piecewise_decay():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        lr = fluid.layers.learning_rate_scheduler.piecewise_decay(
+            [3, 6], [0.1, 0.01, 0.001])
+        x = fluid.layers.data("x", [2])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square(pred))
+        fluid.optimizer.SGD(lr).minimize(loss)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xb = np.ones((4, 2), "float32")
+        lrs = [round(float(exe.run(prog, feed={"x": xb}, fetch_list=[lr])[0]), 6)
+               for _ in range(8)]
+    assert lrs[0] == 0.1 and lrs[3] == 0.01 and lrs[7] == 0.001
+
+
+def test_l2_regularization_changes_update():
+    def run(reg):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup), unique_name.guard():
+            x = fluid.layers.data("x", [2])
+            pred = fluid.layers.fc(
+                x, 1, bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    name="wreg",
+                    initializer=fluid.initializer.ConstantInitializer(1.0)))
+            loss = fluid.layers.mean(pred)
+            fluid.optimizer.SGD(0.1, regularization=reg).minimize(loss)
+        exe = Executor()
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            exe.run(prog, feed={"x": np.zeros((2, 2), "float32")},
+                    fetch_list=[loss])
+            return np.asarray(scope.find_var("wreg")).copy()
+
+    w_plain = run(None)
+    w_reg = run(fluid.regularizer.L2Decay(0.5))
+    assert not np.allclose(w_plain, w_reg)
+    assert np.all(w_reg < w_plain)  # decay shrinks weights
+
+
+def test_global_norm_clip():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [2])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square(pred))
+        fluid.clip.set_gradient_clip(fluid.clip.GradientClipByGlobalNorm(1e-6))
+        try:
+            fluid.optimizer.SGD(1.0).minimize(loss)
+        finally:
+            fluid.clip.set_gradient_clip(None)
+    exe = Executor()
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        params = prog.all_parameters()
+        before = np.asarray(scope.find_var(params[0].name)).copy()
+        exe.run(prog, feed={"x": np.ones((4, 2), "float32") * 100},
+                fetch_list=[loss])
+        after = np.asarray(scope.find_var(params[0].name))
+    # clipped to tiny global norm → parameters barely move
+    assert np.allclose(before, after, atol=1e-4)

@@ -1,0 +1,72 @@
+"""Program IR unit tests (reference framework tests:
+test_program.py, test_operator_desc.py, prune semantics)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.program import Program, program_guard
+
+
+def build_simple():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        y1 = fluid.layers.fc(x, 3, act="relu")
+        y2 = fluid.layers.fc(y1, 2)
+        dead = fluid.layers.fc(x, 7)  # not needed for y2
+    return prog, startup, y2, dead
+
+
+def test_program_structure():
+    prog, startup, out, _ = build_simple()
+    ops = [op.type for op in prog.global_block.ops]
+    assert ops.count("mul") == 3
+    assert "relu" in ops
+    params = prog.all_parameters()
+    assert len(params) == 6  # 3 weights + 3 biases
+    assert all(p.persistable for p in params)
+    # startup has one initializer op per parameter
+    assert len(startup.global_block.ops) == 6
+
+
+def test_serialization_roundtrip():
+    prog, _, out, _ = build_simple()
+    data = prog.serialize()
+    prog2 = Program.deserialize(data)
+    assert [op.type for op in prog2.global_block.ops] == \
+        [op.type for op in prog.global_block.ops]
+    v = prog2.global_block.var(out.name)
+    assert v.shape == out.shape and v.dtype == out.dtype
+
+
+def test_prune():
+    prog, _, out, dead = build_simple()
+    pruned = prog.prune([out.name])
+    kept = [op.type for op in pruned.global_block.ops]
+    assert kept.count("mul") == 2
+    assert dead.name not in pruned.global_block.vars
+
+
+def test_clone_independent():
+    prog, _, out, _ = build_simple()
+    clone = prog.clone()
+    n = len(clone.global_block.ops)
+    prog.global_block.append_op("mean", {"X": [out.name]}, {"Out": ["m"]})
+    assert len(clone.global_block.ops) == n
+
+
+def test_op_roles():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    from paddle_tpu.core.program import OP_ROLE_ATTR, OpRole
+    roles = {op.attr(OP_ROLE_ATTR) for op in prog.global_block.ops}
+    assert OpRole.Forward in roles
+    assert any(r & OpRole.Backward for r in roles if isinstance(r, int))
+    assert OpRole.Optimize in roles
+    sgd_ops = [op for op in prog.global_block.ops if op.type == "sgd"]
+    assert len(sgd_ops) == 2  # w and b

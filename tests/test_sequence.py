@@ -1,0 +1,107 @@
+"""Padded-sequence semantics vs per-example numpy loops (the LoDTensor
+parity tests; reference sequence op unittests)."""
+import numpy as np
+
+from op_harness import run_forward
+from paddle_tpu.layer_helper import LayerHelper
+
+rng = np.random.RandomState(3)
+
+
+def _seq_batch(B=4, Tmax=6, D=3):
+    lens = rng.randint(1, Tmax + 1, size=B).astype("int32")
+    x = rng.randn(B, Tmax, D).astype("float64")
+    for b in range(B):
+        x[b, lens[b]:] = 0.0
+    return x, lens
+
+
+def _run_seq_op(op_type, x, lens, attrs, out_shape):
+    def build(v):
+        helper = LayerHelper(op_type + "_t")
+        out = helper.create_variable_for_type_inference("float64", shape=out_shape)
+        helper.append_op(op_type, {"X": [v["x"]], "SeqLen": [v["len"]]},
+                         {"Out": [out]}, attrs)
+        return out
+    (got,) = run_forward(build, {"x": x, "len": lens})
+    return got
+
+
+def test_sequence_pool_modes():
+    x, lens = _seq_batch()
+    B, T, D = x.shape
+    for mode, ref_fn in [
+        ("SUM", lambda s: s.sum(0)),
+        ("AVERAGE", lambda s: s.mean(0)),
+        ("MAX", lambda s: s.max(0)),
+        ("LAST", lambda s: s[-1]),
+        ("FIRST", lambda s: s[0]),
+        ("SQRT", lambda s: s.sum(0) / np.sqrt(len(s))),
+    ]:
+        got = _run_seq_op("sequence_pool", x, lens, {"pooltype": mode}, (B, D))
+        want = np.stack([ref_fn(x[b, :lens[b]]) for b in range(B)])
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-12,
+                                   err_msg=f"mode {mode}")
+
+
+def test_sequence_reverse():
+    x, lens = _seq_batch()
+    B, T, D = x.shape
+    got = _run_seq_op("sequence_reverse", x, lens, {}, (B, T, D))
+    for b in range(B):
+        np.testing.assert_allclose(got[b, :lens[b]], x[b, :lens[b]][::-1])
+        np.testing.assert_allclose(got[b, lens[b]:], x[b, lens[b]:])
+
+
+def test_sequence_softmax_masks_pad():
+    x, lens = _seq_batch(D=1)
+    B, T, D = x.shape
+    got = _run_seq_op("sequence_softmax", x, lens, {}, (B, T, D))
+    for b in range(B):
+        np.testing.assert_allclose(got[b, lens[b]:], 0.0, atol=1e-12)
+        np.testing.assert_allclose(got[b, :lens[b]].sum(), 1.0, rtol=1e-6)
+
+
+def test_lstm_masking_freezes_state_after_length():
+    """Hidden state stops changing past each row's length."""
+    B, T, H = 3, 5, 4
+    lens = np.array([2, 5, 3], dtype="int32")
+    xproj = rng.randn(B, T, 4 * H).astype("float64")
+    w = (rng.randn(H, 4 * H) * 0.1).astype("float64")
+
+    def build(v):
+        helper = LayerHelper("lstm_m")
+        hidden = helper.create_variable_for_type_inference("float64", shape=(B, T, H))
+        cell = helper.create_variable_for_type_inference("float64", shape=(B, T, H))
+        lh = helper.create_variable_for_type_inference("float64", shape=(B, H))
+        lc = helper.create_variable_for_type_inference("float64", shape=(B, H))
+        helper.append_op(
+            "lstm",
+            {"Input": [v["x"]], "Weight": [v["w"]], "SeqLen": [v["len"]]},
+            {"Hidden": [hidden], "Cell": [cell], "LastH": [lh], "LastC": [lc]},
+            {})
+        return [hidden, lh]
+
+    got_h, got_lh = run_forward(build, {"x": xproj, "w": w, "len": lens})
+    for b in range(B):
+        L = lens[b]
+        for t in range(L, T):
+            np.testing.assert_allclose(got_h[b, t], got_h[b, L - 1], atol=1e-12)
+        np.testing.assert_allclose(got_lh[b], got_h[b, L - 1], atol=1e-12)
+
+
+def test_data_feeder_padding():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.program import Program, program_guard
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        words = fluid.layers.data("w", [1], dtype="int64", lod_level=1)
+        label = fluid.layers.data("l", [1], dtype="int64")
+        feeder = fluid.DataFeeder(["w", "l"])
+    batch = [(np.array([1, 2, 3]), 0), (np.array([4]), 1)]
+    fd = feeder.feed(batch)
+    assert fd["w"].shape[0] == 2 and fd["w"].shape[1] >= 3
+    assert fd["w"].shape[2] == 1
+    np.testing.assert_array_equal(fd["w@LEN"], [3, 1])
+    assert fd["l"].shape == (2, 1)

@@ -1,0 +1,99 @@
+"""Benchmark: Transformer-base training throughput (tokens/sec) on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Model: Transformer-base (d_model=512, 8 heads, ffn 2048, 6+6 layers,
+vocab 32k, seq 64) — the reference's dist_transformer.py config — built and
+trained entirely through the paddle_tpu program stack (layer DSL →
+append_backward → Adam ops → whole-block XLA lowering).
+
+Baseline for vs_baseline: 50,000 tokens/sec ≈ A100 mixed-precision
+Transformer-base training per-chip throughput (BASELINE.md north-star:
+"≥A100 per-chip throughput").
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+A100_TOKENS_PER_SEC = 50_000.0
+
+BATCH = 64
+SEQ = 64
+VOCAB = 32000
+WARMUP = 3
+STEPS = 20
+DTYPE = "bfloat16"
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.lowering import analyze_block, build_block_fn
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.models import transformer
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 1
+    with program_guard(prog, startup), unique_name.guard():
+        feed_names, loss, _ = transformer.build(
+            src_vocab=VOCAB, tgt_vocab=VOCAB, max_len=SEQ,
+            dropout=0.1, with_optimizer=True, dtype=DTYPE)
+
+    scope = Scope()
+    exe = Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+
+        rng_np = np.random.RandomState(0)
+        mask = np.ones((BATCH, SEQ), "float32")
+        feed = {
+            "src_ids": rng_np.randint(0, VOCAB, (BATCH, SEQ)).astype("int64"),
+            "tgt_ids": rng_np.randint(0, VOCAB, (BATCH, SEQ)).astype("int64"),
+            "lbl_ids": rng_np.randint(0, VOCAB, (BATCH, SEQ)).astype("int64"),
+            "src_mask": mask,
+            "tgt_mask": mask,
+        }
+        ordered = sorted(feed)
+        plan = analyze_block(prog, 0, ordered, [loss.name])
+        fn = build_block_fn(prog, plan)
+        jitted = jax.jit(fn, donate_argnums=(1,))
+
+        feeds = [jax.device_put(feed[n]) for n in ordered]
+        donated = [jax.device_put(np.asarray(scope.find_var(n)))
+                   for n in plan.donated_reads]
+        const = [jax.device_put(np.asarray(scope.find_var(n)))
+                 for n in plan.const_reads]
+        rng = jax.random.PRNGKey(0)
+
+        refeed = plan.donated_write_indices
+
+        def step(donated, rng):
+            fetches, new_state, rng = jitted(feeds, donated, const, rng)
+            return fetches[0], [new_state[i] for i in refeed], rng
+
+        for _ in range(WARMUP):
+            l, donated, rng = step(donated, rng)
+        jax.block_until_ready(l)
+
+        t0 = time.time()
+        for _ in range(STEPS):
+            l, donated, rng = step(donated, rng)
+        jax.block_until_ready(l)
+        dt = time.time() - t0
+
+    tokens_per_sec = BATCH * SEQ * STEPS / dt
+    print(json.dumps({
+        "metric": "transformer_base_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tokens_per_sec / A100_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

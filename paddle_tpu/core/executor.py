@@ -122,20 +122,21 @@ class Executor:
         feed = feed or {}
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])]
         scope = scope or global_scope()
+        program = self._prepare_program(program, feed)
 
         feed_names = sorted(feed)
         block = program.global_block
         feed_vals = []
         for n in feed_names:
             var = block.var_or_none(n)
-            feed_vals.append(_as_device_array(feed[n], var))
+            feed_vals.append(self._put_feed(_as_device_array(feed[n], var)))
 
         sig = tuple((n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_vals))
         key = (id(program), program._version, sig, tuple(fetch_names))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             plan = analyze_block(program, 0, feed_names, fetch_names)
-            fn = build_block_fn(program, plan)
+            fn = build_block_fn(program, plan, mesh=self._mesh())
             jitted = jax.jit(fn, donate_argnums=(1,))
             entry = (plan, jitted)
             if use_program_cache:
@@ -147,10 +148,12 @@ class Executor:
         rng = scope.find_var(RNG_STATE_VAR)
         if rng is None:
             rng = jax.random.PRNGKey(program.random_seed or 0)
+        rng = self._put_rng(rng)
 
         fetches, new_state, rng_out = jitted(feed_vals, donated_state, const_state, rng)
 
         for name, val in zip(plan.persist_writes, new_state):
+            self._note_state_write(name)
             scope.set_var(name, val)
         if plan.has_stateful:
             scope.set_var(RNG_STATE_VAR, rng_out)
@@ -158,6 +161,25 @@ class Executor:
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    # -- placement hooks (overridden by ParallelExecutor) ------------------
+    def _prepare_program(self, program: Program, feed: Dict) -> Program:
+        return program
+
+    def _mesh(self):
+        return None
+
+    def _put_feed(self, arr):
+        return arr
+
+    def _put_rng(self, rng):
+        return rng
+
+    def _put_state(self, name: str, val):
+        return val
+
+    def _note_state_write(self, name: str) -> None:
+        pass
 
     # -- helpers -----------------------------------------------------------
     def _state_val(self, scope: Scope, block, name: str):
@@ -167,7 +189,11 @@ class Executor:
                 f"variable {name!r} is not initialized in the scope — run the "
                 f"startup program first (fluid.default_startup_program())"
             )
-        return _as_device_array(val, block.var_or_none(name))
+        val = _as_device_array(val, block.var_or_none(name))
+        placed = self._put_state(name, val)
+        if placed is not val:
+            scope.set_var(name, placed)
+        return placed
 
     def close(self) -> None:
         self._cache.clear()

@@ -44,6 +44,14 @@ class BlockPlan:
         w = set(self.persist_writes)
         return [n for n in self.state_reads if n not in w]
 
+    @property
+    def donated_write_indices(self) -> List[int]:
+        """For step-loop drivers: indices into the returned ``new_state``
+        (persist_writes order) that refeed the donated inputs
+        (donated_reads order) on the next call."""
+        pos = {n: i for i, n in enumerate(self.persist_writes)}
+        return [pos[n] for n in self.donated_reads]
+
 
 def analyze_block(program: Program, block_idx: int, feed_names: Sequence[str],
                   fetch_names: Sequence[str]) -> BlockPlan:

@@ -192,7 +192,11 @@ def vjp_grad(opdef: OpDef, ctx: LowerContext, ins: SlotVals, attrs: dict) -> Slo
     def make_cot(path_slot, j, primal):
         g_list = ins.get(path_slot + "@GRAD")
         if g_list is not None and j < len(g_list) and g_list[j] is not None:
-            return g_list[j]
+            g = g_list[j]
+            pdt = jnp.asarray(primal).dtype
+            # declared grad-var dtype can differ from the promoted primal
+            # dtype under mixed precision (bf16 activations, f32 stats)
+            return g.astype(pdt) if g.dtype != pdt else g
         if jnp.issubdtype(jnp.asarray(primal).dtype, jnp.inexact):
             return jnp.zeros_like(primal)
         import numpy as _np

@@ -1,0 +1,3 @@
+"""Model zoo mirroring the reference's benchmark/test model set
+(benchmark/fluid/models/ + dist_transformer.py + dist_ctr.py)."""
+from . import deepfm, mnist, resnet, stacked_lstm, transformer, vgg  # noqa: F401

@@ -1,0 +1,7 @@
+from .parallel_executor import ParallelExecutor, make_mesh  # noqa: F401
+from .strategy import (  # noqa: F401
+    BuildStrategy,
+    ExecutionStrategy,
+    GradientScaleStrategy,
+    ReduceStrategy,
+)

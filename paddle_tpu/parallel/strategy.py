@@ -1,0 +1,49 @@
+"""Build/execution strategies for parallel execution.
+
+Reference: ``paddle/fluid/framework/details/build_strategy.h:55`` (ReduceStrategy,
+GradientScaleStrategy) and ``execution_strategy.h:21``.  On TPU these select
+*sharding policies* for the one jitted program instead of assembling an SSA
+graph of collective op-handles:
+
+- ``kAllReduce``  → parameters + optimizer state replicated; XLA/GSPMD emits
+  the gradient all-reduce over ICI (the NCCLAllReduce analogue).
+- ``kReduce``     → optimizer state (and accumulator math) sharded over the
+  data axis; GSPMD emits reduce-scatter + all-gather — the reference's
+  "reduce → update on one device → broadcast" becomes ZeRO-style sharding.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class ReduceStrategy:
+    kAllReduce = 0
+    kReduce = 1
+
+
+class GradientScaleStrategy:
+    kCoeffNumDevice = 0
+    kOne = 1
+    kCustomized = 2
+
+
+@dataclass
+class BuildStrategy:
+    reduce_strategy: int = ReduceStrategy.kAllReduce
+    gradient_scale_strategy: int = GradientScaleStrategy.kCoeffNumDevice
+    debug_graphviz_path: str = ""
+    # TPU extensions beyond the 2018 reference: named mesh axes and
+    # parameter sharding rules (regex -> PartitionSpec dims) enabling
+    # tensor/model parallelism on the same program.
+    mesh_shape: Optional[Dict[str, int]] = None          # e.g. {"dp": 8, "mp": 1}
+    sharding_rules: List[Tuple[str, tuple]] = field(default_factory=list)
+    # e.g. [(r".*ffn1\.w.*", (None, "mp")), (r".*embed.*", ("mp", None))]
+
+
+@dataclass
+class ExecutionStrategy:
+    num_threads: int = 0
+    use_cuda: bool = True  # parity field; device choice belongs to JAX
+    allow_op_delay: bool = False
+    num_iteration_per_drop_scope: int = 1

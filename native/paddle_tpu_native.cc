@@ -1,0 +1,304 @@
+// Native runtime components for paddle_tpu (C ABI, loaded via ctypes).
+//
+// TPU-native equivalents of the reference's native data-path pieces:
+//  - BlockingQueue: bounded MPMC byte-buffer queue feeding the device input
+//    pipeline (reference: operators/reader/lod_tensor_blocking_queue.h and
+//    the double-buffer reader's staging queue).
+//  - RecordIO: chunked record file format with per-chunk CRC32 and optional
+//    zlib compression (reference: paddle/fluid/recordio/{header,chunk,
+//    scanner,writer} — same structure: magic, per-chunk record count,
+//    compressor tag, checksum).
+//  - ThreadPool: fixed worker pool used by the host-side pipeline
+//    (reference: framework/threadpool.h).
+//
+// Build: make -C native   (g++ -O2 -fPIC -shared -lz -lpthread)
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// BlockingQueue of byte buffers
+// ---------------------------------------------------------------------------
+
+struct Queue {
+  size_t capacity;
+  std::deque<std::string> items;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  bool closed = false;
+};
+
+void* ptq_queue_create(size_t capacity) {
+  auto* q = new Queue();
+  q->capacity = capacity ? capacity : 1;
+  return q;
+}
+
+// blocks while full; returns 0 on success, -1 if closed
+int ptq_queue_push(void* qp, const char* data, size_t len) {
+  auto* q = static_cast<Queue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_full.wait(lk, [q] { return q->items.size() < q->capacity || q->closed; });
+  if (q->closed) return -1;
+  q->items.emplace_back(data, len);
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// blocks while empty; returns length (malloc'd into *out), -1 if closed+drained
+long ptq_queue_pop(void* qp, char** out) {
+  auto* q = static_cast<Queue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [q] { return !q->items.empty() || q->closed; });
+  if (q->items.empty()) return -1;
+  std::string s = std::move(q->items.front());
+  q->items.pop_front();
+  q->not_full.notify_one();
+  lk.unlock();
+  *out = static_cast<char*>(malloc(s.size()));
+  memcpy(*out, s.data(), s.size());
+  return static_cast<long>(s.size());
+}
+
+void ptq_buffer_free(char* buf) { free(buf); }
+
+void ptq_queue_close(void* qp) {
+  auto* q = static_cast<Queue*>(qp);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+size_t ptq_queue_size(void* qp) {
+  auto* q = static_cast<Queue*>(qp);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+int ptq_queue_closed(void* qp) {
+  auto* q = static_cast<Queue*>(qp);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->closed ? 1 : 0;
+}
+
+void ptq_queue_destroy(void* qp) { delete static_cast<Queue*>(qp); }
+
+// ---------------------------------------------------------------------------
+// RecordIO (recordio/header.h:25 layout concept: chunked, CRC, compressor)
+// ---------------------------------------------------------------------------
+
+static const uint32_t kMagic = 0x50545152;  // "PTQR"
+enum Compressor { kNone = 0, kZlib = 1 };
+
+struct ChunkHeader {
+  uint32_t magic;
+  uint32_t num_records;
+  uint32_t compressor;
+  uint32_t crc32;
+  uint64_t payload_len;  // on-disk (possibly compressed) length
+};
+
+struct Writer {
+  FILE* f;
+  int compressor;
+  size_t max_records;
+  std::string buf;       // raw concatenated (len,data) records
+  uint32_t num_records = 0;
+};
+
+static int write_chunk(Writer* w) {
+  if (w->num_records == 0) return 0;
+  std::string payload;
+  if (w->compressor == kZlib) {
+    uLongf dst_len = compressBound(w->buf.size());
+    payload.resize(dst_len);
+    if (compress2(reinterpret_cast<Bytef*>(&payload[0]), &dst_len,
+                  reinterpret_cast<const Bytef*>(w->buf.data()),
+                  w->buf.size(), Z_DEFAULT_COMPRESSION) != Z_OK)
+      return -1;
+    payload.resize(dst_len);
+  } else {
+    payload = w->buf;
+  }
+  ChunkHeader h;
+  h.magic = kMagic;
+  h.num_records = w->num_records;
+  h.compressor = static_cast<uint32_t>(w->compressor);
+  h.crc32 = static_cast<uint32_t>(
+      crc32(0L, reinterpret_cast<const Bytef*>(payload.data()), payload.size()));
+  h.payload_len = payload.size();
+  // raw length follows header so the scanner can size its buffer
+  uint64_t raw_len = w->buf.size();
+  if (fwrite(&h, sizeof(h), 1, w->f) != 1) return -1;
+  if (fwrite(&raw_len, sizeof(raw_len), 1, w->f) != 1) return -1;
+  if (!payload.empty() && fwrite(payload.data(), payload.size(), 1, w->f) != 1)
+    return -1;
+  w->buf.clear();
+  w->num_records = 0;
+  return 0;
+}
+
+void* ptq_recordio_writer_open(const char* path, int compressor,
+                               size_t max_chunk_records) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  w->compressor = compressor;
+  w->max_records = max_chunk_records ? max_chunk_records : 1000;
+  return w;
+}
+
+int ptq_recordio_write(void* wp, const char* data, size_t len) {
+  auto* w = static_cast<Writer*>(wp);
+  uint32_t l = static_cast<uint32_t>(len);
+  w->buf.append(reinterpret_cast<const char*>(&l), sizeof(l));
+  w->buf.append(data, len);
+  w->num_records++;
+  if (w->num_records >= w->max_records) return write_chunk(w);
+  return 0;
+}
+
+int ptq_recordio_writer_close(void* wp) {
+  auto* w = static_cast<Writer*>(wp);
+  int rc = write_chunk(w);
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+struct Scanner {
+  FILE* f;
+  std::string chunk;          // decompressed current chunk
+  size_t offset = 0;
+  uint32_t remaining = 0;
+};
+
+void* ptq_recordio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+static int load_chunk(Scanner* s) {
+  ChunkHeader h;
+  if (fread(&h, sizeof(h), 1, s->f) != 1) return -1;  // EOF
+  if (h.magic != kMagic) return -2;
+  uint64_t raw_len;
+  if (fread(&raw_len, sizeof(raw_len), 1, s->f) != 1) return -2;
+  std::string payload(h.payload_len, '\0');
+  if (h.payload_len &&
+      fread(&payload[0], h.payload_len, 1, s->f) != 1)
+    return -2;
+  uint32_t crc = static_cast<uint32_t>(crc32(
+      0L, reinterpret_cast<const Bytef*>(payload.data()), payload.size()));
+  if (crc != h.crc32) return -3;  // corruption detected
+  if (h.compressor == kZlib) {
+    s->chunk.resize(raw_len);
+    uLongf dst = raw_len;
+    if (uncompress(reinterpret_cast<Bytef*>(&s->chunk[0]), &dst,
+                   reinterpret_cast<const Bytef*>(payload.data()),
+                   payload.size()) != Z_OK)
+      return -2;
+  } else {
+    s->chunk = std::move(payload);
+  }
+  s->offset = 0;
+  s->remaining = h.num_records;
+  return 0;
+}
+
+// returns record length (malloc'd into *out); -1 EOF; -2 format err; -3 CRC err
+long ptq_recordio_next(void* sp, char** out) {
+  auto* s = static_cast<Scanner*>(sp);
+  if (s->remaining == 0) {
+    int rc = load_chunk(s);
+    if (rc != 0) return rc;
+  }
+  uint32_t len;
+  memcpy(&len, s->chunk.data() + s->offset, sizeof(len));
+  s->offset += sizeof(len);
+  *out = static_cast<char*>(malloc(len));
+  memcpy(*out, s->chunk.data() + s->offset, len);
+  s->offset += len;
+  s->remaining--;
+  return static_cast<long>(len);
+}
+
+void ptq_recordio_scanner_close(void* sp) {
+  auto* s = static_cast<Scanner*>(sp);
+  fclose(s->f);
+  delete s;
+}
+
+// ---------------------------------------------------------------------------
+// ThreadPool (framework/threadpool.h analogue) — runs C callbacks; the
+// Python side uses it through the prefetch pipeline below.
+// ---------------------------------------------------------------------------
+
+struct Pool {
+  std::vector<std::thread> workers;
+  std::deque<std::function<void()>> tasks;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool stop = false;
+};
+
+void* ptq_pool_create(int num_threads) {
+  auto* p = new Pool();
+  for (int i = 0; i < num_threads; ++i) {
+    p->workers.emplace_back([p] {
+      for (;;) {
+        std::function<void()> task;
+        {
+          std::unique_lock<std::mutex> lk(p->mu);
+          p->cv.wait(lk, [p] { return p->stop || !p->tasks.empty(); });
+          if (p->stop && p->tasks.empty()) return;
+          task = std::move(p->tasks.front());
+          p->tasks.pop_front();
+        }
+        task();
+      }
+    });
+  }
+  return p;
+}
+
+typedef void (*ptq_task_fn)(void* arg);
+
+void ptq_pool_submit(void* pp, ptq_task_fn fn, void* arg) {
+  auto* p = static_cast<Pool*>(pp);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->tasks.emplace_back([fn, arg] { fn(arg); });
+  }
+  p->cv.notify_one();
+}
+
+void ptq_pool_destroy(void* pp) {
+  auto* p = static_cast<Pool*>(pp);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->cv.notify_all();
+  for (auto& t : p->workers) t.join();
+  delete p;
+}
+
+}  // extern "C"

@@ -24,6 +24,7 @@ from __future__ import annotations
 from . import ops  # noqa: F401
 
 from . import clip  # noqa: F401
+from . import data  # noqa: F401
 from . import initializer  # noqa: F401
 from . import io  # noqa: F401
 from . import layers  # noqa: F401

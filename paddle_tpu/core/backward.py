@@ -126,6 +126,21 @@ def append_backward(
         op = block.ops[idx]
         if op.attr(OP_ROLE_ATTR, OpRole.Forward) != OpRole.Forward:
             continue
+        if op.type in ("while", "conditional_block"):
+            raise NotImplementedError(
+                f"gradient of {op.type!r} is not supported yet — use "
+                f"StaticRNN (lax.scan, fully differentiable) for trainable "
+                f"recurrence; while/conditional_block are inference-path ops")
+        if op.type == "static_rnn":
+            # grad re-traces the scan; rng-consuming ops inside would draw
+            # fresh keys and silently corrupt gradients — reject them
+            sub = program.blocks[op.attr("sub_block")]
+            for sop in sub.ops:
+                if registry.has(sop.type) and registry.get(sop.type).stateful:
+                    raise NotImplementedError(
+                        f"op {sop.type!r} inside a StaticRNN step block is "
+                        f"not differentiable (rng re-traced in the reverse "
+                        f"scan); hoist it outside the rnn or use is_test")
         if not registry.has(op.type):
             raise KeyError(f"cannot differentiate unregistered op {op.type!r}")
         opdef = registry.get(op.type)

@@ -74,8 +74,13 @@ def analyze_block(program: Program, block_idx: int, feed_names: Sequence[str],
                 if n and n != EMPTY_VAR and n not in defined and n not in seen_reads:
                     seen_reads.add(n)
                     plan.state_reads.append(n)
+            # names bound inside sub-blocks by the control-flow lowering
+            # (scan step inputs, memories, loop carries) are not scope reads
+            inner = set(op.attr("carry_vars", ()) or ())
+            inner |= set(op.attr("step_input_vars", ()) or ())
+            inner |= {m[0] for m in (op.attr("memories", ()) or ())}
             for sub in op.sub_block_ids:
-                walk(program.blocks[sub], set(defined))
+                walk(program.blocks[sub], set(defined) | inner)
             for n in op.output_arg_names():
                 if not n or n == EMPTY_VAR:
                     continue
@@ -100,8 +105,18 @@ def analyze_block(program: Program, block_idx: int, feed_names: Sequence[str],
 
 def lower_ops(ctx: LowerContext, program: Program, block: Block, env: Dict) -> Dict:
     """Trace every op in ``block`` through its lowering rule, mutating env."""
+    from ..ops.control_flow_ops import CONTROL_FLOW_OPS
+
     for op in block.ops:
         if op.type in SKIP_OPS:
+            continue
+        if op.type in CONTROL_FLOW_OPS:
+            try:
+                CONTROL_FLOW_OPS[op.type](ctx, program, op, env, lower_ops)
+            except Exception as e:
+                raise type(e)(
+                    f"while lowering control-flow op {op!r} in block "
+                    f"{block.idx}: {e}") from e
             continue
         ins = {}
         for slot, names in op.inputs.items():

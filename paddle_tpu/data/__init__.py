@@ -1,0 +1,18 @@
+"""Data pipeline: reader decorators, datasets, native queue/RecordIO,
+async DataLoader (reference: python/paddle/reader/, python/paddle/dataset/,
+paddle/fluid/recordio/, operators/reader/)."""
+from . import datasets  # noqa: F401
+from .decorator import (  # noqa: F401
+    batch,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+    xmap_readers,
+)
+from .loader import DataLoader  # noqa: F401
+from .native import BlockingQueue, RecordIOScanner, RecordIOWriter  # noqa: F401
+from .recordio_utils import reader_creator, write_recordio  # noqa: F401

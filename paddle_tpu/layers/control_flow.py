@@ -1,0 +1,307 @@
+"""Control-flow layers: While, StaticRNN, Switch, ConditionalBlock.
+
+Reference: ``python/paddle/fluid/layers/control_flow.py`` (StaticRNN:429,
+While:654, ConditionalBlock:1203, Switch:1285).  Same user API; the emitted
+ops carry explicit ``carry_vars``/``memories`` attrs so the lowering can
+build ``lax.while_loop``/``scan``/``cond`` (see ops/control_flow_ops.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.program import Variable, default_main_program
+from ..layer_helper import LayerHelper
+from .nn import _unary  # reuse helper
+
+
+def _written_names(block) -> List[str]:
+    out = []
+    for op in block.ops:
+        for n in op.output_arg_names():
+            if n and n not in out:
+                out.append(n)
+    return out
+
+
+def _captured_names(block, exclude) -> List[str]:
+    defined = set(exclude)
+    captured = []
+    for op in block.ops:
+        for n in op.input_arg_names():
+            if n and n not in defined and n not in captured \
+                    and not block.has_var(n):
+                captured.append(n)
+        defined |= {n for n in op.output_arg_names() if n}
+    return captured
+
+
+class BlockGuard:
+    def __init__(self, program):
+        self.program = program
+
+    def __enter__(self):
+        self.block = self.program._create_block()
+        return self.block
+
+    def __exit__(self, exc_type, *a):
+        self.program._rollback()
+        return False
+
+
+class While:
+    """while loop (control_flow.py:654).  The sub-block must reassign the
+    condition var; vars assigned in the block that exist outside become the
+    loop carry."""
+
+    def __init__(self, cond: Variable, name: Optional[str] = None):
+        self.helper = LayerHelper("while", name=name)
+        assert cond.dtype == "bool", "While condition must be bool"
+        self.cond_var = cond
+
+    def block(self):
+        return _WhileGuard(self)
+
+
+class _WhileGuard(BlockGuard):
+    def __init__(self, while_op: While):
+        super().__init__(default_main_program())
+        self.while_op = while_op
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None:
+            self.program._rollback()
+            return False
+        sub = self.block
+        self.program._rollback()
+        parent = self.program.current_block()
+        cond_name = self.while_op.cond_var.name
+        carries = [n for n in _written_names(sub)
+                   if parent.var_or_none(n) is not None and n != cond_name]
+        parent.append_op(
+            "while",
+            {"Condition": [cond_name], "X": carries},
+            {"Out": carries},
+            {"sub_block": sub.idx, "carry_vars": [cond_name] + carries},
+        )
+        return False
+
+
+class StaticRNN:
+    """Fixed-length RNN over [B, T, ...] step inputs (control_flow.py:429;
+    lowers to lax.scan → trains via reverse-scan vjp)."""
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name: Optional[str] = None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.status = self.BEFORE_RNN_BLOCK
+        self._step_inputs = []      # (outer_name, inner_var)
+        self._memories = []         # [inner_mem_name, init_name, updated_name]
+        self._outputs = []          # (inner_name, outer_var)
+        self._sub_block = None
+        self.seq_len = None
+
+    def step(self):
+        return _RnnGuard(self)
+
+    def _assert_in_rnn_block(self):
+        assert self.status == self.IN_RNN_BLOCK, "must be called in rnn.step() block"
+
+    def step_input(self, x: Variable) -> Variable:
+        self._assert_in_rnn_block()
+        if self.seq_len is None:
+            self.seq_len = x.shape[1]
+        inner = self._sub_block.create_var(
+            name=x.name + "@STEP", dtype=x.dtype,
+            shape=(x.shape[0],) + tuple(x.shape[2:]))
+        self._step_inputs.append((x.name, inner))
+        return inner
+
+    def memory(self, init: Optional[Variable] = None, shape=None,
+               batch_ref: Optional[Variable] = None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1) -> Variable:
+        self._assert_in_rnn_block()
+        if init is None:
+            assert shape is not None and batch_ref is not None, \
+                "memory needs init or (shape, batch_ref)"
+            parent = self._sub_block.parent_block
+            # batch_ref may be an inner step var — the init op lives in the
+            # parent block, so reference the outer sequence input instead
+            ref_name = batch_ref.name
+            for outer, inner in self._step_inputs:
+                if inner.name == ref_name:
+                    ref_name = outer
+                    break
+            init = parent.create_var(
+                name=self.helper.name + f".mem_init_{len(self._memories)}",
+                dtype=batch_ref.dtype,
+                shape=(batch_ref.shape[0],) + tuple(shape))
+            # materialize init before the rnn op (in the parent block)
+            parent.append_op(
+                "fill_constant_batch_size_like",
+                {"Input": [ref_name]}, {"Out": [init.name]},
+                {"shape": [-1] + list(shape), "dtype": init.dtype,
+                 "value": init_value, "input_dim_idx": 0, "output_dim_idx": 0})
+        mem = self._sub_block.create_var(
+            name=self.helper.name + f".mem_{len(self._memories)}",
+            dtype=init.dtype, shape=init.shape)
+        self._memories.append([mem.name, init.name, None])
+        return mem
+
+    def update_memory(self, mem: Variable, var: Variable) -> None:
+        self._assert_in_rnn_block()
+        for rec in self._memories:
+            if rec[0] == mem.name:
+                rec[2] = var.name
+                return
+        raise ValueError(f"{mem.name} is not a memory of this RNN")
+
+    def step_output(self, o: Variable) -> None:
+        self._assert_in_rnn_block()
+        outer = self._sub_block.parent_block.create_var(
+            name=o.name + "@SEQ", dtype=o.dtype,
+            shape=(o.shape[0], self.seq_len) + tuple(o.shape[1:]))
+        self._outputs.append((o.name, outer))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        assert self.status == self.AFTER_RNN_BLOCK, "call rnn() after the step block"
+        outs = [outer for _, outer in self._outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def _complete(self):
+        sub = self._sub_block
+        parent = sub.parent_block
+        assert all(rec[2] is not None for rec in self._memories), \
+            "every memory needs update_memory"
+        inner_defined = [inner.name for _, inner in self._step_inputs] + \
+            [rec[0] for rec in self._memories]
+        captured = _captured_names(sub, inner_defined)
+        parent.append_op(
+            "static_rnn",
+            {"X": [outer for outer, _ in self._step_inputs],
+             "Init": [rec[1] for rec in self._memories],
+             "Captured": captured},
+            {"Out": [outer.name for _, outer in self._outputs]},
+            {"sub_block": sub.idx,
+             "step_inputs": [outer for outer, _ in self._step_inputs],
+             "step_input_vars": [inner.name for _, inner in self._step_inputs],
+             "memories": self._memories,
+             "step_outputs": [[inner, outer.name] for inner, outer in self._outputs]},
+        )
+
+
+class _RnnGuard(BlockGuard):
+    def __init__(self, rnn: StaticRNN):
+        super().__init__(default_main_program())
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.block = self.program._create_block()
+        self.rnn._sub_block = self.block
+        self.rnn.status = StaticRNN.IN_RNN_BLOCK
+        return self.block
+
+    def __exit__(self, exc_type, *a):
+        self.program._rollback()
+        if exc_type is None:
+            self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+            self.rnn._complete()
+        return False
+
+
+class ConditionalBlock:
+    """Run a block iff condition (control_flow.py:1203)."""
+
+    def __init__(self, inputs, is_scalar_condition=True, name=None):
+        self.helper = LayerHelper("conditional_block", name=name)
+        self.cond = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+
+    def block(self):
+        return _CondGuard(self)
+
+
+class _CondGuard(BlockGuard):
+    def __init__(self, cb: ConditionalBlock):
+        super().__init__(default_main_program())
+        self.cb = cb
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None:
+            self.program._rollback()
+            return False
+        sub = self.block
+        self.program._rollback()
+        parent = self.program.current_block()
+        carries = [n for n in _written_names(sub)
+                   if parent.var_or_none(n) is not None]
+        parent.append_op(
+            "conditional_block",
+            {"Condition": [self.cb.cond.name], "X": carries},
+            {"Out": carries},
+            {"sub_block": sub.idx, "carry_vars": carries},
+        )
+        return False
+
+
+class Switch:
+    """case/default sugar over ConditionalBlock (control_flow.py:1285)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.pre_not_conditions: List[Variable] = []
+
+    def case(self, condition):
+        from . import nn
+        if self.pre_not_conditions:
+            not_prev = _unary("logical_not", self.pre_not_conditions[-1],
+                              out_dtype="bool")
+            cond = self._and(not_prev, condition)
+        else:
+            cond = condition
+        self.pre_not_conditions.append(
+            self._or(self.pre_not_conditions[-1], condition)
+            if self.pre_not_conditions else condition)
+        return ConditionalBlock([cond]).block()
+
+    def default(self):
+        assert self.pre_not_conditions, "default needs a prior case"
+        not_all = _unary("logical_not", self.pre_not_conditions[-1],
+                         out_dtype="bool")
+        return ConditionalBlock([not_all]).block()
+
+    def _and(self, a, b):
+        helper = LayerHelper("logical_and")
+        out = helper.create_variable_for_type_inference("bool", shape=a.shape)
+        helper.append_op("logical_and", {"X": [a], "Y": [b]}, {"Out": [out]})
+        return out
+
+    def _or(self, a, b):
+        helper = LayerHelper("logical_or")
+        out = helper.create_variable_for_type_inference("bool", shape=a.shape)
+        helper.append_op("logical_or", {"X": [a], "Y": [b]}, {"Out": [out]})
+        return out
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def less_than(x, y, cond=None):
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool", shape=x.shape)
+    helper.append_op("less_than", {"X": [x], "Y": [y]}, {"Out": [cond]})
+    return cond
+
+
+def array_length(x):  # parity stub for TensorArray API
+    raise NotImplementedError(
+        "TensorArray ops land with the decoder stack; use StaticRNN/scan")

@@ -1,0 +1,165 @@
+"""Control-flow lowering: sub-block ops → functional XLA control flow.
+
+Reference: ``paddle/fluid/operators/while_op.cc:36`` (step-scope executor
+loop), ``recurrent_op.cc:222`` (StaticRNN with StepScopes),
+``conditional_block_op.cc``.  The reference runs an Executor over a
+sub-block per iteration, mutating step scopes; under XLA this becomes
+``lax.while_loop`` / ``lax.scan`` / ``lax.cond`` with the carried state
+explicit — listed in the op's ``carry_vars`` attr (computed by the layer
+from the sub-block's writes).  Grad-of-scan is the reverse scan jax derives
+(the functional equivalent of while_grad's reversed step-scope walk,
+while_op.cc:101).
+
+These handlers get *name-level* env access (unlike regular lowering rules)
+because carries are program variables; ``core/lowering.py`` dispatches
+``CONTROL_FLOW_OPS`` here.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.program import EMPTY_VAR
+from ..core.registry import register
+
+
+def _never(ctx, ins, attrs):  # pragma: no cover
+    raise RuntimeError("control-flow ops lower via CONTROL_FLOW_OPS dispatch")
+
+
+# registry entries let append_backward build grad op descs generically
+register("static_rnn", no_grad_slots=())(_never)
+
+
+def lower_while(ctx, program, op, env: Dict, lower_block_ops) -> None:
+    """while op: attrs sub_block (idx), carry_vars (names, first is the
+    condition var).  Repeats the sub-block until the condition var, which
+    the block must reassign, is false."""
+    sub = program.blocks[op.attr("sub_block")]
+    cond_name = op.input("Condition")[0]
+    carry_names = [n for n in op.attr("carry_vars") if n != cond_name]
+
+    def cond_fn(carry):
+        return carry[0].reshape(()).astype(jnp.bool_)
+
+    def body_fn(carry):
+        benv = dict(env)
+        benv[cond_name] = carry[0]
+        benv.update(zip(carry_names, carry[1:]))
+        lower_block_ops(ctx, program, sub, benv)
+        return (benv[cond_name],) + tuple(benv[n] for n in carry_names)
+
+    init = (env[cond_name],) + tuple(env[n] for n in carry_names)
+    res = lax.while_loop(cond_fn, body_fn, init)
+    env[cond_name] = res[0]
+    env.update(zip(carry_names, res[1:]))
+
+
+def lower_conditional_block(ctx, program, op, env: Dict, lower_block_ops) -> None:
+    """conditional_block: run sub-block iff the scalar condition is true;
+    carried vars keep their prior values otherwise (both branches traced —
+    lax.cond semantics)."""
+    sub = program.blocks[op.attr("sub_block")]
+    cond = env[op.input("Condition")[0]].reshape(()).astype(jnp.bool_)
+    carry_names = list(op.attr("carry_vars"))
+    # vars created inside the block need an initial value for the false
+    # branch: zeros shaped like the true branch's result
+    def true_branch(carry):
+        benv = dict(env)
+        benv.update(zip(carry_names, carry))
+        lower_block_ops(ctx, program, sub, benv)
+        return tuple(benv[n] for n in carry_names)
+
+    def false_branch(carry):
+        return tuple(carry)
+
+    init = []
+    for n in carry_names:
+        if n in env:
+            init.append(env[n])
+        else:
+            raise RuntimeError(
+                f"conditional_block carry {n!r} has no prior value; "
+                f"initialize it before the block (layers.fill_constant)")
+    res = lax.cond(cond, true_branch, false_branch, tuple(init))
+    env.update(zip(carry_names, res))
+
+
+def lower_static_rnn(ctx, program, op, env: Dict, lower_block_ops) -> None:
+    """static_rnn op (recurrent_op.cc:222 redesigned as lax.scan).
+
+    attrs: sub_block, step_inputs (outer [B,T,...] names), step_input_vars
+    (inner per-step names), memories [(inner_mem_name, init_name,
+    updated_inner_name)], step_outputs [(inner_name, outer_name)].
+    """
+    sub = program.blocks[op.attr("sub_block")]
+    step_in_outer = op.attr("step_inputs")
+    step_in_inner = op.attr("step_input_vars")
+    memories = op.attr("memories")  # list of [mem, init, updated]
+    step_outputs = op.attr("step_outputs")  # list of [inner, outer]
+
+    xs = tuple(jnp.swapaxes(env[n], 0, 1) for n in step_in_outer)  # [T,B,...]
+    init = tuple(env[init_n] for _, init_n, _ in memories)
+
+    def body(carry, x_t):
+        benv = dict(env)
+        for (mem, _, _), c in zip(memories, carry):
+            benv[mem] = c
+        for name, v in zip(step_in_inner, x_t):
+            benv[name] = v
+        lower_block_ops(ctx, program, sub, benv)
+        new_carry = tuple(benv[upd] for _, _, upd in memories)
+        outs = tuple(benv[inner] for inner, _ in step_outputs)
+        return new_carry, outs
+
+    last_carry, stacked = lax.scan(body, init, xs)
+    for (inner, outer), seq in zip(step_outputs, stacked):
+        env[outer] = jnp.swapaxes(seq, 0, 1)  # back to [B,T,...]
+    for (mem, _, _), c in zip(memories, last_carry):
+        env[mem + "@LAST"] = c
+
+
+CONTROL_FLOW_OPS = {
+    "while": lower_while,
+    "conditional_block": lower_conditional_block,
+    "static_rnn": lower_static_rnn,
+}
+
+
+def lower_static_rnn_grad(ctx, program, op, env: Dict, lower_block_ops) -> None:
+    """Grad of static_rnn: jax.vjp over the scan lowering (reverse scan —
+    the functional form of recurrent_op.cc's backward step-scope walk).
+    Differentiates wrt outer step inputs, memory inits, and captured vars."""
+    diff_slots = ("X", "Init", "Captured")
+    diff_names = []
+    for slot in diff_slots:
+        for n in op.input(slot):
+            if n and n in env and jnp.issubdtype(jnp.asarray(env[n]).dtype, jnp.inexact):
+                diff_names.append(n)
+    outer_outs = [outer for _, outer in op.attr("step_outputs")]
+
+    def f(vals: Dict):
+        benv = dict(env)
+        benv.update(vals)
+        lower_static_rnn(ctx, program, op, benv, lower_block_ops)
+        return {n: benv[n] for n in outer_outs}
+
+    primals, vjp_fn = jax.vjp(f, {n: env[n] for n in diff_names})
+    cot = {}
+    grad_names = dict(zip(op.input("Out"), op.input("Out@GRAD")))
+    for n in outer_outs:
+        gname = grad_names.get(n)
+        g = env.get(gname) if gname and gname != EMPTY_VAR else None
+        cot[n] = g if g is not None else jnp.zeros_like(primals[n])
+    (grads,) = vjp_fn(cot)
+    for slot in diff_slots:
+        out_names = op.output(slot + "@GRAD")
+        for src, dst in zip(op.input(slot), out_names):
+            if dst and dst != EMPTY_VAR and src in grads:
+                env[dst] = grads[src]
+
+
+CONTROL_FLOW_OPS["static_rnn_grad"] = lower_static_rnn_grad

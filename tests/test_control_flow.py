@@ -1,0 +1,123 @@
+"""Control-flow tests (reference tests: test_while_op.py,
+test_recurrent_op.py, test_conditional_block.py, test_switch.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+L = fluid.layers
+
+
+def test_while_loop_sums():
+    """while: accumulate x into s ten times."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [4])
+        i = L.fill_constant((), "float32", 0.0)
+        n = L.fill_constant((), "float32", 10.0)
+        s = L.fill_constant((), "float32", 0.0)
+        cond = fluid.layers.control_flow.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            s2 = L.elementwise_add(s, L.reduce_sum(x))
+            L.assign(s2, s)
+            L.increment(i, 1.0)
+            fluid.layers.control_flow.less_than(i, n, cond=cond)
+    exe = Executor()
+    with scope_guard(Scope()):
+        xb = np.ones((2, 4), "float32")
+        (got,) = exe.run(prog, feed={"x": xb}, fetch_list=[s])
+    assert float(got) == 80.0  # 10 iterations * sum(ones(2,4))
+
+
+def test_conditional_block():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [1])
+        flag = L.data("flag", [], append_batch_size=False, dtype="bool")
+        out = L.fill_constant((), "float32", -1.0)
+        cb = fluid.layers.ConditionalBlock([flag])
+        with cb.block():
+            L.assign(L.reduce_sum(x), out)
+    exe = Executor()
+    with scope_guard(Scope()):
+        xb = np.full((3, 1), 2.0, "float32")
+        (a,) = exe.run(prog, feed={"x": xb, "flag": np.array(True)},
+                       fetch_list=[out])
+        (b,) = exe.run(prog, feed={"x": xb, "flag": np.array(False)},
+                       fetch_list=[out])
+    assert float(a) == 6.0 and float(b) == -1.0
+
+
+def _rnn_program(train=True):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [8, 4], append_batch_size=True)  # [B,T=8,D=4]
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(shape=[16], batch_ref=x_t, init_value=0.0)
+            h = fluid.layers.fc([x_t, h_prev], 16, act="tanh",
+                                param_attr=[fluid.ParamAttr(name="rnn_wx"),
+                                            fluid.ParamAttr(name="rnn_wh")],
+                                bias_attr=fluid.ParamAttr(name="rnn_b"))
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        seq = rnn()  # [B,T,16]
+        pooled = L.reduce_mean(seq, dim=1)
+        pred = fluid.layers.fc(pooled, 1, bias_attr=False)
+        loss = L.mean(L.square(pred))
+        if train:
+            fluid.optimizer.SGD(0.05).minimize(loss)
+    return prog, startup, loss, seq
+
+
+def test_static_rnn_forward_shapes():
+    prog, startup, loss, seq = _rnn_program(train=False)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xb = np.random.RandomState(0).randn(3, 8, 4).astype("float32")
+        (s,) = exe.run(prog, feed={"x": xb}, fetch_list=[seq])
+    assert s.shape == (3, 8, 16)
+    assert not np.allclose(s[:, 0], s[:, -1])  # state evolves
+
+
+def test_static_rnn_trains():
+    """Reverse-scan gradients flow into rnn weights (captured vars)."""
+    prog, startup, loss, _ = _rnn_program(train=True)
+    exe = Executor()
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var("rnn_wx")).copy()
+        xb = np.random.RandomState(0).randn(16, 8, 4).astype("float32")
+        losses = [float(exe.run(prog, feed={"x": xb}, fetch_list=[loss])[0])
+                  for _ in range(25)]
+        w1 = np.asarray(scope.find_var("rnn_wx"))
+    assert not np.allclose(w0, w1), "rnn weights never updated"
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+
+def test_while_grad_raises_clearly():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [4])
+        i = L.fill_constant((), "float32", 0.0)
+        n = L.fill_constant((), "float32", 3.0)
+        s = fluid.layers.fc(x, 1)
+        cond = fluid.layers.control_flow.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            L.assign(L.scale(s, 2.0), s)
+            L.increment(i, 1.0)
+            fluid.layers.control_flow.less_than(i, n, cond=cond)
+        loss = L.mean(s)
+        try:
+            fluid.optimizer.SGD(0.1).minimize(loss)
+            raised = False
+        except NotImplementedError as e:
+            raised = "StaticRNN" in str(e)
+    assert raised

@@ -1,0 +1,187 @@
+"""Data pipeline tests: native queue, RecordIO round-trip + corruption
+detection, reader decorators, DataLoader (reference: recordio tests,
+reader decorator tests)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import data as D
+
+
+def test_blocking_queue_roundtrip():
+    q = D.BlockingQueue(4)
+    items = [b"a" * 10, b"b" * 1000, b""]
+    for it in items:
+        assert q.push(it)
+    got = [q.pop() for _ in items]
+    assert got == items
+    q.close()
+    assert q.pop() is None
+
+
+def test_blocking_queue_blocks_and_threads():
+    q = D.BlockingQueue(2)
+    out = []
+
+    def consumer():
+        while True:
+            item = q.pop()
+            if item is None:
+                return
+            out.append(item)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(100):
+        q.push(str(i).encode())
+    q.close()
+    t.join(5)
+    assert [int(x) for x in out] == list(range(100))
+
+
+@pytest.mark.parametrize("compressor", [0, 1])
+def test_recordio_roundtrip(tmp_path, compressor):
+    path = str(tmp_path / "data.recordio")
+    records = [os.urandom(np.random.randint(1, 2000)) for _ in range(250)]
+    with D.RecordIOWriter(path, compressor, max_chunk_records=64) as w:
+        for r in records:
+            w.write(r)
+    with D.RecordIOScanner(path) as s:
+        got = list(s)
+    assert got == records
+
+
+def test_recordio_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "x.recordio")
+    with D.RecordIOWriter(path, 0, max_chunk_records=10) as w:
+        for i in range(10):
+            w.write(b"payload-%d" % i)
+    blob = bytearray(open(path, "rb").read())
+    blob[-3] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(IOError, match="CRC"):
+        list(D.RecordIOScanner(path))
+
+
+def test_reader_decorators_compose():
+    def r():
+        return iter(range(10))
+
+    doubled = D.map_readers(lambda x: x * 2, lambda: r())
+    assert list(doubled()) == [x * 2 for x in range(10)]
+    assert sorted(D.shuffle(lambda: r(), 5)()) == list(range(10))
+    assert list(D.chain(lambda: r(), lambda: r())()) == list(range(10)) * 2
+    assert list(D.firstn(lambda: r(), 3)()) == [0, 1, 2]
+    assert list(D.buffered(lambda: r(), 4)()) == list(range(10))
+    assert sorted(D.xmap_readers(lambda x: x + 1, lambda: r(), 3, 4)()) == \
+        list(range(1, 11))
+    assert list(D.xmap_readers(lambda x: x + 1, lambda: r(), 3, 4, order=True)()) == \
+        list(range(1, 11))
+    batches = list(D.batch(lambda: r(), 4)())
+    assert batches == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    assert list(D.batch(lambda: r(), 4, drop_last=True)()) == \
+        [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_recordio_reader_creator(tmp_path):
+    path = str(tmp_path / "samples.recordio")
+    samples = [(np.arange(4, dtype="float32"), i) for i in range(20)]
+    n = D.write_recordio(lambda: iter(samples), path)
+    assert n == 20
+    got = list(D.reader_creator(path)())
+    assert len(got) == 20
+    np.testing.assert_array_equal(got[3][0], samples[3][0])
+    assert got[7][1] == 7
+
+
+def test_dataset_readers_shapes():
+    x, y = next(D.datasets.mnist.train()())
+    assert x.shape == (784,) and 0 <= y < 10
+    x, y = next(D.datasets.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    words, label = next(D.datasets.imdb.train()())
+    assert words.ndim == 1 and label in (0, 1)
+    src, trg_in, trg_next = next(D.datasets.wmt16.train()())
+    assert trg_in[0] == D.datasets.wmt16.BOS
+    assert trg_next[-1] == D.datasets.wmt16.EOS
+    assert len(trg_in) == len(trg_next)
+
+
+def test_dataloader_end_to_end():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [13])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+        loader = D.DataLoader(
+            ["x", "y"],
+            D.batch(D.datasets.uci_housing.train(), 32),
+            capacity=4, program=prog)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = []
+        for epoch in range(15):
+            for feed in loader:
+                (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+                losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_pipeline_error_propagates():
+    from paddle_tpu.data import buffered, xmap_readers
+
+    def bad_reader():
+        yield 1
+        yield 2
+        raise ValueError("boom in reader")
+
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="boom in reader"):
+        list(buffered(lambda: bad_reader(), 2)())
+
+    def bad_mapper(x):
+        raise ValueError("boom in mapper")
+
+    with _pytest.raises(RuntimeError, match="boom in mapper"):
+        list(xmap_readers(bad_mapper, lambda: iter(range(5)), 2, 2)())
+
+
+def test_compose_alignment_raises():
+    from paddle_tpu.data.decorator import ComposeNotAligned, compose
+    import pytest as _pytest
+    r10 = lambda: iter(range(10))
+    r12 = lambda: iter(range(12))
+    with _pytest.raises(ComposeNotAligned):
+        list(compose(r10, r12)())
+    assert len(list(compose(r10, r12, check_alignment=False)())) == 12
+
+
+def test_dataloader_early_break_no_hang():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu import data as D
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        fluid.layers.data("x", [13])
+        fluid.layers.data("y", [1])
+        loader = D.DataLoader(["x", "y"],
+                              D.batch(D.datasets.uci_housing.train(), 8),
+                              capacity=2, program=prog, device_prefetch=False)
+    for i, feed in enumerate(loader):
+        if i == 1:
+            break  # must not leak a blocked producer
+    # iterating again works (fresh queue per __iter__)
+    n = sum(1 for _ in loader)
+    assert n > 10

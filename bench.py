@@ -20,7 +20,7 @@ import numpy as np
 
 A100_TOKENS_PER_SEC = 50_000.0
 
-BATCH = 64
+BATCH = 128
 SEQ = 64
 VOCAB = 32000
 WARMUP = 3
@@ -42,7 +42,8 @@ def main():
     with program_guard(prog, startup), unique_name.guard():
         feed_names, loss, _ = transformer.build(
             src_vocab=VOCAB, tgt_vocab=VOCAB, max_len=SEQ,
-            dropout=0.1, with_optimizer=True, dtype=DTYPE)
+            dropout=0.1, with_optimizer=True, dtype=DTYPE,
+            attention_impl="auto")
 
     scope = Scope()
     exe = Executor()

@@ -1,0 +1,257 @@
+"""Fused / flash / ring attention — the framework's hot-op kernel story.
+
+Reference precedent: the CPU JIT kernel library
+(``paddle/fluid/operators/math/jit_kernel*`` — hand-tuned kernels behind a
+dispatch layer) and the cuDNN library_type kernels.  Here the hot op is
+attention; three implementations sit behind one function:
+
+- ``xla``:    plain jnp einsum/softmax chain (XLA fuses; always available)
+- ``pallas``: tiled online-softmax flash-attention kernel (MXU-sized tiles,
+              VMEM accumulators; interpret mode off-TPU)
+- ``ring``:   sequence-parallel attention over a mesh axis — K/V shards
+              rotate around the ring via ``lax.ppermute`` with online
+              softmax merging, so attention over sequence length S uses
+              O(S/sp) memory per chip.  This is the long-context scaling
+              mechanism (SURVEY.md §5: absent in the 2018 reference,
+              required here as first-class).
+
+Gradients: ``jax.custom_vjp`` — forward may run the Pallas kernel; backward
+recomputes with the XLA math (flash-style recompute; a Pallas backward
+kernel is a later optimization).  Ring attention differentiates through
+shard_map/ppermute natively.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# plain XLA implementation (also the custom_vjp backward math)
+# ---------------------------------------------------------------------------
+
+def mha_xla(q, k, v, kv_mask=None, causal=False, sm_scale=None,
+            q_offset=0, kv_offset=0):
+    """q,k,v: [B,H,Tq|Tk,D]; kv_mask: [B,Tk] 1/0; returns [B,H,Tq,D].
+
+    q_offset/kv_offset give global positions for causal masking when the
+    sequence is sharded (ring attention)."""
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, NEG_INF)
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None] + q_offset
+        ki = jnp.arange(k.shape[2])[None, :] + kv_offset
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *,
+                      sm_scale: float, causal: bool,
+                      block_q: int, block_k: int, num_kb: int):
+    """Grid (B*H, nq, nk); K/V stream through VMEM one block_k tile at a
+    time (nk is the sequential minor grid axis on TPU, so the online-softmax
+    state lives in VMEM scratch across k iterations — O(block) memory at any
+    sequence length)."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    k_blk = k_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+    mask = mask_ref[0, :]
+    s = jnp.where(mask[None, :] > 0, s, NEG_INF)
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m, l, acc = m_scr[:], l_scr[:], acc_scr[:]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    m_scr[:] = m_new
+    l_scr[:] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        o_ref[:] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+try:  # pallas import kept lazy-safe for exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _pad_to(x, multiple, axis):
+    rem = x.shape[axis] % multiple
+    if rem == 0:
+        return x, 0
+    pad = multiple - rem
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), pad
+
+
+def mha_pallas(q, k, v, kv_mask=None, causal=False, sm_scale=None,
+               block_q=128, block_k=128, interpret=None):
+    """Flash-attention forward via pallas_call; grid (B*H, Tq/block_q)."""
+    if not _HAVE_PALLAS:
+        return mha_xla(q, k, v, kv_mask, causal, sm_scale)
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Tk), jnp.float32)
+
+    q4, pad_q = _pad_to(q, block_q, 2)
+    k4, pad_k = _pad_to(k, block_k, 2)
+    v4, _ = _pad_to(v, block_k, 2)
+    mask2, _ = _pad_to(kv_mask.astype(jnp.float32), block_k, 1)
+    Tq_p, Tk_p = q4.shape[2], k4.shape[2]
+    num_kb = Tk_p // block_k
+
+    qf = q4.reshape(B * H, Tq_p, D)
+    kf = k4.reshape(B * H, Tk_p, D)
+    vf = v4.reshape(B * H, Tk_p, D)
+    maskf = jnp.repeat(mask2[:, None, :], H, axis=1).reshape(B * H, 1, Tk_p)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, sm_scale=sm_scale,
+        causal=causal, block_q=block_q, num_kb=num_kb)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+        grid=(B * H, Tq_p // block_q, num_kb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, 1, block_k), lambda b, i, j: (b, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, maskf)
+    out = out.reshape(B, H, Tq_p, D)
+    return out[:, :, :Tq, :]
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper: pallas forward, XLA-recompute backward
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention(q, k, v, kv_mask, causal=False, sm_scale=None):
+    return mha_pallas(q, k, v, kv_mask, causal, sm_scale)
+
+
+def _fa_fwd(q, k, v, kv_mask, causal, sm_scale):
+    out = mha_pallas(q, k, v, kv_mask, causal, sm_scale)
+    return out, (q, k, v, kv_mask)
+
+
+def _fa_bwd(causal, sm_scale, res, g):
+    q, k, v, kv_mask = res
+    # recompute with the XLA math and differentiate it (flash recompute)
+    def f(q, k, v):
+        return mha_xla(q, k, v, kv_mask, causal, sm_scale)
+    _, vjp_fn = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp_fn(g)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention: sequence-parallel over a mesh axis
+# ---------------------------------------------------------------------------
+
+def ring_attention(q, k, v, kv_mask, axis_name: str, causal=False,
+                   sm_scale=None):
+    """Blockwise ring attention (to be called under shard_map with the
+    sequence dimension sharded over ``axis_name``).
+
+    Each device holds local q/k/v shards [B,H,S/sp,D].  K/V rotate around
+    the ring; partial attention outputs merge with online softmax, so no
+    device ever materializes full-sequence scores — O(S/sp) memory.
+    """
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    S_local = q.shape[2]
+
+    def partial_attn(k_blk, v_blk, m_blk, kv_idx):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk).astype(jnp.float32) * sm_scale
+        s = jnp.where(m_blk[:, None, None, :] > 0, s, NEG_INF)
+        if causal:
+            qi = jnp.arange(S_local)[:, None] + idx * S_local
+            ki = jnp.arange(S_local)[None, :] + kv_idx * S_local
+            s = jnp.where(qi >= ki, s, NEG_INF)
+        m_new = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m_new)
+        l_new = jnp.sum(p, axis=-1, keepdims=True)
+        o_new = jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        return m_new, l_new, o_new
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, _):
+        m, l, o, k_cur, v_cur, mask_cur, kv_idx = carry
+        m_p, l_p, o_p = partial_attn(k_cur, v_cur, mask_cur, kv_idx)
+        m_new = jnp.maximum(m, m_p)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_p - m_new)
+        l_new = l * alpha + l_p * beta
+        o_new = o * alpha + o_p * beta
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = lax.ppermute(mask_cur, axis_name, perm)
+        kv_nxt = lax.ppermute(kv_idx, axis_name, perm)
+        return (m_new, l_new, o_new, k_nxt, v_nxt, mask_nxt, kv_nxt), None
+
+    B, H, S, D = q.shape
+    # derive inits from q so shard_map's varying-axis inference matches the
+    # ppermute-produced carries
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full_like(qf[..., :1], NEG_INF)
+    l0 = jnp.zeros_like(qf[..., :1])
+    o0 = jnp.zeros_like(qf)
+    carry = (m0, l0, o0, k, v, kv_mask, idx)
+    (m, l, o, *_), _ = lax.scan(step, carry, None, length=sp)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)

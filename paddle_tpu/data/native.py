@@ -21,9 +21,19 @@ def load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO):
-        subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)], check=True,
-                       capture_output=True)
+    deps = [os.path.abspath(os.path.join(_NATIVE_DIR, f))
+            for f in ("paddle_tpu_native.cc", "Makefile")]
+    stale = (not os.path.exists(_SO)
+             or any(os.path.exists(d)
+                    and os.path.getmtime(d) > os.path.getmtime(_SO)
+                    for d in deps))
+    if stale:
+        try:
+            subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR), "-B"],
+                           check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native lib build failed:\n{e.stdout}\n{e.stderr}") from e
     lib = ctypes.CDLL(_SO)
     # queue
     lib.ptq_queue_create.restype = ctypes.c_void_p

@@ -24,7 +24,11 @@ def _decay_step_counter(begin=0):
         return gb.vars[LR_COUNTER]
     counter = helper.create_global_variable(
         shape=(), dtype="float32", persistable=True, name=LR_COUNTER)
-    helper.set_variable_initializer(counter, ConstantInitializer(float(begin)))
+    # the prepended increment runs before any read, so start at begin-1 to
+    # make schedules observe `begin` on the first step (reference
+    # layers/nn.py autoincreased_step_counter semantics)
+    helper.set_variable_initializer(
+        counter, ConstantInitializer(float(begin) - 1.0))
     with program.op_role_guard(OpRole.LRSched):
         gb.prepend_op("increment", {"X": [LR_COUNTER]}, {"Out": [LR_COUNTER]},
                       {"step": 1.0, OP_ROLE_ATTR: OpRole.LRSched})
@@ -44,7 +48,6 @@ def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     div = _sched_op(helper, "scale", {"X": [step]}, {"scale": 1.0 / decay_steps})
     if staircase:
         div = _sched_op(helper, "floor", {"X": [div]})
-    pw = _sched_op(helper, "pow", {"X": [div]}, {"factor": 1.0})
     # decay_rate ** div  ==  exp(div * log(decay_rate))
     scaled = _sched_op(helper, "scale", {"X": [div]}, {"scale": math.log(decay_rate)})
     factor = _sched_op(helper, "exp", {"X": [scaled]})

@@ -64,18 +64,32 @@ register("depthwise_conv2d")(
 
 @register("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
+    # conv2d_transpose is defined as the input-gradient of a forward conv2d
+    # (reference conv_transpose_op semantics: out = (in-1)*s - 2p + d*(k-1)+1,
+    # weight layout [C_in, C_out/g, kh, kw] ≡ OIHW of the y→x conv).
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
-    out = lax.conv_transpose(
-        x, w,
-        strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dil,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
-    )
+    groups = attrs.get("groups", 1)
+    n, _, h, wd = x.shape
+    _, cout_pg, kh, kw = w.shape
+    cout = cout_pg * groups
+    hout = (h - 1) * strides[0] - 2 * pads[0] + dil[0] * (kh - 1) + 1
+    wout = (wd - 1) * strides[1] - 2 * pads[1] + dil[1] * (kw - 1) + 1
+
+    def fwd(y):
+        return lax.conv_general_dilated(
+            y, w,
+            window_strides=strides,
+            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            rhs_dilation=dil,
+            feature_group_count=groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+
+    _, vjp_fn = jax.vjp(fwd, jnp.zeros((n, cout, hout, wout), x.dtype))
+    (out,) = vjp_fn(x)
     return {"Output": [out]}
 
 

@@ -90,6 +90,46 @@ def test_conv2d_grad():
         wrt=["x"], rtol=5e-3, atol=5e-4)
 
 
+def test_conv2d_transpose_parity_and_grad():
+    # value parity vs torch.conv_transpose2d (same [C_in, C_out, kh, kw]
+    # weight layout and output-shrinking padding semantics as the reference)
+    torch = pytest.importorskip("torch")
+    F = torch.nn.functional
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.initializer import NumpyArrayInitializer
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    wref = rng.randn(3, 4, 3, 3).astype("float32")
+    for stride, pad, dil in [(2, 1, 1), (1, 0, 1), (2, 0, 2)]:
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup), unique_name.guard():
+            xv = fluid.layers.data("x", list(x.shape[1:]))
+            out = fluid.layers.conv2d_transpose(
+                xv, 4, 3, stride=stride, padding=pad, dilation=dil,
+                bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    name="w", initializer=NumpyArrayInitializer(wref)))
+        exe = Executor()
+        with scope_guard(Scope()):
+            exe.run(startup)
+            (got,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        want = F.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(wref),
+            stride=stride, padding=pad, dilation=dil).numpy()
+        assert got.shape == want.shape, (stride, pad, dil, got.shape, want.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    check_grad(
+        lambda v: L.conv2d_transpose(
+            v["x"], 4, 3, stride=2, padding=1, bias_attr=False,
+            param_attr=fluid.ParamAttr(name="dconvw")),
+        {"x": f64(2, 3, 5, 5)},
+        rtol=5e-3, atol=5e-4)
+
+
 def test_pool2d_avg_grad():
     check_grad(lambda v: L.pool2d(v["x"], 2, "avg", 2), {"x": f64(2, 3, 6, 6)})
 

@@ -20,7 +20,7 @@ class GradientClipByValue(BaseGradientClipAttr):
     def _create_operators(self, param, grad):
         block = grad.block
         out = block.create_var(name=grad.name + "@CLIP", shape=grad.shape,
-                               dtype=grad.dtype)
+                               dtype=grad.dtype, type=grad.type)
         block.append_op("clip", {"X": [grad.name]}, {"Out": [out.name]},
                         {"min": self.min, "max": self.max,
                          OP_ROLE_ATTR: OpRole.Backward})
@@ -34,7 +34,7 @@ class GradientClipByNorm(BaseGradientClipAttr):
     def _create_operators(self, param, grad):
         block = grad.block
         out = block.create_var(name=grad.name + "@CLIP", shape=grad.shape,
-                               dtype=grad.dtype)
+                               dtype=grad.dtype, type=grad.type)
         block.append_op("clip_by_norm", {"X": [grad.name]}, {"Out": [out.name]},
                         {"max_norm": self.clip_norm,
                          OP_ROLE_ATTR: OpRole.Backward})
@@ -73,7 +73,8 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
                         {"clip_norm": self.clip_norm, OP_ROLE_ATTR: OpRole.Backward})
         out = []
         for p, g in params_grads:
-            ng = block.create_var(name=g.name + "@CLIP", shape=g.shape, dtype=g.dtype)
+            ng = block.create_var(name=g.name + "@CLIP", shape=g.shape,
+                                  dtype=g.dtype, type=g.type)
             block.append_op("elementwise_mul", {"X": [g.name], "Y": [factor.name]},
                             {"Out": [ng.name]}, {OP_ROLE_ATTR: OpRole.Backward})
             out.append((p, ng))

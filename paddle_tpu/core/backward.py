@@ -28,7 +28,7 @@ from .program import (
     grad_var_name,
 )
 from .registry import GRAD_OP_SUFFIX
-from .types import is_float
+from .types import VarType, is_float
 
 
 def _find_relevant_ops(block: Block, target: str) -> Set[int]:
@@ -206,6 +206,12 @@ def append_backward(
                 OP_ROLE_ATTR: OpRole.Backward,
             },
         )
+        # sparse lookup gradients are SelectedRows (selected_rows.h:32);
+        # mark the grad var so regularizers/transpilers can branch on it
+        if op.type == "lookup_table" and op.attrs.get("is_sparse"):
+            for gn in g_outputs.get("W@GRAD", ()):
+                if gn != EMPTY_VAR:
+                    block.var(gn).type = VarType.SELECTED_ROWS
 
     # collect (param, grad) pairs
     params = (

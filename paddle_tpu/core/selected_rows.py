@@ -1,0 +1,95 @@
+"""SelectedRows: static-shape sparse row-slice gradients.
+
+TPU-native redesign of the reference's ``SelectedRows``
+(``paddle/fluid/framework/selected_rows.h:32``): a {row-index vector,
+value rows} pair used as the gradient type of ``lookup_table(is_sparse)``.
+The reference stores a dynamically-sized row list on the host; XLA needs
+static shapes, so here ``rows`` is the *flattened id tensor* of the lookup
+(fixed length N = number of lookups per step, duplicates allowed) and
+``values`` the matching cotangent rows.  Dense materialisation of the
+[height, D] gradient never happens: optimizers scatter straight into the
+parameter rows (``sgd_op.h:47-52`` sparse-path analogue).
+
+Duplicate handling: scatter-add is exact for SGD; accumulator-based
+optimizers (momentum/adam/adagrad/...) must see each row once, so
+``merge_rows`` segment-sums duplicates into unique rows — the analogue of
+the reference's ``scatter::MergeAdd`` (``operators/math/selected_rows_functor.h``).
+Merged slots beyond the number of unique rows carry the sentinel row id
+``height``; gathers use fill-with-zero and scatters use drop mode, so the
+sentinel rows are no-ops on device — no dynamic shapes anywhere.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+
+@tree_util.register_pytree_node_class
+class SelectedRows:
+    """Sparse row-slice tensor: ``values[i]`` is a (sub)gradient for row
+    ``rows[i]`` of a dense [height, ...] tensor.  Rows may repeat."""
+
+    def __init__(self, rows, values, height: int, merged: bool = False):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+        self.merged = bool(merged)  # rows already unique (merge_rows output)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), (self.height, self.merged)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    def to_dense(self):
+        """Materialise the dense gradient (duplicates accumulate)."""
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def __repr__(self):
+        return (f"SelectedRows(n={self.rows.shape[0]}, height={self.height}, "
+                f"row_shape={self.values.shape[1:]}, dtype={self.dtype})")
+
+
+def merge_rows(sr: SelectedRows) -> SelectedRows:
+    """Sum duplicate rows (MergeAdd).  Result has the same static length N;
+    slot i holds the i-th unique row's sum, unused slots carry the sentinel
+    row id ``height`` (dropped by scatters, zero-filled by gathers)."""
+    rows, vals = sr.rows, sr.values
+    n = rows.shape[0]
+    if n == 0 or sr.merged:
+        return sr
+    order = jnp.argsort(rows)
+    r = rows[order]
+    v = vals[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), r[1:] != r[:-1]])
+    seg = jnp.cumsum(first) - 1           # sorted position → unique-group id
+    merged = jax.ops.segment_sum(v, seg, num_segments=n)
+    group_rows = jax.ops.segment_max(r, seg, num_segments=n)
+    valid = jnp.arange(n) < seg[-1] + 1   # first n_unique slots are real
+    out_rows = jnp.where(valid, group_rows, jnp.asarray(sr.height, r.dtype))
+    return SelectedRows(out_rows, merged, sr.height, merged=True)
+
+
+def gather_rows(dense, rows):
+    """Gather dense[rows]; sentinel (out-of-range) rows read as zero."""
+    return dense.at[rows].get(mode="fill", fill_value=0)
+
+
+def scatter_set_rows(dense, rows, values):
+    """dense[rows] = values; sentinel rows are dropped."""
+    return dense.at[rows].set(values.astype(dense.dtype), mode="drop")

@@ -74,16 +74,29 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32", name=None):
-    """Embedding lookup (reference nn.py:272).  ``is_distributed`` marks the
-    table for the pserver transpiler's sharded-table path."""
+    """Embedding lookup (reference nn.py:272).  ``is_sparse`` makes the
+    gradient a SelectedRows row-slice pair (no dense [V, D] grad is ever
+    materialised); ``is_distributed`` marks the table for the pserver
+    transpiler's sharded-table path."""
+    if is_distributed:
+        raise NotImplementedError(
+            "is_distributed=True requires the DistributeTranspiler "
+            "sharded-table path; pass is_sparse=True for local sparse "
+            "gradients")
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(param_attr, size, dtype)
     out_shape = tuple(input.shape[:-1] if input.shape[-1] == 1 else input.shape) + (size[1],)
     out = helper.create_variable_for_type_inference(dtype, shape=out_shape)
+    # negative padding_idx counts from the end (reference nn.py:292:
+    # kNoPadding if None else idx if idx >= 0 else size[0] + idx)
+    if padding_idx is None:
+        padding_idx = -1  # kNoPadding sentinel
+    elif padding_idx < 0:
+        padding_idx = size[0] + padding_idx
     helper.append_op(
         "lookup_table", {"W": [w], "Ids": [input]}, {"Out": [out]},
         {"is_sparse": is_sparse, "is_distributed": is_distributed,
-         "padding_idx": -1 if padding_idx is None else padding_idx},
+         "padding_idx": padding_idx},
     )
     if seq_len_var(input) is not None:
         _alias_len(out, seq_len_var(input))

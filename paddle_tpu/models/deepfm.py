@@ -16,7 +16,7 @@ def ctr_deepfm(dense_input, sparse_ids, sparse_field_count, sparse_dim,
         param_attr=fluid.ParamAttr(
             name="ctr.sparse_emb",
             initializer=fluid.initializer.Uniform(-0.01, 0.01)),
-        is_distributed=True)                       # [B, fields, embed_dim]
+        is_sparse=True)                            # [B, fields, embed_dim]
     # FM second-order term: 0.5*((Σv)² − Σv²)
     sum_emb = fluid.layers.reduce_sum(emb, dim=1)              # [B, k]
     sum_sq = fluid.layers.square(sum_emb)
@@ -29,7 +29,7 @@ def ctr_deepfm(dense_input, sparse_ids, sparse_field_count, sparse_dim,
     emb1 = fluid.layers.embedding(
         sparse_ids, [sparse_dim, 1],
         param_attr=fluid.ParamAttr(name="ctr.sparse_w1"),
-        is_distributed=True)                       # [B, fields, 1]
+        is_sparse=True)                            # [B, fields, 1]
     first = fluid.layers.reduce_sum(emb1, dim=1)   # [B, 1]
 
     # deep part

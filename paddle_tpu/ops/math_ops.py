@@ -36,17 +36,27 @@ def broadcast_y(x, y, axis: int):
     return y.reshape(new_shape)
 
 
-def _ew(fn):
+def _ew(fn, sparse_scalar_ok=False):
     def lower(ctx, ins, attrs):
+        from ..core.selected_rows import SelectedRows
+
         x, y = ins["X"][0], ins["Y"][0]
+        if isinstance(x, SelectedRows):
+            # only f with f(0, y) == 0 (mul/div) may skip the absent zero
+            # rows; anything else would silently diverge from dense semantics
+            if sparse_scalar_ok and jnp.ndim(y) == 0:
+                return {"Out": [SelectedRows(x.rows, fn(x.values, y), x.height,
+                                             merged=x.merged)]}
+            raise NotImplementedError(
+                f"elementwise op {fn.__name__!r} over a SelectedRows operand")
         return {"Out": [fn(x, broadcast_y(x, y, attrs.get("axis", -1)))]}
     return lower
 
 
 register("elementwise_add")(_ew(jnp.add))
 register("elementwise_sub")(_ew(jnp.subtract))
-register("elementwise_mul")(_ew(jnp.multiply))
-register("elementwise_div")(_ew(jnp.divide))
+register("elementwise_mul")(_ew(jnp.multiply, sparse_scalar_ok=True))
+register("elementwise_div")(_ew(jnp.divide, sparse_scalar_ok=True))
 register("elementwise_max")(_ew(jnp.maximum))
 register("elementwise_min")(_ew(jnp.minimum))
 register("elementwise_pow")(_ew(jnp.power))
@@ -209,7 +219,20 @@ def _scale(ctx, ins, attrs):
 
 @register("sum")
 def _sum(ctx, ins, attrs):
+    from ..core.selected_rows import SelectedRows
+
     xs = ins["X"]
+    sparse = [x for x in xs if isinstance(x, SelectedRows)]
+    if sparse:
+        if len(sparse) == len(xs):
+            # all-sparse sum = row concatenation (reference sum_op over
+            # SelectedRows; duplicates are merged later by the consumer)
+            out = SelectedRows(
+                jnp.concatenate([s.rows for s in sparse]),
+                jnp.concatenate([s.values for s in sparse]),
+                sparse[0].height)
+            return {"Out": [out]}
+        xs = [x.to_dense() if isinstance(x, SelectedRows) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x
@@ -231,13 +254,31 @@ def _cast_grad(ctx, ins, attrs):
 
 @register("clip")
 def _clip(ctx, ins, attrs):
-    return {"Out": [jnp.clip(ins["X"][0], attrs.get("min"), attrs.get("max"))]}
+    from ..core.selected_rows import SelectedRows, merge_rows
+
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        m = merge_rows(x)  # merge first so duplicates clip like the dense grad
+        return {"Out": [SelectedRows(
+            m.rows, jnp.clip(m.values, attrs.get("min"), attrs.get("max")),
+            m.height, merged=True)]}
+    return {"Out": [jnp.clip(x, attrs.get("min"), attrs.get("max"))]}
 
 
 @register("clip_by_norm")
 def _clip_by_norm(ctx, ins, attrs):
+    from ..core.selected_rows import SelectedRows, merge_rows
+
     x = ins["X"][0]
     max_norm = attrs["max_norm"]
+    if isinstance(x, SelectedRows):
+        m = merge_rows(x)
+        norm = jnp.sqrt(jnp.sum(jnp.square(m.values)))
+        factor = jnp.where(norm > max_norm,
+                           max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return {"Out": [SelectedRows(
+            m.rows, m.values * factor.astype(m.dtype), m.height,
+            merged=True)]}
     norm = jnp.sqrt(jnp.sum(jnp.square(x)))
     factor = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
     return {"Out": [x * factor.astype(x.dtype)]}
@@ -298,7 +339,11 @@ def _logical_not(ctx, ins, attrs):
 # helpers for GradientClipByGlobalNorm (clip.py)
 @register("__global_norm_sq__", no_grad_slots=("X",))
 def _global_norm_sq(ctx, ins, attrs):
+    from ..core.selected_rows import SelectedRows, merge_rows
+
     x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        x = merge_rows(x).values  # duplicates must sum before squaring
     return {"Out": [jnp.sum(jnp.square(x.astype(jnp.float32)))]}
 
 

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register
+from ..core.selected_rows import (
+    SelectedRows, gather_rows, merge_rows, scatter_set_rows)
 
 
 def _lr(ins, dtype=None):
@@ -24,10 +26,28 @@ def _lr(ins, dtype=None):
     return lr.astype(dtype) if dtype is not None else lr
 
 
+def _is_sparse(g):
+    return isinstance(g, SelectedRows)
+
+
+def _dense_only(g, op):
+    if isinstance(g, SelectedRows):
+        raise NotImplementedError(
+            f"optimizer op {op!r} has no sparse (SelectedRows) update path; "
+            "use sgd/momentum/adam/adagrad for is_sparse embeddings")
+    return g
+
+
 @register("sgd")
 def _sgd(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
-    return {"ParamOut": [p - _lr(ins, p.dtype) * g.astype(p.dtype)]}
+    lr = _lr(ins, p.dtype)
+    if _is_sparse(g):
+        # sparse path (sgd_op.h:47-52): scatter-add touches only the looked-up
+        # rows; duplicates accumulate, which is exact for plain SGD
+        return {"ParamOut": [
+            p.at[g.rows].add(-lr * g.values.astype(p.dtype), mode="drop")]}
+    return {"ParamOut": [p - lr * g.astype(p.dtype)]}
 
 
 @register("momentum")
@@ -35,6 +55,18 @@ def _momentum(ctx, ins, attrs):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = jnp.asarray(attrs.get("mu", 0.9), v.dtype)
     lr = _lr(ins, v.dtype)
+    if _is_sparse(g):
+        m = merge_rows(g)
+        rows, gf = m.rows, m.values.astype(v.dtype)
+        vr = gather_rows(v, rows)
+        pr = gather_rows(p, rows).astype(v.dtype)
+        v_new_r = mu * vr + gf
+        if attrs.get("use_nesterov", False):
+            p_new_r = pr - (gf + mu * v_new_r) * lr
+        else:
+            p_new_r = pr - lr * v_new_r
+        return {"ParamOut": [scatter_set_rows(p, rows, p_new_r)],
+                "VelocityOut": [scatter_set_rows(v, rows, v_new_r)]}
     g = g.astype(v.dtype)
     v_new = mu * v + g
     if attrs.get("use_nesterov", False):
@@ -52,6 +84,25 @@ def _adam(ctx, ins, attrs):
     beta1 = jnp.asarray(attrs.get("beta1", 0.9), m1.dtype)
     beta2 = jnp.asarray(attrs.get("beta2", 0.999), m2.dtype)
     eps = jnp.asarray(attrs.get("epsilon", 1e-8), m1.dtype)
+    if _is_sparse(g):
+        # sparse (lazy) adam: merge duplicate rows, update moments and param
+        # for touched rows only (reference adam_op.h SelectedRows path)
+        m = merge_rows(g)
+        rows, gf = m.rows, m.values.astype(m1.dtype)
+        m1r, m2r = gather_rows(m1, rows), gather_rows(m2, rows)
+        pr = gather_rows(p, rows).astype(m1.dtype)
+        m1n = beta1 * m1r + (1 - beta1) * gf
+        m2n = beta2 * m2r + (1 - beta2) * gf * gf
+        lr = (_lr(ins, m1.dtype)
+              * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(())))
+        step = lr * m1n / (jnp.sqrt(m2n) + eps)
+        return {
+            "ParamOut": [scatter_set_rows(p, rows, pr - step)],
+            "Moment1Out": [scatter_set_rows(m1, rows, m1n)],
+            "Moment2Out": [scatter_set_rows(m2, rows, m2n)],
+            "Beta1PowOut": [b1p * beta1],
+            "Beta2PowOut": [b2p * beta2],
+        }
     gf = g.astype(m1.dtype)
     m1n = beta1 * m1 + (1 - beta1) * gf
     m2n = beta2 * m2 + (1 - beta2) * gf * gf
@@ -70,6 +121,15 @@ def _adam(ctx, ins, attrs):
 def _adagrad(ctx, ins, attrs):
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = jnp.asarray(attrs.get("epsilon", 1e-6), mom.dtype)
+    if _is_sparse(g):
+        m = merge_rows(g)
+        rows, gf = m.rows, m.values.astype(mom.dtype)
+        momr = gather_rows(mom, rows)
+        pr = gather_rows(p, rows).astype(mom.dtype)
+        mom_new_r = momr + gf * gf
+        p_new_r = pr - _lr(ins, mom.dtype) * gf / (jnp.sqrt(mom_new_r) + eps)
+        return {"ParamOut": [scatter_set_rows(p, rows, p_new_r)],
+                "MomentOut": [scatter_set_rows(mom, rows, mom_new_r)]}
     gf = g.astype(mom.dtype)
     mom_new = mom + gf * gf
     p_new = p - (_lr(ins, mom.dtype) * gf / (jnp.sqrt(mom_new) + eps)).astype(p.dtype)
@@ -78,6 +138,7 @@ def _adagrad(ctx, ins, attrs):
 
 @register("decayed_adagrad")
 def _decayed_adagrad(ctx, ins, attrs):
+    ins = {**ins, "Grad": [_dense_only(ins["Grad"][0], "decayed_adagrad")]}
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     decay = jnp.asarray(attrs.get("decay", 0.95), mom.dtype)
     eps = jnp.asarray(attrs.get("epsilon", 1e-6), mom.dtype)
@@ -89,6 +150,7 @@ def _decayed_adagrad(ctx, ins, attrs):
 
 @register("adamax")
 def _adamax(ctx, ins, attrs):
+    ins = {**ins, "Grad": [_dense_only(ins["Grad"][0], "adamax")]}
     p, g = ins["Param"][0], ins["Grad"][0]
     m, inf = ins["Moment"][0], ins["InfNorm"][0]
     b1p = ins["Beta1Pow"][0]
@@ -106,6 +168,7 @@ def _adamax(ctx, ins, attrs):
 
 @register("adadelta")
 def _adadelta(ctx, ins, attrs):
+    ins = {**ins, "Grad": [_dense_only(ins["Grad"][0], "adadelta")]}
     p, g = ins["Param"][0], ins["Grad"][0]
     avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
     rho = jnp.asarray(attrs.get("rho", 0.95), avg_sq_g.dtype)
@@ -120,6 +183,7 @@ def _adadelta(ctx, ins, attrs):
 
 @register("rmsprop")
 def _rmsprop(ctx, ins, attrs):
+    ins = {**ins, "Grad": [_dense_only(ins["Grad"][0], "rmsprop")]}
     p, g = ins["Param"][0], ins["Grad"][0]
     ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
     rho = jnp.asarray(attrs.get("decay", 0.95), ms.dtype)
@@ -144,6 +208,7 @@ def _rmsprop(ctx, ins, attrs):
 
 @register("ftrl")
 def _ftrl(ctx, ins, attrs):
+    ins = {**ins, "Grad": [_dense_only(ins["Grad"][0], "ftrl")]}
     p, g = ins["Param"][0], ins["Grad"][0]
     sq_acc, lin_acc = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
     l1 = jnp.asarray(attrs.get("l1", 0.0), sq_acc.dtype)
@@ -162,6 +227,7 @@ def _ftrl(ctx, ins, attrs):
 
 @register("lars_momentum")
 def _lars_momentum(ctx, ins, attrs):
+    ins = {**ins, "Grad": [_dense_only(ins["Grad"][0], "lars_momentum")]}
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = jnp.asarray(attrs.get("mu", 0.9), v.dtype)
     lars_coeff = attrs.get("lars_coeff", 1e-3)

@@ -145,6 +145,46 @@ def _lookup_table(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register_grad("lookup_table")
+def _lookup_table_grad(ctx, ins, attrs):
+    """W-grad of the embedding gather.  With ``is_sparse`` the gradient is a
+    SelectedRows {flattened ids, cotangent rows} pair — the [height, D]
+    dense gradient is never materialised (reference sparse path:
+    lookup_table_op.cc grad → SelectedRows, selected_rows.h:32)."""
+    from ..core.selected_rows import SelectedRows
+
+    w, ids = ins["W"][0], ins["Ids"][0]
+    gout = ins["Out@GRAD"][0]
+    if gout is None:
+        return {}
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        gout = gout * (ids != pad)[..., None].astype(gout.dtype)
+    rows = ids.reshape(-1)
+    vals = gout.reshape((-1,) + gout.shape[ids.ndim:]).astype(w.dtype)
+    if attrs.get("is_sparse", False):
+        return {"W@GRAD": [SelectedRows(rows, vals, w.shape[0])]}
+    return {"W@GRAD": [jnp.zeros_like(w).at[rows].add(vals)]}
+
+
+@register("sparse_decay", no_grad_slots=("Param", "Grad"))
+def _sparse_decay(ctx, ins, attrs):
+    """Weight-decay contribution for a SelectedRows gradient: decay only the
+    touched rows (reference regularizer.py SelectedRows branch: extract_rows
+    + row gather + scale).  Rows are merged first so duplicated lookups decay
+    once, matching the dense-grad semantics."""
+    from ..core.selected_rows import SelectedRows, gather_rows, merge_rows
+
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m = merge_rows(g)
+    pr = gather_rows(p, m.rows).astype(m.dtype)
+    coeff = attrs.get("coeff", 0.0)
+    vals = coeff * (jnp.sign(pr) if attrs.get("mode", "l2") == "l1" else pr)
+    return {"Out": [SelectedRows(m.rows, vals, m.height, merged=True)]}
+
+
 @register("one_hot", no_grad_slots=("X",))
 def _one_hot(ctx, ins, attrs):
     x = ins["X"][0]

@@ -6,6 +6,20 @@ between backward and the optimizer pass.
 from __future__ import annotations
 
 from .core.program import OP_ROLE_ATTR, OpRole
+from .core.types import VarType
+
+
+def _sparse_decay_var(param, grad, block, coeff, mode):
+    """SelectedRows grad: decay only the touched rows (reference
+    regularizer.py SelectedRows branch)."""
+    decay = block.create_var(
+        name=grad.name + "@" + mode.upper() + "DECAY", shape=param.shape,
+        dtype=param.dtype, type=VarType.SELECTED_ROWS)
+    block.append_op(
+        "sparse_decay", {"Param": [param.name], "Grad": [grad.name]},
+        {"Out": [decay.name]},
+        {"coeff": coeff, "mode": mode, OP_ROLE_ATTR: OpRole.Backward})
+    return decay
 
 
 class WeightDecayRegularizer:
@@ -18,6 +32,8 @@ class L2DecayRegularizer(WeightDecayRegularizer):
         self._coeff = regularization_coeff
 
     def __call__(self, param, grad, block):
+        if grad.type == VarType.SELECTED_ROWS:
+            return _sparse_decay_var(param, grad, block, self._coeff, "l2")
         decay = block.create_var(
             name=grad.name + "@L2DECAY", shape=param.shape, dtype=param.dtype)
         block.append_op(
@@ -31,6 +47,8 @@ class L1DecayRegularizer(WeightDecayRegularizer):
         self._coeff = regularization_coeff
 
     def __call__(self, param, grad, block):
+        if grad.type == VarType.SELECTED_ROWS:
+            return _sparse_decay_var(param, grad, block, self._coeff, "l1")
         sign = block.create_var(
             name=grad.name + "@L1SIGN", shape=param.shape, dtype=param.dtype)
         block.append_op(
@@ -54,7 +72,8 @@ def append_regularization_ops(params_grads, regularization=None):
         block = grad.block
         decay = regularizer(param, grad, block)
         new_grad = block.create_var(
-            name=grad.name + "@REG", shape=param.shape, dtype=param.dtype)
+            name=grad.name + "@REG", shape=param.shape, dtype=param.dtype,
+            type=grad.type)
         block.append_op(
             "sum", {"X": [grad.name, decay.name]}, {"Out": [new_grad.name]},
             {OP_ROLE_ATTR: OpRole.Backward})

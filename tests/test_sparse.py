@@ -1,0 +1,280 @@
+"""SelectedRows sparse-gradient stack tests.
+
+Parity model (reference test strategy: test_sgd_op.py sparse cases,
+test_adam_op.py TestSparseAdamOp): the sparse path must produce the same
+trained parameters as the dense path on identical programs, including
+duplicate ids, regularization, and global-norm clipping.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.core.types import VarType
+
+V, D = 50, 8
+
+
+def _merge_ref(rows, vals, height):
+    dense = np.zeros((height,) + vals.shape[1:], vals.dtype)
+    np.add.at(dense, rows, vals)
+    return dense
+
+
+def test_merge_rows_sums_duplicates():
+    import jax.numpy as jnp
+    from paddle_tpu.core.selected_rows import SelectedRows, merge_rows
+
+    rng = np.random.RandomState(0)
+    rows = np.array([3, 1, 3, 7, 1, 3], np.int64)
+    vals = rng.randn(6, 4).astype(np.float64)
+    m = merge_rows(SelectedRows(jnp.asarray(rows), jnp.asarray(vals), 10))
+    got = np.zeros((10, 4))
+    r, v = np.asarray(m.rows), np.asarray(m.values)
+    for i in range(len(r)):
+        if r[i] < 10:
+            assert got[r[i]].sum() == 0, "duplicate row in merged output"
+            got[r[i]] += v[i]
+    np.testing.assert_allclose(got, _merge_ref(rows, vals, 10), rtol=1e-12)
+    # sentinel slots: exactly n - n_unique of them
+    assert (r == 10).sum() == 6 - 3
+
+
+def _train(optimizer_fn, is_sparse, steps=4, regularizer=None, clip=None,
+           seed=0, cover_all=False):
+    """Train a tiny embedding+fc model; return the final embedding table.
+
+    ``cover_all``: every table row appears in every batch — required for
+    exact dense parity of *lazy* accumulator optimizers (momentum/adam),
+    whose sparse path deliberately skips accumulator decay on untouched
+    rows (reference adam_op.h SelectedRows semantics).
+    """
+    rng = np.random.RandomState(seed)
+    prog, startup = Program(), Program()
+    prog.random_seed = 5
+    with program_guard(prog, startup), unique_name.guard():
+        ids = fluid.layers.data("ids", [6], dtype="int64")
+        label = fluid.layers.data("label", [1])
+        emb = fluid.layers.embedding(
+            ids, [V, D], is_sparse=is_sparse,
+            param_attr=fluid.ParamAttr(
+                name="emb.w",
+                initializer=fluid.initializer.Uniform(-0.5, 0.5),
+                regularizer=regularizer))
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        pred = fluid.layers.fc(pooled, 1,
+                               param_attr=fluid.ParamAttr(name="fc.w"))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, label))
+        if clip is not None:
+            fluid.clip.set_gradient_clip(clip)
+        optimizer_fn().minimize(loss)
+        if clip is not None:
+            fluid.clip.set_gradient_clip(None)
+    exe = Executor()
+    sc = Scope()
+    with scope_guard(sc):
+        exe.run(startup)
+        for i in range(steps):
+            if cover_all:
+                # [10, 6] = 60 slots: every one of the V=50 rows appears,
+                # plus 10 random duplicates
+                flat = np.concatenate(
+                    [rng.permutation(V), rng.randint(0, V, 10)])
+                idb = flat.reshape(10, 6).astype("int64")
+                lb = rng.randn(10, 1).astype("float32")
+            else:
+                # duplicate ids inside one batch on purpose
+                idb = rng.randint(0, V, (3, 6)).astype("int64")
+                idb[:, 0] = idb[:, 1]
+                lb = rng.randn(3, 1).astype("float32")
+            exe.run(prog, feed={"ids": idb, "label": lb}, fetch_list=[loss])
+        w = np.asarray(sc.find_var("emb.w"))
+    return w
+
+
+@pytest.mark.parametrize("opt,cover_all", [
+    (lambda: fluid.optimizer.SGD(0.1), False),
+    (lambda: fluid.optimizer.Adagrad(0.1), False),
+    # lazy accumulator optimizers: exact parity needs full row coverage
+    (lambda: fluid.optimizer.Momentum(0.1, 0.9), True),
+    (lambda: fluid.optimizer.Adam(0.1), True),
+])
+def test_sparse_dense_optimizer_parity(opt, cover_all):
+    wd = _train(opt, is_sparse=False, cover_all=cover_all)
+    ws = _train(opt, is_sparse=True, cover_all=cover_all)
+    np.testing.assert_allclose(ws, wd, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_parity_with_l2_and_global_norm_clip():
+    reg = fluid.regularizer.L2Decay(0.05)
+    mk = lambda: fluid.optimizer.Adam(0.05)
+    # cover_all: L2 decay on the sparse path is lazy (touched rows only),
+    # so exact dense parity needs every row touched every step
+    wd = _train(mk, False, regularizer=reg, cover_all=True,
+                clip=fluid.clip.GradientClipByGlobalNorm(0.7))
+    ws = _train(mk, True, regularizer=reg, cover_all=True,
+                clip=fluid.clip.GradientClipByGlobalNorm(0.7))
+    np.testing.assert_allclose(ws, wd, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_update_touches_only_looked_up_rows():
+    """Rows never looked up must keep their initial values (the whole point
+    of the sparse path) — including under L2 decay AND global-norm clipping,
+    whose intermediate vars must stay SelectedRows end to end."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, [V, D], is_sparse=True,
+            param_attr=fluid.ParamAttr(
+                name="emb.w", regularizer=fluid.regularizer.L2Decay(0.1)))
+        loss = fluid.layers.mean(emb)
+        fluid.clip.set_gradient_clip(fluid.clip.GradientClipByGlobalNorm(0.5))
+        fluid.optimizer.Adam(0.5).minimize(loss)
+        fluid.clip.set_gradient_clip(None)
+    exe = Executor()
+    sc = Scope()
+    with scope_guard(sc):
+        exe.run(startup)
+        w0 = np.asarray(sc.find_var("emb.w")).copy()
+        idb = np.array([[1, 2, 3, 1], [2, 4, 5, 5]], "int64")
+        exe.run(prog, feed={"ids": idb}, fetch_list=[loss])
+        w1 = np.asarray(sc.find_var("emb.w"))
+    touched = sorted(set(idb.ravel().tolist()))
+    untouched = [i for i in range(V) if i not in touched]
+    assert not np.allclose(w1[touched], w0[touched]), "touched rows unchanged"
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+
+
+def test_negative_padding_idx_counts_from_end():
+    """padding_idx=-1 must pad row V-1 (reference nn.py: size[0]+idx), not
+    silently disable padding."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        ids = fluid.layers.data("ids", [3], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, [V, D], padding_idx=-1,
+            param_attr=fluid.ParamAttr(
+                name="emb.w",
+                initializer=fluid.initializer.Constant(1.0)))
+        out = fluid.layers.reduce_sum(emb, dim=2)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        (o,) = exe.run(prog, feed={"ids": np.array([[V - 1, 0, V - 1]],
+                                                   "int64")},
+                       fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), [[0.0, D, 0.0]])
+
+
+def test_grad_var_is_marked_selected_rows():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, [V, D], is_sparse=True,
+            param_attr=fluid.ParamAttr(name="emb.w"))
+        loss = fluid.layers.mean(emb)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    gv = prog.global_block.var("emb.w@GRAD")
+    assert gv.type == VarType.SELECTED_ROWS
+
+
+def test_unsupported_sparse_optimizer_raises():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        emb = fluid.layers.embedding(ids, [V, D], is_sparse=True)
+        loss = fluid.layers.mean(emb)
+        fluid.optimizer.Ftrl(0.1).minimize(loss)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        with pytest.raises(NotImplementedError, match="sparse"):
+            exe.run(prog, feed={"ids": np.zeros((2, 4), "int64")},
+                    fetch_list=[loss])
+
+
+def test_is_distributed_errors_without_transpiler():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        with pytest.raises(NotImplementedError, match="is_distributed"):
+            fluid.layers.embedding(ids, [V, D], is_distributed=True)
+
+
+def test_sparse_grads_under_dp_mesh():
+    """Sparse (SelectedRows) grads must survive GSPMD lowering: losses on a
+    dp=8 mesh with dp-sharded id feeds match single-device training."""
+    from paddle_tpu.parallel import BuildStrategy, ParallelExecutor
+
+    def build():
+        prog, startup = Program(), Program()
+        prog.random_seed = 11
+        with program_guard(prog, startup), unique_name.guard():
+            ids = fluid.layers.data("ids", [6], dtype="int64")
+            label = fluid.layers.data("label", [1])
+            emb = fluid.layers.embedding(
+                ids, [V, D], is_sparse=True,
+                param_attr=fluid.ParamAttr(
+                    name="emb.w",
+                    initializer=fluid.initializer.Uniform(-0.5, 0.5)))
+            pooled = fluid.layers.reduce_sum(emb, dim=1)
+            pred = fluid.layers.fc(pooled, 1,
+                                   param_attr=fluid.ParamAttr(name="fc.w"))
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, label))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        return prog, startup, loss
+
+    rng = np.random.RandomState(7)
+    batches = [(rng.randint(0, V, (16, 6)).astype("int64"),
+                rng.randn(16, 1).astype("float32")) for _ in range(6)]
+
+    prog, startup, loss = build()
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        single = [float(exe.run(prog, feed={"ids": i, "label": l},
+                                fetch_list=[loss])[0]) for i, l in batches]
+
+    prog, startup, loss = build()
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        pe = ParallelExecutor(loss_name=loss.name, main_program=prog,
+                              build_strategy=BuildStrategy(
+                                  mesh_shape={"dp": 8}))
+        multi = [float(np.asarray(
+            pe.run(feed={"ids": i, "label": l}, fetch_list=[loss.name])[0]))
+            for i, l in batches]
+    np.testing.assert_allclose(multi, single, rtol=2e-4, atol=1e-5)
+
+
+def test_deepfm_large_table_trains():
+    """DeepFM CTR with a 1M-row sparse table: the step must run without ever
+    materialising the dense [1M, D] gradient, and the loss must drop."""
+    from paddle_tpu.models import deepfm
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 3
+    with program_guard(prog, startup), unique_name.guard():
+        feeds, avg_cost, _ = deepfm.build(sparse_dim=int(1e6), lr=1e-3)
+    rng = np.random.RandomState(0)
+    feed = {
+        "dense": rng.rand(16, 13).astype("float32"),
+        "sparse": rng.randint(0, int(1e6), (16, 26)).astype("int64"),
+        "label": (rng.rand(16, 1) > 0.5).astype("float32"),
+    }
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = []
+        for i in range(8):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[avg_cost])
+            losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses

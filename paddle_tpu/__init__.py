@@ -41,6 +41,7 @@ from .core.program import (  # noqa: F401
 )
 from .core import unique_name  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
+from .distributed import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .parallel import (  # noqa: F401
     BuildStrategy,

@@ -21,8 +21,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import host_ops as _host_ops
 from .lowering import analyze_block, build_block_fn
-from .program import Program, Variable, default_main_program
+from .program import EMPTY_VAR, Program, Variable, default_main_program
+from .selected_rows import SelectedRows
 from .types import np_dtype
 
 RNG_STATE_VAR = "@RNG_STATE@"
@@ -91,6 +93,10 @@ def scope_guard(scope: Scope):
 def _as_device_array(value, var: Optional[Variable]):
     if isinstance(value, (jax.Array,)):
         return value
+    if isinstance(value, SelectedRows):
+        return SelectedRows(jnp.asarray(np.asarray(value.rows)),
+                            jnp.asarray(np.asarray(value.values)),
+                            value.height)
     arr = np.asarray(value)
     if var is not None and var.dtype is not None:
         arr = arr.astype(np_dtype(var.dtype), copy=False)
@@ -123,6 +129,9 @@ class Executor:
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])]
         scope = scope or global_scope()
         program = self._prepare_program(program, feed)
+
+        if any(_host_ops.is_host_op(op.type) for op in program.global_block.ops):
+            return self._run_segmented(program, feed, fetch_names, scope, return_numpy)
 
         feed_names = sorted(feed)
         block = program.global_block
@@ -161,6 +170,75 @@ class Executor:
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    # -- host-op segmented execution ---------------------------------------
+    # Blocks containing host ops (core/host_ops.py: RPC, pserver loop, IO)
+    # are partitioned into maximal device segments — each lowered + jitted
+    # exactly like a plain block — interleaved with host-op calls against
+    # the scope.  This is the TPU translation of the reference op loop
+    # running send/recv/listen_and_serv kernels in program order
+    # (executor.cc:390, operators/send_op.cc:29, listen_and_serv_op.cc:102).
+
+    def _segment_plan(self, program: Program, feed_names: tuple, fetch_names: tuple):
+        key = ("seg", id(program), program._version, feed_names, fetch_names)
+        segs = self._cache.get(key)
+        if segs is not None:
+            return segs
+        block = program.global_block
+        runs: List = []  # (kind, start, end) over block.ops
+        for i, op in enumerate(block.ops):
+            kind = "host" if _host_ops.is_host_op(op.type) else "device"
+            if runs and runs[-1][0] == kind:
+                runs[-1][2] = i + 1
+            else:
+                runs.append([kind, i, i + 1])
+        segs = []
+        for idx, (kind, a, b) in enumerate(runs):
+            if kind == "host":
+                segs.append(("host", block.ops[a:b]))
+                continue
+            needed_later = set(fetch_names)
+            for _, a2, b2 in runs[idx + 1:]:
+                for op in block.ops[a2:b2]:
+                    needed_later.update(op.input_arg_names())
+            produced = set()
+            for op in block.ops[a:b]:
+                produced.update(op.output_arg_names())
+            seg_fetches = sorted((produced & needed_later) - {EMPTY_VAR, ""})
+            sub = program.clone()
+            sub.global_block.ops = sub.global_block.ops[a:b]
+            reads, defined = set(), set()
+            for op in sub.global_block.ops:
+                reads.update(n for n in op.input_arg_names() if n not in defined)
+                defined.update(op.output_arg_names())
+            segs.append(("device", sub, seg_fetches, reads))
+        self._cache[key] = segs
+        return segs
+
+    def _run_segmented(self, program, feed, fetch_names, scope, return_numpy):
+        segs = self._segment_plan(program, tuple(sorted(feed)), tuple(fetch_names))
+        fetched: Dict[str, object] = {}
+        for seg in segs:
+            if seg[0] == "host":
+                for op in seg[1]:
+                    _host_ops.run_host_op(self, program, op, scope)
+                continue
+            _, sub, seg_fetches, reads = seg
+            sub_feed = {n: v for n, v in feed.items() if n in reads}
+            vals = self.run(sub, feed=sub_feed, fetch_list=seg_fetches,
+                            scope=scope, return_numpy=False)
+            for n, v in zip(seg_fetches, vals):
+                fetched[n] = v
+                scope.set_var(n, v)
+        out = []
+        for n in fetch_names:
+            v = fetched.get(n)
+            if v is None:
+                v = scope.find_var(n)
+            if return_numpy and v is not None and not isinstance(v, SelectedRows):
+                v = np.asarray(v)
+            out.append(v)
+        return out
 
     # -- placement hooks (overridden by ParallelExecutor) ------------------
     def _prepare_program(self, program: Program, feed: Dict) -> Program:

@@ -1,0 +1,36 @@
+"""Host-op registry: side-effectful ops run by the Executor on the host.
+
+The reference's op loop treats RPC/IO ops like any other op — their kernels
+just happen to do gRPC or file IO instead of math (``send_op.cc:29``,
+``recv_op.cc:28``, ``listen_and_serv_op.cc:102``, ``print_op.cc``).  The
+TPU runtime whole-block-JITs device compute, so side-effectful ops cannot
+live inside the XLA program.  Instead they register here; the Executor
+partitions a block containing host ops into maximal *device segments*
+(each lowered + jitted exactly as before) interleaved with host-op calls
+that read/write the Scope.  Device compute keeps end-to-end XLA fusion;
+host ops keep reference op-loop ordering semantics.
+
+Handler signature: ``fn(executor, program, op, scope)``; inputs are read
+from the scope (device segments fetch any value a later host op consumes
+into the scope first), outputs are written back to the scope.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+HOST_OPS: Dict[str, Callable] = {}
+
+
+def register_host_op(op_type: str):
+    def deco(fn: Callable) -> Callable:
+        HOST_OPS[op_type] = fn
+        return fn
+    return deco
+
+
+def is_host_op(op_type: str) -> bool:
+    return op_type in HOST_OPS
+
+
+def run_host_op(executor, program, op, scope):
+    return HOST_OPS[op.type](executor, program, op, scope)

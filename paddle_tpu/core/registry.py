@@ -43,12 +43,32 @@ class LowerContext:
         self.mesh = mesh
         self.training = training
         self._rng_key = None
+        self._rng_key0 = None
         self._rng_used = False
         self._lower_block_fn = lower_block_fn  # (block_idx, env) -> env
 
     def set_rng(self, key):
         self._rng_key = key
+        self._rng_key0 = key
         self._rng_used = False
+
+    def named_prng(self, name: str, seed: int = 0):
+        """Order-independent PRNG key derived from (base key, name).
+
+        Used by initializer ops (attr ``seed_name``) so that initialization
+        is a pure function of (program.random_seed, var name) regardless of
+        op order or program partitioning — program rewrites (transpilers,
+        pserver splits) then initialize identical values to the local run.
+        The reference gets the equivalent property from per-op ``seed``
+        attrs (uniform_random_op.cc) set at build time.
+        """
+        import zlib
+
+        base = jax.random.PRNGKey(seed) if seed else self._rng_key0
+        if base is None:
+            raise RuntimeError("op requires randomness but no rng state was provided")
+        self._rng_used = True
+        return jax.random.fold_in(base, zlib.crc32(name.encode("utf-8")))
 
     def prng(self):
         """Split off a fresh PRNG key (marks rng as consumed)."""

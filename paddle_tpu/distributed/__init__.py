@@ -1,0 +1,35 @@
+"""Distributed parameter-server stack (TPU-native redesign).
+
+The reference implements pserver-mode distribution as gRPC variable
+transport (``paddle/fluid/operators/distributed/grpc_client.h:175``,
+``grpc_server.cc:82``), RPC ops run by the op-loop executor
+(``send_op.cc:29``, ``recv_op.cc:28``, ``listen_and_serv_op.cc:102,213``)
+and a program rewrite (``python/paddle/fluid/transpiler/
+distribute_transpiler.py:144,237``).
+
+Here the same capability is built TPU-first:
+
+- device compute stays whole-block-jitted; RPC ops are *host ops*
+  (``core/host_ops.py``) run between device segments by the Executor;
+- variable transport is a framed-TCP service (``transport.py`` +
+  ``serde.py``) carrying dense tensors and SelectedRows sparse slices over
+  DCN — the role NCCL cannot play for sparse/pserver traffic;
+- ``DistributeTranspiler`` rewrites the trainer program (grads → send /
+  params ← recv) and emits per-endpoint pserver programs whose optimize
+  sub-blocks the pserver event loop executes as jitted mini-programs.
+"""
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from . import ps_ops  # noqa: F401  (registers the host ops)
+from . import transport
+
+
+def notify_complete(endpoints, trainer_id: int = 0) -> None:
+    """Tell every pserver this trainer is done (reference SendComplete,
+    ``executor.cc:86-92`` / ``grpc_client.h`` AsyncSendComplete).  When all
+    trainers have completed, ``listen_and_serv`` returns."""
+    client = transport.get_client(trainer_id)
+    client.parallel([(client.complete, ep) for ep in endpoints])
+
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "notify_complete"]

@@ -1,0 +1,330 @@
+"""RPC host ops: send / recv / barriers / prefetch / listen_and_serv.
+
+These register with ``core/host_ops.py`` and run between jitted device
+segments.  Reference kernels: ``operators/send_op.cc:29``,
+``recv_op.cc:28``, ``send_barrier_op.cc``, ``fetch_barrier_op.cc``,
+``prefetch_op.cc:27``, ``checkpoint_notify_op.cc:28`` and the pserver
+event loop ``listen_and_serv_op.cc`` (``RunSyncLoop:102``,
+``RunAsyncLoop:213``).
+
+The pserver applies optimizer *sub-blocks* exactly like the reference
+(``listen_and_serv_op.cc:55-74`` ParallelExecuteBlocks), except each block
+is lowered+jitted once by the standard Executor and re-run per round — the
+op-loop becomes an XLA executable per optimize block.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import defaultdict, deque
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.host_ops import register_host_op
+from ..core.program import Operator, Program, Variable
+from ..core.selected_rows import SelectedRows
+from . import transport
+from .transport import (BATCH_BARRIER, CHECKPOINT_NOTIFY, COMPLETE,
+                        FETCH_BARRIER, GET_VAR, OK, PREFETCH, SEND_VAR, serde)
+
+
+def _to_host(value):
+    """Device value → numpy-backed value for the wire."""
+    if isinstance(value, SelectedRows):
+        return SelectedRows(np.asarray(value.rows), np.asarray(value.values),
+                            value.height)
+    return np.asarray(value)
+
+
+# ---------------------------------------------------------------------------
+# trainer-side ops
+# ---------------------------------------------------------------------------
+
+@register_host_op("send")
+def _send(exe, program, op, scope):
+    names = op.input("X")
+    epmap = op.attr("epmap")
+    client = transport.get_client(op.attr("trainer_id", 0))
+    calls = []
+    for name, ep in zip(names, epmap):
+        val = scope.find_var(name)
+        if val is None:
+            raise RuntimeError(f"send: variable {name!r} not found in scope")
+        remote = op.attr("varmap", {}).get(name, name)
+        calls.append((client.send_var, ep, remote, _to_host(val)))
+    client.parallel(calls)
+
+
+@register_host_op("send_barrier")
+def _send_barrier(exe, program, op, scope):
+    client = transport.get_client(op.attr("trainer_id", 0))
+    client.parallel([(client.batch_barrier, ep)
+                     for ep in op.attr("endpoints")])
+
+
+@register_host_op("recv")
+def _recv(exe, program, op, scope):
+    names = op.output("Out")
+    epmap = op.attr("epmap")
+    client = transport.get_client(op.attr("trainer_id", 0))
+    varmap = op.attr("varmap", {})
+    vals = client.parallel([(client.get_var, ep, varmap.get(n, n))
+                            for n, ep in zip(names, epmap)])
+    for name, val in zip(names, vals):
+        scope.set_var(name, val)
+
+
+@register_host_op("fetch_barrier")
+def _fetch_barrier(exe, program, op, scope):
+    client = transport.get_client(op.attr("trainer_id", 0))
+    client.parallel([(client.fetch_barrier, ep)
+                     for ep in op.attr("endpoints")])
+
+
+@register_host_op("checkpoint_notify")
+def _checkpoint_notify(exe, program, op, scope):
+    client = transport.get_client(op.attr("trainer_id", 0))
+    dirname = op.attr("dirname")
+    client.parallel([(client.checkpoint_notify, ep, dirname)
+                     for ep in op.attr("endpoints")])
+
+
+@register_host_op("prefetch")
+def _prefetch(exe, program, op, scope):
+    """Distributed-table row fetch (prefetch_op.cc:27): ids → per-shard
+    remote gather → rows reassembled in id order."""
+    ids_name = op.input("Ids")[0]
+    out_name = op.output("Out")[0]
+    table = op.attr("table_name")
+    sections = op.attr("sections")    # [[endpoint, row_offset, rows], ...]
+    client = transport.get_client(op.attr("trainer_id", 0))
+    ids = np.asarray(scope.find_var(ids_name)).reshape(-1).astype(np.int64)
+
+    calls, masks = [], []
+    for ep, offset, rows in sections:
+        mask = (ids >= offset) & (ids < offset + rows)
+        local = ids[mask] - offset
+        masks.append(mask)
+        calls.append((client.prefetch, ep, table, local))
+    results = client.parallel(calls)
+    width = results[0].shape[-1]
+    out = np.zeros((ids.shape[0], width), results[0].dtype)
+    for mask, rows in zip(masks, results):
+        out[mask] = rows
+    scope.set_var(out_name, out)
+
+
+# ---------------------------------------------------------------------------
+# pserver-side: listen_and_serv
+# ---------------------------------------------------------------------------
+
+def _block_program(ps_program: Program, block_idx: int) -> Program:
+    """Standalone program from one optimize sub-block (vars resolved
+    against block 0), runnable by the standard Executor."""
+    sub = Program()
+    gb = sub.global_block
+    for src in (ps_program.global_block, ps_program.blocks[block_idx]):
+        for name, v in src.vars.items():
+            if name not in gb.vars:
+                gb.vars[name] = Variable.from_dict(gb, v.to_dict())
+    for op in ps_program.blocks[block_idx].ops:
+        gb.ops.append(Operator(gb, op.type, op.inputs, op.outputs,
+                               dict(op.attrs)))
+    return sub
+
+
+class PServerLoop:
+    """The pserver service + event loop (listen_and_serv_op.cc).
+
+    Sync mode (RunSyncLoop:102): grads buffer per (trainer, round); the
+    last batch-barrier of a round merges grads (mean for dense, concat for
+    SelectedRows), runs the LR block then every optimize block, and bumps
+    ``applied_rounds``.  A GET from trainer *t* blocks until
+    ``applied_rounds >= rounds_sent(t)`` — the request-type condition
+    barrier of ``rpc_server.cc`` reduced to one monotonic counter.
+
+    Async mode (RunAsyncLoop:213): each incoming grad is applied
+    immediately through its optimize block under a per-block lock
+    (hogwild across params, serialized per param).
+    """
+
+    def __init__(self, executor, program: Program, op, scope):
+        self.exe = executor
+        self.scope = scope
+        self.op = op
+        self.sync_mode = bool(op.attr("sync_mode", True))
+        self.num_trainers = int(op.attr("Fanin", 1))
+        self.grad_to_block = dict(op.attr("grad_to_block_id", {}))
+        self.lr_block = int(op.attr("lr_block", -1))
+        self.lr_fetch = list(op.attr("lr_fetch", []))
+        self.dense_merge = op.attr("dense_merge", "mean")
+        self.persist_names = list(op.attr("persist_names", []))
+        self.dist_tables = dict(op.attr("dist_tables", {}))
+        # {table: {"var": shard var, "offset": o, "rows": r}}
+
+        self.block_progs = {int(b): _block_program(program, int(b))
+                            for b in self.grad_to_block.values()}
+        if self.lr_block >= 0:
+            self.lr_prog = _block_program(program, self.lr_block)
+        else:
+            self.lr_prog = None
+
+        self.lock = threading.Condition()
+        self.open_round: Dict[int, dict] = defaultdict(dict)
+        self.closed: Dict[int, deque] = defaultdict(deque)
+        self.rounds_sent: Dict[int, int] = defaultdict(int)
+        self.applied_rounds = 0
+        self.n_complete = 0
+        self.exit = False
+        self.error: Exception = None
+        self.block_locks: Dict[int, threading.Lock] = defaultdict(threading.Lock)
+        self.lr_lock = threading.Lock()
+        self._async_sends = 0
+
+    # -- optimize-block execution -----------------------------------------
+    def _run_lr(self):
+        if self.lr_prog is None:
+            return
+        vals = self.exe.run(self.lr_prog, feed={}, fetch_list=self.lr_fetch,
+                            scope=self.scope, return_numpy=False)
+        for n, v in zip(self.lr_fetch, vals):
+            self.scope.set_var(n, v)
+
+    def _run_block(self, block_idx: int):
+        self.exe.run(self.block_progs[block_idx], feed={}, fetch_list=[],
+                     scope=self.scope)
+
+    def _merge_grads(self, per_trainer: List[dict]):
+        for gname, bidx in self.grad_to_block.items():
+            vals = [buf[gname] for buf in per_trainer if gname in buf]
+            if not vals:
+                continue
+            if isinstance(vals[0], SelectedRows):
+                rows = np.concatenate([np.asarray(v.rows) for v in vals])
+                data = np.concatenate([np.asarray(v.values) for v in vals])
+                if self.dense_merge == "mean":
+                    data = data / float(self.num_trainers)
+                merged = SelectedRows(rows, data, vals[0].height)
+            else:
+                merged = np.sum(np.stack(vals), axis=0)
+                if self.dense_merge == "mean":
+                    merged = merged / float(self.num_trainers)
+            self.scope.set_var(gname, merged)
+
+    def _apply_round(self):
+        per_trainer = [self.closed[t].popleft()
+                       for t in range(self.num_trainers) if self.closed[t]]
+        try:
+            self._merge_grads(per_trainer)
+            self._run_lr()
+            for bidx in sorted(set(self.grad_to_block.values())):
+                self._run_block(bidx)
+        except Exception as e:
+            # record + still advance the round so waiting GETs wake up and
+            # surface the error instead of deadlocking (exception_holder.h
+            # role in the reference's threaded executor)
+            self.error = e
+            raise
+        finally:
+            self.applied_rounds += 1
+            self.lock.notify_all()  # caller holds the condition
+
+    # -- service entry (one call per request, many threads) ----------------
+    def handle(self, msg_type, trainer_id, name, payload):
+        if msg_type == SEND_VAR:
+            value = serde.loads_value(payload)
+            if self.sync_mode:
+                with self.lock:
+                    self.open_round[trainer_id][name] = value
+            else:
+                bidx = self.grad_to_block.get(name)
+                if bidx is None:
+                    # plain var write (e.g. startup broadcast)
+                    with self.lock:
+                        self.scope.set_var(name, value)
+                else:
+                    # hogwild apply (RunAsyncLoop:213): no scaling, no
+                    # barriers; LR block advances once per virtual round
+                    with self.lr_lock:
+                        n_grads = max(1, len(self.grad_to_block))
+                        if self._async_sends % n_grads == 0:
+                            self._run_lr()
+                        self._async_sends += 1
+                    with self.block_locks[bidx]:
+                        self.scope.set_var(name, value)
+                        self._run_block(bidx)
+            return OK, b""
+
+        if msg_type == BATCH_BARRIER:
+            if self.sync_mode:
+                with self.lock:
+                    self.closed[trainer_id].append(self.open_round.pop(trainer_id, {}))
+                    self.rounds_sent[trainer_id] += 1
+                    ready = all(self.closed[t]
+                                for t in range(self.num_trainers))
+                    if ready:
+                        self._apply_round()
+                        self.lock.notify_all()
+            return OK, b""
+
+        if msg_type == GET_VAR:
+            if self.sync_mode:
+                with self.lock:
+                    target = self.rounds_sent[trainer_id]
+                    while self.applied_rounds < target and not self.exit:
+                        self.lock.wait(timeout=1.0)
+            if self.error is not None:
+                raise RuntimeError(
+                    f"pserver optimize pass failed: {self.error!r}")
+            val = self.scope.find_var(name)
+            if val is None:
+                raise KeyError(f"pserver has no variable {name!r}")
+            return OK, serde.dumps_value(_to_host(val))
+
+        if msg_type == PREFETCH:
+            info = self.dist_tables[name]
+            ids = np.asarray(serde.loads_value(payload)).reshape(-1)
+            table = np.asarray(self.scope.find_var(info["var"]))
+            return OK, serde.dumps_value(table[ids])
+
+        if msg_type == FETCH_BARRIER:
+            return OK, b""
+
+        if msg_type == CHECKPOINT_NOTIFY:
+            dirname = name
+            os.makedirs(dirname, exist_ok=True)
+            fname = os.path.join(
+                dirname, "pserver_%s.npz" % self.op.attr("endpoint")
+                .replace(":", "_").replace("/", "_"))
+            arrs = {n: np.asarray(self.scope.find_var(n))
+                    for n in self.persist_names
+                    if self.scope.find_var(n) is not None}
+            np.savez(fname, **arrs)
+            return OK, b""
+
+        if msg_type == COMPLETE:
+            with self.lock:
+                self.n_complete += 1
+                if self.n_complete >= self.num_trainers:
+                    self.exit = True
+                self.lock.notify_all()
+            return OK, b""
+
+        raise ValueError(f"unknown message type {msg_type}")
+
+    def wait_exit(self):
+        with self.lock:
+            while not self.exit:
+                self.lock.wait(timeout=0.5)
+
+
+@register_host_op("listen_and_serv")
+def _listen_and_serv(exe, program, op, scope):
+    loop = PServerLoop(exe, program, op, scope)
+    server = transport.RPCServer(op.attr("endpoint"), loop)
+    server.start()
+    try:
+        loop.wait_exit()
+    finally:
+        server.stop()

@@ -1,0 +1,385 @@
+"""DistributeTranspiler: single program → trainer + pserver programs.
+
+TPU-native redesign of
+``python/paddle/fluid/transpiler/distribute_transpiler.py:144,237`` (and
+``slice_variable:79``).  The same contract: take the trained program
+(forward + backward + optimize), shard parameters across parameter-server
+endpoints, and emit
+
+- a **trainer program**: optimize/LR ops removed; grads are split into
+  row-range sections (device ops), sent to their pservers (host ops),
+  fresh param sections recv'd back and concatenated (device ops);
+- per-endpoint **pserver programs**: a ``listen_and_serv`` host op whose
+  sub-blocks hold the re-targeted optimizer ops for the endpoint's param
+  sections (plus one shared LR-schedule block);
+- per-endpoint **pserver startup programs**: param sections initialized
+  *bit-identically* to the local run — initializer ops are keyed by var
+  name (``seed_name`` → ``LowerContext.named_prng``), so a pserver
+  initializes the full parameter with the same draw and slices out its
+  rows.  This replaces the reference's startup-program splicing.
+
+Differences from the reference, by design: gradient clipping and
+regularization stay on the trainer (they rewrite the grad before send);
+dense merging averages over trainers (kCoeffNumDevice semantics) so a
+2-trainer run on half-batches matches the 1-process run on full batches.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional
+
+from ..core.program import (OP_ROLE_ATTR, OP_ROLE_VAR_ATTR, Operator, OpRole,
+                            Program, Variable, default_main_program,
+                            default_startup_program)
+
+
+class DistributeTranspilerConfig:
+    """Reference DistributeTranspilerConfig (distribute_transpiler.py:125)."""
+
+    slice_var_up: bool = True
+    min_block_size: int = 8192
+    split_method: str = "RoundRobin"  # or "HashName"
+
+
+class _Section:
+    """One row-range shard of a parameter assigned to one endpoint."""
+
+    def __init__(self, param: str, grad: str, index: int, offset: int,
+                 rows: int, total: int):
+        self.param, self.grad = param, grad
+        self.index, self.offset, self.rows = index, offset, rows
+        self.sliced = total > 1
+        self.endpoint: str = ""
+
+    @property
+    def pname(self) -> str:
+        return f"{self.param}@BLOCK{self.index}" if self.sliced else self.param
+
+    @property
+    def gname(self) -> str:
+        return f"{self.grad}@BLOCK{self.index}" if self.sliced else self.grad
+
+
+def _split_rows(dim0: int, numel: int, max_parts: int, min_block: int) -> List[int]:
+    """Row counts for slicing a [dim0, ...] var into near-even contiguous
+    sections of at least ``min_block`` elements each (capability match for
+    reference slice_variable:79, original row-based scheme)."""
+    if dim0 <= 1 or numel < 2 * min_block or max_parts <= 1:
+        return [dim0]
+    parts = min(max_parts, max(1, numel // min_block), dim0)
+    base, extra = divmod(dim0, parts)
+    return [base + (1 if i < extra else 0) for i in range(parts)]
+
+
+def _is_optimize_op(op) -> bool:
+    return ("Param" in op.inputs and "Grad" in op.inputs
+            and op.attr(OP_ROLE_ATTR) == OpRole.Optimize)
+
+
+def _is_lr_op(op) -> bool:
+    return bool(op.attr(OP_ROLE_ATTR) == OpRole.LRSched)
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+
+    # -- main entry (reference transpile:237) ------------------------------
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  pservers: str = "127.0.0.1:6174", trainers: int = 1,
+                  sync_mode: bool = True,
+                  startup_program: Optional[Program] = None):
+        self.trainer_id = trainer_id
+        self.origin_program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
+        self.trainers = trainers
+        self.sync_mode = sync_mode
+
+        block0 = self.origin_program.global_block
+        self.opt_ops = [op for op in block0.ops if _is_optimize_op(op)]
+        self.lr_ops = [op for op in block0.ops if _is_lr_op(op)]
+        self.lr_names = sorted({n for op in self.opt_ops
+                                for n in op.input("LearningRate")})
+
+        # params whose gradient is a SelectedRows sparse slice
+        # (lookup_table is_sparse): never sliced — row-slicing a sparse
+        # grad needs a split_selected_rows + per-section id rebasing; keep
+        # the whole table on one pserver so global row ids stay valid
+        # (reference handles this case via the distributed-table path,
+        # distribute_transpiler.py _distributed_lookup_table).
+        self.sparse_params = {
+            op.input("W")[0] for op in block0.ops
+            if op.type == "lookup_table" and op.attr("is_sparse", False)}
+
+        # param sections in deterministic program order
+        self.sections: List[_Section] = []
+        self.param_sections: Dict[str, List[_Section]] = {}
+        for op in self.opt_ops:
+            pname = op.input("Param")[0]
+            gname = op.input("Grad")[0]
+            pvar = block0.var(pname)
+            numel = 1
+            for s in pvar.shape:
+                numel *= int(s)
+            if self.config.slice_var_up and pname not in self.sparse_params:
+                rows = _split_rows(int(pvar.shape[0]), numel,
+                                   len(self.endpoints),
+                                   self.config.min_block_size)
+            else:
+                rows = [int(pvar.shape[0])]
+            secs, off = [], 0
+            for i, r in enumerate(rows):
+                secs.append(_Section(pname, gname, i, off, r, len(rows)))
+                off += r
+            self.param_sections[pname] = secs
+            self.sections.extend(secs)
+
+        # endpoint assignment (RoundRobin / HashName, distribute_transpiler
+        # mode selection at :125)
+        if self.config.split_method == "HashName":
+            for s in self.sections:
+                s.endpoint = self.endpoints[
+                    zlib.crc32(s.pname.encode()) % len(self.endpoints)]
+        else:
+            for i, s in enumerate(self.sections):
+                s.endpoint = self.endpoints[i % len(self.endpoints)]
+        return self
+
+    # -- trainer program (reference get_trainer_program) -------------------
+    def get_trainer_program(self) -> Program:
+        prog = self.origin_program.clone()
+        block = prog.global_block
+        block.ops = [op for op in block.ops
+                     if not (_is_optimize_op(op) or _is_lr_op(op))]
+
+        rpc_attrs = {"trainer_id": self.trainer_id,
+                     OP_ROLE_ATTR: OpRole.RPC}
+
+        # device: split grads into sections
+        for p, secs in self.param_sections.items():
+            if len(secs) == 1:
+                continue
+            for s in secs:
+                gvar = block.var(s.grad)
+                block.create_var(
+                    name=s.gname, shape=(s.rows,) + tuple(gvar.shape[1:]),
+                    dtype=gvar.dtype)
+            block.append_op(
+                "split", {"X": [secs[0].grad]},
+                {"Out": [s.gname for s in secs]},
+                {"axis": 0, "sections": [s.rows for s in secs],
+                 OP_ROLE_ATTR: OpRole.Dist})
+
+        # host: send grad sections → pservers
+        block.append_op(
+            "send", {"X": [s.gname for s in self.sections]}, {},
+            {**rpc_attrs, "epmap": [s.endpoint for s in self.sections]})
+        if self.sync_mode:
+            block.append_op("send_barrier", {}, {},
+                            {**rpc_attrs, "endpoints": self.endpoints})
+
+        # host: recv param sections ← pservers
+        for p, secs in self.param_sections.items():
+            for s in secs:
+                if s.sliced:
+                    pvar = block.var(p)
+                    block.create_var(
+                        name=s.pname,
+                        shape=(s.rows,) + tuple(pvar.shape[1:]),
+                        dtype=pvar.dtype)
+        block.append_op(
+            "recv", {}, {"Out": [s.pname for s in self.sections]},
+            {**rpc_attrs, "epmap": [s.endpoint for s in self.sections]})
+        if self.sync_mode:
+            block.append_op("fetch_barrier", {}, {},
+                            {**rpc_attrs, "endpoints": self.endpoints})
+
+        # device: concat sections back into the parameters
+        for p, secs in self.param_sections.items():
+            if len(secs) == 1:
+                continue
+            block.append_op(
+                "concat", {"X": [s.pname for s in secs]}, {"Out": [p]},
+                {"axis": 0, OP_ROLE_ATTR: OpRole.Dist})
+        return prog
+
+    # -- pserver side ------------------------------------------------------
+    def _ep_sections(self, endpoint: str) -> List[_Section]:
+        return [s for s in self.sections if s.endpoint == endpoint]
+
+    def _acc_name(self, acc: str, sec: _Section) -> str:
+        return f"{acc}@BLOCK{sec.index}" if sec.sliced else acc
+
+    def _section_shape(self, var: Variable, sec: _Section, param_shape) -> tuple:
+        if var.shape is not None and tuple(var.shape) == tuple(param_shape):
+            return (sec.rows,) + tuple(var.shape[1:])
+        return tuple(var.shape) if var.shape is not None else None
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        src = self.origin_program.global_block
+        prog = Program()
+        gb = prog.global_block
+
+        secs = self._ep_sections(endpoint)
+        opt_by_param = {op.input("Param")[0]: op for op in self.opt_ops}
+
+        # LR vars live in block 0 of the pserver program
+        persist_names: List[str] = []
+        lr_block_idx = -1
+        lr_fetch: List[str] = []
+        if self.lr_ops:
+            touched = set()
+            for op in self.lr_ops:
+                touched |= set(op.input_arg_names()) | set(op.output_arg_names())
+            for n in sorted(touched):
+                v = src.var_or_none(n)
+                if v is not None:
+                    gb.vars[n] = Variable.from_dict(gb, v.to_dict())
+                    if v.persistable:
+                        persist_names.append(n)
+            with prog.block_guard() as lb:
+                for op in self.lr_ops:
+                    lb.ops.append(Operator(lb, op.type, op.inputs,
+                                           op.outputs, dict(op.attrs)))
+            lr_block_idx = lb.idx
+            lr_fetch = [n for n in self.lr_names if not src.var(n).persistable]
+        for n in self.lr_names:
+            v = src.var(n)
+            if v.persistable and n not in gb.vars:
+                gb.vars[n] = Variable.from_dict(gb, v.to_dict())
+                persist_names.append(n)
+
+        grad_to_block: Dict[str, int] = {}
+        for sec in secs:
+            opt_op = opt_by_param[sec.param]
+            pvar = src.var(sec.param)
+            gb.create_var(name=sec.pname,
+                          shape=(sec.rows,) + tuple(pvar.shape[1:]),
+                          dtype=pvar.dtype, persistable=True)
+            persist_names.append(sec.pname)
+            gvar = src.var_or_none(sec.grad)
+            gshape = (sec.rows,) + tuple(pvar.shape[1:])
+            gb.create_var(name=sec.gname, shape=gshape,
+                          dtype=(gvar.dtype if gvar is not None else pvar.dtype))
+
+            # clone the optimizer op onto the section, renaming param/grad/
+            # accumulators (reference _append_pserver_ops)
+            def rename(names: List[str]) -> List[str]:
+                out = []
+                for n in names:
+                    if n == sec.param:
+                        out.append(sec.pname)
+                    elif n == sec.grad:
+                        out.append(sec.gname)
+                    elif n in self.lr_names:
+                        out.append(n)
+                    else:
+                        out.append(self._acc_name(n, sec))
+                        v = src.var(n)
+                        nn = self._acc_name(n, sec)
+                        if nn not in gb.vars:
+                            gb.create_var(
+                                name=nn,
+                                shape=self._section_shape(v, sec, pvar.shape),
+                                dtype=v.dtype, persistable=True)
+                            persist_names.append(nn)
+                return out
+
+            with prog.block_guard() as ob:
+                ins = {slot: rename(names)
+                       for slot, names in opt_op.inputs.items()}
+                outs = {slot: rename(names)
+                        for slot, names in opt_op.outputs.items()}
+                ob.ops.append(Operator(ob, opt_op.type, ins, outs,
+                                       dict(opt_op.attrs)))
+            grad_to_block[sec.gname] = ob.idx
+
+        gb.append_op(
+            "listen_and_serv", {}, {},
+            {
+                "endpoint": endpoint,
+                "sync_mode": self.sync_mode,
+                "Fanin": self.trainers,
+                "grad_to_block_id": grad_to_block,
+                "lr_block": lr_block_idx,
+                "lr_fetch": lr_fetch,
+                "dense_merge": "mean",
+                "persist_names": sorted(set(persist_names)),
+                "dist_tables": {},
+                OP_ROLE_ATTR: OpRole.RPC,
+            })
+        return prog
+
+    def get_startup_program(self, endpoint: str) -> Program:
+        """Pserver startup: initialize this endpoint's param sections (and
+        accumulators / LR vars) with values identical to the local run."""
+        src_startup = self.startup_program.global_block
+        src_main = self.origin_program.global_block
+        init_by_out: Dict[str, Operator] = {}
+        for op in src_startup.ops:
+            for n in op.output_arg_names():
+                init_by_out[n] = op
+
+        prog = Program()
+        prog.random_seed = self.startup_program.random_seed
+        gb = prog.global_block
+        opt_by_param = {op.input("Param")[0]: op for op in self.opt_ops}
+
+        def clone_init(src_name: str, out_name: str, shape=None):
+            """Clone the startup op initializing ``src_name``, retargeting
+            output (and optionally shape) to ``out_name``."""
+            op = init_by_out.get(src_name)
+            if op is None:
+                return
+            attrs = dict(op.attrs)
+            if shape is not None and "shape" in attrs:
+                attrs["shape"] = list(shape)
+            outs = {slot: [out_name if n == src_name else n for n in names]
+                    for slot, names in op.outputs.items()}
+            gb.ops.append(Operator(gb, op.type, op.inputs, outs, attrs))
+
+        needed_lr = set(self.lr_names)
+        if self.lr_ops:
+            for op in self.lr_ops:
+                needed_lr |= {n for n in op.input_arg_names()
+                              if src_main.var_or_none(n) is not None
+                              and src_main.var(n).persistable}
+        for n in sorted(needed_lr):
+            v = src_main.var_or_none(n)
+            if v is not None and v.persistable:
+                gb.vars[n] = Variable.from_dict(gb, v.to_dict())
+                clone_init(n, n)
+
+        for sec in self._ep_sections(endpoint):
+            pvar = src_main.var(sec.param)
+            sec_shape = (sec.rows,) + tuple(pvar.shape[1:])
+            gb.create_var(name=sec.pname, shape=sec_shape, dtype=pvar.dtype,
+                          persistable=True)
+            if not sec.sliced:
+                clone_init(sec.param, sec.pname)
+            else:
+                # same named draw as the local init, then slice out our rows
+                full = f"{sec.param}@FULL"
+                if full not in gb.vars:
+                    gb.create_var(name=full, shape=pvar.shape,
+                                  dtype=pvar.dtype)
+                    clone_init(sec.param, full)
+                gb.append_op(
+                    "slice", {"Input": [full]}, {"Out": [sec.pname]},
+                    {"axes": [0], "starts": [sec.offset],
+                     "ends": [sec.offset + sec.rows]})
+
+            opt_op = opt_by_param[sec.param]
+            for n in set(opt_op.input_arg_names()):
+                if n in (sec.param, sec.grad) or n in self.lr_names:
+                    continue
+                v = src_main.var(n)
+                nn = self._acc_name(n, sec)
+                shape = self._section_shape(v, sec, pvar.shape)
+                if nn in gb.vars:
+                    continue
+                gb.create_var(name=nn, shape=shape, dtype=v.dtype,
+                              persistable=True)
+                clone_init(n, nn, shape=shape)
+        return prog

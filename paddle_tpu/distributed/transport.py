@@ -1,0 +1,234 @@
+"""Framed-TCP variable transport: RPC client/server for pserver mode.
+
+TPU-native replacement for the reference's gRPC transport
+(``paddle/fluid/operators/distributed/grpc_client.h:175-206``,
+``grpc_server.cc:82,117``, ``rpc_server.cc`` request barriers).  Runs over
+DCN between TPU-VM hosts; intra-pod dense traffic rides XLA collectives
+instead (parallel/), so this path only carries pserver/sparse variables.
+
+Wire format (little-endian), one frame per request and per response:
+
+    u32  body_len
+    body = u8 msg_type | i32 trainer_id | u16 name_len | name | payload
+
+Connections are persistent; each client socket is a serial
+request/response channel (guarded by a lock), and the client fans out to
+many endpoints concurrently via a shared thread pool — the analogue of the
+reference's async completion queues + ``Wait`` (``grpc_client.h:180-213``).
+Server handlers may block (sync-mode barriers), so the server is
+thread-per-connection like the reference's handler thread pools.
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+from . import serde
+
+# message types (request)
+SEND_VAR = 1
+GET_VAR = 2
+BATCH_BARRIER = 3
+FETCH_BARRIER = 4
+COMPLETE = 5
+PREFETCH = 6
+CHECKPOINT_NOTIFY = 7
+# message types (response)
+OK = 0
+ERR = 255
+
+_HDR = struct.Struct("<BiH")  # msg_type, trainer_id, name_len
+
+
+def _send_frame(sock: socket.socket, msg_type: int, trainer_id: int,
+                name: str, payload: bytes = b"") -> None:
+    nm = name.encode("utf-8")
+    body = _HDR.pack(msg_type, trainer_id, len(nm)) + nm + payload
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            return None
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket):
+    raw = _recv_exact(sock, 4)
+    if raw is None:
+        return None
+    (blen,) = struct.unpack("<I", raw)
+    body = _recv_exact(sock, blen)
+    if body is None:
+        return None
+    msg_type, trainer_id, name_len = _HDR.unpack_from(body, 0)
+    off = _HDR.size
+    name = body[off:off + name_len].decode("utf-8")
+    payload = body[off + name_len:]
+    return msg_type, trainer_id, name, payload
+
+
+class RPCServer:
+    """Serves variable requests against a pluggable service object.
+
+    ``service.handle(msg_type, trainer_id, name, payload)`` returns
+    ``(resp_type, resp_payload)`` and may block (barriers).  Reference:
+    ``AsyncGRPCServer`` + ``RequestHandler`` (``grpc_server.cc:82``,
+    ``request_handler_impl.cc``).
+    """
+
+    def __init__(self, endpoint: str, service):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self.service = service
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        frame = _recv_frame(self.request)
+                    except OSError:
+                        return
+                    if frame is None:
+                        return
+                    msg_type, tid, name, payload = frame
+                    try:
+                        rtype, rpayload = outer.service.handle(
+                            msg_type, tid, name, payload)
+                    except Exception as e:  # propagate as ERR frame
+                        rtype, rpayload = ERR, repr(e).encode("utf-8")
+                    try:
+                        _send_frame(self.request, rtype, tid, name, rpayload)
+                    except OSError:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, int(port)), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True, name=f"rpc-server-{endpoint}")
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class _Conn:
+    def __init__(self, endpoint: str, connect_timeout: float):
+        host, port = endpoint.rsplit(":", 1)
+        self.lock = threading.Lock()
+        deadline = time.time() + connect_timeout
+        last = None
+        while True:
+            try:
+                self.sock = socket.create_connection((host, int(port)), timeout=30.0)
+                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.sock.settimeout(None)
+                return
+            except OSError as e:  # pserver may not be up yet (_wait_ps_ready)
+                last = e
+                if time.time() > deadline:
+                    raise ConnectionError(
+                        f"cannot reach pserver at {endpoint}: {last}")
+                time.sleep(0.1)
+
+
+class RPCClient:
+    """Trainer-side client: one persistent connection per endpoint +
+    a shared pool for concurrent fan-out (``GRPCClient`` analogue)."""
+
+    _CONNECT_TIMEOUT = 120.0
+
+    def __init__(self, trainer_id: int = 0):
+        self.trainer_id = trainer_id
+        self._conns: Dict[str, _Conn] = {}
+        self._conns_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="rpc-client")
+
+    def _conn(self, endpoint: str) -> _Conn:
+        with self._conns_lock:
+            c = self._conns.get(endpoint)
+            if c is None:
+                c = _Conn(endpoint, self._CONNECT_TIMEOUT)
+                self._conns[endpoint] = c
+            return c
+
+    def _request(self, endpoint: str, msg_type: int, name: str = "",
+                 payload: bytes = b""):
+        c = self._conn(endpoint)
+        with c.lock:
+            _send_frame(c.sock, msg_type, self.trainer_id, name, payload)
+            frame = _recv_frame(c.sock)
+        if frame is None:
+            raise ConnectionError(f"pserver {endpoint} closed the connection")
+        rtype, _, _, rpayload = frame
+        if rtype == ERR:
+            raise RuntimeError(
+                f"pserver {endpoint} error for {name!r}: "
+                f"{rpayload.decode('utf-8', 'replace')}")
+        return rpayload
+
+    # -- public API (grpc_client.h:180-206 signatures) ---------------------
+    def send_var(self, endpoint: str, name: str, value) -> None:
+        self._request(endpoint, SEND_VAR, name, serde.dumps_value(value))
+
+    def get_var(self, endpoint: str, name: str):
+        return serde.loads_value(self._request(endpoint, GET_VAR, name))
+
+    def prefetch(self, endpoint: str, table_name: str, ids):
+        return serde.loads_value(
+            self._request(endpoint, PREFETCH, table_name, serde.dumps_value(ids)))
+
+    def batch_barrier(self, endpoint: str) -> None:
+        self._request(endpoint, BATCH_BARRIER)
+
+    def fetch_barrier(self, endpoint: str) -> None:
+        self._request(endpoint, FETCH_BARRIER)
+
+    def checkpoint_notify(self, endpoint: str, dirname: str) -> None:
+        self._request(endpoint, CHECKPOINT_NOTIFY, dirname)
+
+    def complete(self, endpoint: str) -> None:
+        self._request(endpoint, COMPLETE)
+
+    def parallel(self, calls):
+        """Run [(fn, args...), ...] concurrently; reraise first error."""
+        futs = [self._pool.submit(fn, *args) for fn, *args in calls]
+        return [f.result() for f in futs]
+
+
+# process-wide client singleton per trainer id (connections persist across
+# executor steps, like the reference's RPCClient::GetInstance)
+_clients: Dict[int, RPCClient] = {}
+_clients_lock = threading.Lock()
+
+
+def get_client(trainer_id: int = 0) -> RPCClient:
+    with _clients_lock:
+        c = _clients.get(trainer_id)
+        if c is None:
+            c = RPCClient(trainer_id)
+            _clients[trainer_id] = c
+        return c

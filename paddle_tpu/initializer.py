@@ -52,7 +52,8 @@ class UniformInitializer(Initializer):
         block.append_op(
             "uniform_random", {}, {"Out": [var.name]},
             {"shape": list(var.shape), "dtype": var.dtype,
-             "min": self.low, "max": self.high, "seed": self.seed},
+             "min": self.low, "max": self.high, "seed": self.seed,
+             "seed_name": var.name},
         )
 
 
@@ -64,7 +65,8 @@ class NormalInitializer(Initializer):
         block.append_op(
             "gaussian_random", {}, {"Out": [var.name]},
             {"shape": list(var.shape), "dtype": var.dtype,
-             "mean": self.loc, "std": self.scale, "seed": self.seed},
+             "mean": self.loc, "std": self.scale, "seed": self.seed,
+             "seed_name": var.name},
         )
 
 
@@ -73,7 +75,8 @@ class TruncatedNormalInitializer(NormalInitializer):
         block.append_op(
             "truncated_gaussian_random", {}, {"Out": [var.name]},
             {"shape": list(var.shape), "dtype": var.dtype,
-             "mean": self.loc, "std": self.scale, "seed": self.seed},
+             "mean": self.loc, "std": self.scale, "seed": self.seed,
+             "seed_name": var.name},
         )
 
 

@@ -261,6 +261,10 @@ def _truncated_gaussian_random(ctx, ins, attrs):
 
 def _seed_key(ctx, attrs):
     seed = attrs.get("seed", 0)
+    name = attrs.get("seed_name")
+    if name:
+        # initializer ops: key by var name → order/partition-independent
+        return ctx.named_prng(name, seed)
     if seed:
         return jax.random.PRNGKey(seed)
     return ctx.prng()

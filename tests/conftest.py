@@ -19,3 +19,9 @@ jax.config.update("jax_enable_x64", True)
 # env JAX_PLATFORMS alone is not honored once the axon TPU plugin registers;
 # force the CPU backend explicitly so tests run on the virtual 8-device mesh
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration test (subprocess clusters, "
+        "convergence runs)")

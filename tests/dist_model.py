@@ -1,0 +1,57 @@
+"""Shared tiny model for the distributed tests (the dist_mnist.py role in
+reference tests/unittests/test_dist_base.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.program import Program, program_guard
+
+
+def build(lr=0.1, optimizer="sgd", decay=False):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 16, act="tanh")
+        pred = fluid.layers.fc(h, 1)
+        diff = fluid.layers.elementwise_sub(pred, y)
+        loss = fluid.layers.mean(fluid.layers.square(diff))
+        if decay:
+            lr = fluid.layers.learning_rate_scheduler.exponential_decay(
+                lr, decay_steps=5, decay_rate=0.9)
+        if optimizer == "adam":
+            fluid.optimizer.Adam(lr).minimize(loss)
+        else:
+            fluid.optimizer.SGD(lr).minimize(loss)
+    return prog, startup, loss
+
+
+def batches(n_steps, bs=8, seed=7):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(4, 1).astype("float32")
+    out = []
+    for _ in range(n_steps):
+        x = rng.randn(bs, 4).astype("float32")
+        y = (x @ w + 0.1 * rng.randn(bs, 1)).astype("float32")
+        out.append((x, y))
+    return out
+
+
+def param_values(prog, scope):
+    names = sorted(p.name for p in prog.all_parameters())
+    return {n: np.asarray(scope.find_var(n)) for n in names}
+
+
+def run_local(n_steps, optimizer="sgd", decay=False):
+    from paddle_tpu.core.executor import Executor, Scope
+
+    prog, startup, loss = build(optimizer=optimizer, decay=decay)
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    losses = []
+    for x, y in batches(n_steps):
+        (lv,) = exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss],
+                        scope=scope)
+        losses.append(float(lv))
+    return losses, param_values(prog, scope)

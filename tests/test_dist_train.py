@@ -1,0 +1,195 @@
+"""End-to-end pserver-mode training tests.
+
+In-process variant: pservers and trainers run as threads with private
+Scopes (the details/*_op_handle_test.cc style of multi-role-in-one-process
+testing).  Subprocess variant: the reference ``test_dist_base.py:31,197``
+pattern — 2 pservers + 2 trainers as localhost processes, trainer results
+compared against the single-process run.
+"""
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.executor import Executor, Scope
+from paddle_tpu.distributed import notify_complete
+
+from dist_model import batches, build, param_values, run_local
+
+N_STEPS = 5
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _transpiler(trainer_id, endpoints, sync_mode=True, slice_var_up=False,
+                optimizer="sgd", decay=False):
+    prog, startup, loss = build(optimizer=optimizer, decay=decay)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.slice_var_up = slice_var_up
+    cfg.min_block_size = 4
+    t = fluid.DistributeTranspiler(config=cfg)
+    t.transpile(trainer_id=trainer_id, program=prog,
+                pservers=",".join(endpoints), trainers=2,
+                sync_mode=sync_mode, startup_program=startup)
+    return t, prog, startup, loss
+
+
+def _pserver_thread(endpoints, idx, sync_mode, slice_var_up, optimizer,
+                    decay, errors):
+    try:
+        t, _, _, _ = _transpiler(0, endpoints, sync_mode, slice_var_up,
+                                 optimizer, decay)
+        ep = endpoints[idx]
+        scope = Scope()
+        exe = Executor()
+        exe.run(t.get_startup_program(ep), scope=scope)
+        exe.run(t.get_pserver_program(ep), scope=scope)
+    except Exception as e:  # pragma: no cover
+        errors.append(("pserver", idx, e))
+
+
+def _trainer_thread(endpoints, tid, sync_mode, slice_var_up, optimizer,
+                    decay, results, errors):
+    try:
+        t, prog, startup, loss = _transpiler(tid, endpoints, sync_mode,
+                                             slice_var_up, optimizer, decay)
+        tp = t.get_trainer_program()
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        losses = []
+        for x, y in batches(N_STEPS):
+            half = slice(tid * 4, (tid + 1) * 4)
+            (lv,) = exe.run(tp, feed={"x": x[half], "y": y[half]},
+                            fetch_list=[loss], scope=scope)
+            losses.append(float(lv))
+        results[tid] = (losses, param_values(prog, scope))
+        notify_complete(endpoints, trainer_id=tid)
+    except Exception as e:  # pragma: no cover
+        errors.append(("trainer", tid, e))
+        try:
+            notify_complete(endpoints, trainer_id=tid)
+        except Exception:
+            pass
+
+
+def _run_cluster(sync_mode=True, slice_var_up=False, optimizer="sgd",
+                 decay=False):
+    endpoints = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    errors, results = [], {}
+    threads = [
+        threading.Thread(target=_pserver_thread,
+                         args=(endpoints, i, sync_mode, slice_var_up,
+                               optimizer, decay, errors), daemon=True)
+        for i in range(2)
+    ] + [
+        threading.Thread(target=_trainer_thread,
+                         args=(endpoints, tid, sync_mode, slice_var_up,
+                               optimizer, decay, results, errors),
+                         daemon=True)
+        for tid in range(2)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+        assert not th.is_alive(), "distributed run timed out"
+    assert not errors, errors
+    return results
+
+
+@pytest.mark.parametrize("slice_var_up", [False, True],
+                         ids=["whole-var", "sliced"])
+def test_sync_pserver_matches_local(slice_var_up):
+    """2 trainers × half batches + mean merge == local full batches."""
+    results = _run_cluster(sync_mode=True, slice_var_up=slice_var_up)
+    _, local_params = run_local(N_STEPS)
+    for tid in (0, 1):
+        _, dist_params = results[tid]
+        for name, want in local_params.items():
+            np.testing.assert_allclose(
+                dist_params[name], want, rtol=2e-4, atol=2e-5,
+                err_msg=f"trainer {tid} param {name}")
+
+
+def test_sync_pserver_with_lr_decay_matches_local():
+    results = _run_cluster(sync_mode=True, decay=True)
+    _, local_params = run_local(N_STEPS, decay=True)
+    _, dist_params = results[0]
+    for name, want in local_params.items():
+        np.testing.assert_allclose(dist_params[name], want,
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_async_pserver_trains():
+    """Async mode: no barriers; losses must still go down."""
+    results = _run_cluster(sync_mode=False)
+    losses, _ = results[0]
+    assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
+def test_dist_subprocess_matches_local():
+    """The test_dist_base.py pattern: 2 pservers + 2 trainers as real
+    localhost processes; trainer params must match the local run."""
+    endpoints = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    here = os.path.dirname(os.path.abspath(__file__))
+    env_base = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "JAX_ENABLE_X64": "1",  # match the conftest env of the local run
+        "PADDLE_PSERVER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_TRAINERS_NUM": "2",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(here), here,
+             os.environ.get("PYTHONPATH", "")]),
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        procs = []
+        for i, ep in enumerate(endpoints):
+            env = {**env_base, "PADDLE_TRAINING_ROLE": "PSERVER",
+                   "PADDLE_CURRENT_ENDPOINT": ep}
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(here, "dist_runner.py")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        trainers = []
+        for tid in range(2):
+            env = {**env_base, "PADDLE_TRAINING_ROLE": "TRAINER",
+                   "PADDLE_TRAINER_ID": str(tid),
+                   "DIST_OUT": os.path.join(tmp, f"trainer{tid}.npz")}
+            p = subprocess.Popen(
+                [sys.executable, os.path.join(here, "dist_runner.py")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            trainers.append(p)
+        for p in trainers + procs:
+            try:
+                out, err = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+                pytest.fail(f"distributed process timed out:\n{err.decode()}")
+            assert p.returncode == 0, err.decode()
+
+        _, local_params = run_local(N_STEPS)
+        for tid in range(2):
+            data = np.load(os.path.join(tmp, f"trainer{tid}.npz"))
+            for name, want in local_params.items():
+                np.testing.assert_allclose(
+                    data[name], want, rtol=2e-4, atol=2e-5,
+                    err_msg=f"trainer {tid} param {name}")

@@ -1,0 +1,107 @@
+"""Program-structure assertions for DistributeTranspiler (reference
+tests/unittests/test_dist_transpiler.py pattern: assert the generated op
+sequences, no sockets involved)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from dist_model import build
+
+EPS = "127.0.0.1:7164,127.0.0.1:7165"
+
+
+def _transpile(optimizer="sgd", slice_var_up=False, min_block=8192,
+               sync_mode=True, decay=False):
+    prog, startup, loss = build(optimizer=optimizer, decay=decay)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.slice_var_up = slice_var_up
+    cfg.min_block_size = min_block
+    t = fluid.DistributeTranspiler(config=cfg)
+    t.transpile(trainer_id=0, program=prog, pservers=EPS, trainers=2,
+                sync_mode=sync_mode, startup_program=startup)
+    return t
+
+
+def test_trainer_program_structure():
+    t = _transpile()
+    tp = t.get_trainer_program()
+    types = [op.type for op in tp.global_block.ops]
+    assert "send" in types and "recv" in types
+    assert "send_barrier" in types and "fetch_barrier" in types
+    assert "sgd" not in types  # optimize moved to pserver
+    assert types.index("send") < types.index("send_barrier") < \
+        types.index("recv") < types.index("fetch_barrier")
+
+
+def test_pserver_program_structure():
+    t = _transpile()
+    for ep in t.endpoints:
+        pp = t.get_pserver_program(ep)
+        ops0 = [op.type for op in pp.global_block.ops]
+        assert ops0 == ["listen_and_serv"]
+        ls = pp.global_block.ops[0]
+        g2b = ls.attr("grad_to_block_id")
+        assert g2b, f"no optimize blocks on {ep}"
+        for bidx in g2b.values():
+            sub = [op.type for op in pp.blocks[bidx].ops]
+            assert sub == ["sgd"]
+    # every param section lands on exactly one endpoint
+    assigned = [s.endpoint for s in t.sections]
+    assert set(assigned) <= set(t.endpoints)
+    # 4 params (2 w + 2 b) round-robined across 2 endpoints
+    assert len(t.sections) == 4
+
+
+def test_sliced_sections_and_concat():
+    t = _transpile(slice_var_up=True, min_block=4)
+    sliced = [s for s in t.sections if s.sliced]
+    assert sliced, "expected sliced sections with tiny min_block_size"
+    tp = t.get_trainer_program()
+    types = [op.type for op in tp.global_block.ops]
+    assert "split" in types and "concat" in types
+    # startup initializes sections by slicing the full named draw
+    for ep in t.endpoints:
+        sp = t.get_startup_program(ep)
+        stypes = [op.type for op in sp.global_block.ops]
+        assert "slice" in stypes
+
+
+def test_async_mode_has_no_barriers():
+    t = _transpile(sync_mode=False)
+    tp = t.get_trainer_program()
+    types = [op.type for op in tp.global_block.ops]
+    assert "send_barrier" not in types and "fetch_barrier" not in types
+
+
+def test_lr_decay_moves_to_pserver():
+    t = _transpile(decay=True)
+    tp = t.get_trainer_program()
+    from paddle_tpu.core.program import OP_ROLE_ATTR, OpRole
+    assert not any(op.attr(OP_ROLE_ATTR) == OpRole.LRSched
+                   for op in tp.global_block.ops)
+    pp = t.get_pserver_program(t.endpoints[0])
+    ls = pp.global_block.ops[0]
+    assert ls.attr("lr_block") >= 0
+    lr_ops = [op.type for op in pp.blocks[ls.attr("lr_block")].ops]
+    assert "increment" in lr_ops
+
+
+def test_pserver_startup_init_matches_local():
+    """Pserver-side init must be bit-identical to the local run's values
+    (named-PRNG initializers)."""
+    from paddle_tpu.core.executor import Executor, Scope
+
+    t = _transpile(slice_var_up=True, min_block=4)
+    prog, startup, _ = build()
+    local_scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=local_scope)
+
+    for ep in t.endpoints:
+        sp = t.get_startup_program(ep)
+        ps_scope = Scope()
+        exe.run(sp, scope=ps_scope)
+        for sec in t._ep_sections(ep):
+            got = np.asarray(ps_scope.find_var(sec.pname))
+            want = np.asarray(local_scope.find_var(sec.param))[
+                sec.offset:sec.offset + sec.rows]
+            np.testing.assert_allclose(got, want, rtol=0, atol=0)

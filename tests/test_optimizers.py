@@ -18,7 +18,7 @@ OPTIMIZERS = [
     lambda: fluid.optimizer.Adadelta(1.0, rho=0.9),
     lambda: fluid.optimizer.RMSProp(0.05),
     lambda: fluid.optimizer.Ftrl(0.5),
-    lambda: fluid.optimizer.LarsMomentum(1.0, momentum=0.9, lars_coeff=0.5),
+    lambda: fluid.optimizer.LarsMomentum(1.0, momentum=0.9, lars_coeff=0.2),
 ]
 
 

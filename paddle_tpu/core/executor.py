@@ -218,6 +218,13 @@ class Executor:
     def _run_segmented(self, program, feed, fetch_names, scope, return_numpy):
         segs = self._segment_plan(program, tuple(sorted(feed)), tuple(fetch_names))
         fetched: Dict[str, object] = {}
+        # host ops read their inputs from the scope; make fed values visible
+        for seg in segs:
+            if seg[0] == "host":
+                for op in seg[1]:
+                    for n in op.input_arg_names():
+                        if n in feed:
+                            scope.set_var(n, feed[n])
         for seg in segs:
             if seg[0] == "host":
                 for op in seg[1]:
@@ -235,7 +242,11 @@ class Executor:
             v = fetched.get(n)
             if v is None:
                 v = scope.find_var(n)
-            if return_numpy and v is not None and not isinstance(v, SelectedRows):
+            if v is None:
+                raise RuntimeError(
+                    f"fetch target {n!r} was not produced by any program "
+                    f"segment and is not in the scope")
+            if return_numpy and not isinstance(v, SelectedRows):
                 v = np.asarray(v)
             out.append(v)
         return out

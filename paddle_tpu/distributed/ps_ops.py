@@ -93,13 +93,15 @@ def _checkpoint_notify(exe, program, op, scope):
 @register_host_op("prefetch")
 def _prefetch(exe, program, op, scope):
     """Distributed-table row fetch (prefetch_op.cc:27): ids → per-shard
-    remote gather → rows reassembled in id order."""
+    remote gather → rows reassembled in id order, shaped exactly like the
+    local ``lookup_table`` output (trailing [..., 1] ids dim squeezed)."""
     ids_name = op.input("Ids")[0]
     out_name = op.output("Out")[0]
     table = op.attr("table_name")
     sections = op.attr("sections")    # [[endpoint, row_offset, rows], ...]
     client = transport.get_client(op.attr("trainer_id", 0))
-    ids = np.asarray(scope.find_var(ids_name)).reshape(-1).astype(np.int64)
+    ids_arr = np.asarray(scope.find_var(ids_name))
+    ids = ids_arr.reshape(-1).astype(np.int64)
 
     calls, masks = [], []
     for ep, offset, rows in sections:
@@ -112,7 +114,27 @@ def _prefetch(exe, program, op, scope):
     out = np.zeros((ids.shape[0], width), results[0].dtype)
     for mask, rows in zip(masks, results):
         out[mask] = rows
-    scope.set_var(out_name, out)
+    lead = (ids_arr.shape[:-1] if ids_arr.ndim >= 2 and ids_arr.shape[-1] == 1
+            else ids_arr.shape)
+    scope.set_var(out_name, out.reshape(tuple(lead) + (width,)))
+
+
+@register_host_op("split_selected_rows")
+def _split_selected_rows(exe, program, op, scope):
+    """Split a SelectedRows gradient into per-shard slices with row ids
+    rebased to shard-local (reference split_selected_rows_op.cc)."""
+    x = scope.find_var(op.input("X")[0])
+    if not isinstance(x, SelectedRows):
+        raise TypeError(
+            f"split_selected_rows: {op.input('X')[0]!r} is not a "
+            f"SelectedRows gradient (got {type(x).__name__}); distributed "
+            "tables require embedding(is_sparse=True)")
+    rows = np.asarray(x.rows)
+    vals = np.asarray(x.values)
+    for out_name, (offset, cnt) in zip(op.output("Out"), op.attr("sections")):
+        m = (rows >= offset) & (rows < offset + cnt)
+        scope.set_var(out_name,
+                      SelectedRows(rows[m] - offset, vals[m], cnt))
 
 
 # ---------------------------------------------------------------------------
@@ -283,6 +305,16 @@ class PServerLoop:
             return OK, serde.dumps_value(_to_host(val))
 
         if msg_type == PREFETCH:
+            # same round barrier as GET: the next forward's lookup must see
+            # this round's sparse update applied
+            if self.sync_mode:
+                with self.lock:
+                    target = self.rounds_sent[trainer_id]
+                    while self.applied_rounds < target and not self.exit:
+                        self.lock.wait(timeout=1.0)
+            if self.error is not None:
+                raise RuntimeError(
+                    f"pserver optimize pass failed: {self.error!r}")
             info = self.dist_tables[name]
             ids = np.asarray(serde.loads_value(payload)).reshape(-1)
             table = np.asarray(self.scope.find_var(info["var"]))

@@ -45,10 +45,11 @@ class _Section:
     """One row-range shard of a parameter assigned to one endpoint."""
 
     def __init__(self, param: str, grad: str, index: int, offset: int,
-                 rows: int, total: int):
+                 rows: int, total: int, is_table: bool = False):
         self.param, self.grad = param, grad
         self.index, self.offset, self.rows = index, offset, rows
         self.sliced = total > 1
+        self.is_table = is_table
         self.endpoint: str = ""
 
     @property
@@ -112,8 +113,23 @@ class DistributeTranspiler:
             op.input("W")[0] for op in block0.ops
             if op.type == "lookup_table" and op.attr("is_sparse", False)}
 
+        # distributed lookup tables: sharded by row range across ALL
+        # pservers, served by remote prefetch (reference
+        # _distributed_lookup_table, layers/nn.py:272-326,
+        # operators/prefetch_op.cc:27)
+        self.dist_table_ops: Dict[str, List] = {}
+        for op in block0.ops:
+            if op.type == "lookup_table" and op.attr("is_distributed", False):
+                pad = op.attr("padding_idx", -1)
+                if pad not in (None, -1):
+                    raise NotImplementedError(
+                        "padding_idx is not supported for distributed "
+                        "lookup tables")
+                self.dist_table_ops.setdefault(op.input("W")[0], []).append(op)
+
         # param sections in deterministic program order
         self.sections: List[_Section] = []
+        self.table_sections: List[_Section] = []
         self.param_sections: Dict[str, List[_Section]] = {}
         for op in self.opt_ops:
             pname = op.input("Param")[0]
@@ -122,6 +138,22 @@ class DistributeTranspiler:
             numel = 1
             for s in pvar.shape:
                 numel *= int(s)
+            if pname in self.dist_table_ops:
+                # one shard per endpoint, contiguous rows, global ids
+                # rebased by the trainer-side split_selected_rows
+                parts = min(len(self.endpoints), int(pvar.shape[0]))
+                base, extra = divmod(int(pvar.shape[0]), parts)
+                rows = [base + (1 if i < extra else 0) for i in range(parts)]
+                secs, off = [], 0
+                for i, r in enumerate(rows):
+                    s = _Section(pname, gname, i, off, r, len(rows),
+                                 is_table=True)
+                    s.endpoint = self.endpoints[i]
+                    secs.append(s)
+                    off += r
+                self.param_sections[pname] = secs
+                self.table_sections.extend(secs)
+                continue
             if self.config.slice_var_up and pname not in self.sparse_params:
                 rows = _split_rows(int(pvar.shape[0]), numel,
                                    len(self.endpoints),
@@ -156,9 +188,33 @@ class DistributeTranspiler:
         rpc_attrs = {"trainer_id": self.trainer_id,
                      OP_ROLE_ATTR: OpRole.RPC}
 
+        # distributed tables: forward lookup → remote prefetch host op
+        # (reference rewrite: lookup_table → split_ids/prefetch/merge_ids).
+        # The trainer never materializes the table: the grad op reads
+        # height/dtype from attrs and the var itself is dropped.
+        for i, op in enumerate(block.ops):
+            if (op.type == "lookup_table"
+                    and op.input("W")[0] in self.dist_table_ops):
+                table = op.input("W")[0]
+                secs = self.param_sections[table]
+                block.ops[i] = Operator(
+                    block, "prefetch",
+                    {"Ids": op.inputs["Ids"]}, {"Out": op.outputs["Out"]},
+                    {**rpc_attrs, "table_name": table,
+                     "sections": [[s.endpoint, s.offset, s.rows]
+                                  for s in secs]})
+            elif (op.type == "lookup_table_grad"
+                    and op.input("W")[0] in self.dist_table_ops):
+                tvar = self.origin_program.global_block.var(op.input("W")[0])
+                op.inputs = {k: v for k, v in op.inputs.items() if k != "W"}
+                op.set_attr("height", int(tvar.shape[0]))
+                op.set_attr("w_dtype", tvar.dtype)
+        for table in self.dist_table_ops:
+            block.vars.pop(table, None)
+
         # device: split grads into sections
         for p, secs in self.param_sections.items():
-            if len(secs) == 1:
+            if len(secs) == 1 or secs[0].is_table:
                 continue
             for s in secs:
                 gvar = block.var(s.grad)
@@ -171,16 +227,29 @@ class DistributeTranspiler:
                 {"axis": 0, "sections": [s.rows for s in secs],
                  OP_ROLE_ATTR: OpRole.Dist})
 
+        # host: split SelectedRows table grads by shard range (global row
+        # ids rebased to shard-local; reference split_selected_rows_op)
+        for table, secs in self.param_sections.items():
+            if not secs[0].is_table:
+                continue
+            block.append_op(
+                "split_selected_rows", {"X": [secs[0].grad]},
+                {"Out": [s.gname for s in secs]},
+                {**rpc_attrs, "sections": [[s.offset, s.rows] for s in secs]})
+
         # host: send grad sections → pservers
+        send_secs = self.sections + self.table_sections
         block.append_op(
-            "send", {"X": [s.gname for s in self.sections]}, {},
-            {**rpc_attrs, "epmap": [s.endpoint for s in self.sections]})
+            "send", {"X": [s.gname for s in send_secs]}, {},
+            {**rpc_attrs, "epmap": [s.endpoint for s in send_secs]})
         if self.sync_mode:
             block.append_op("send_barrier", {}, {},
                             {**rpc_attrs, "endpoints": self.endpoints})
 
         # host: recv param sections ← pservers
         for p, secs in self.param_sections.items():
+            if secs[0].is_table:
+                continue
             for s in secs:
                 if s.sliced:
                     pvar = block.var(p)
@@ -197,16 +266,32 @@ class DistributeTranspiler:
 
         # device: concat sections back into the parameters
         for p, secs in self.param_sections.items():
-            if len(secs) == 1:
+            if len(secs) == 1 or secs[0].is_table:
                 continue
             block.append_op(
                 "concat", {"X": [s.pname for s in secs]}, {"Out": [p]},
                 {"axis": 0, OP_ROLE_ATTR: OpRole.Dist})
         return prog
 
+    def get_trainer_startup_program(self) -> Program:
+        """Trainer startup without distributed-table initialization: the
+        table lives only as pserver shards, so a trainer must not allocate
+        the full [V, D] array at startup (the reference equivalently
+        splices table init out of the trainer startup program)."""
+        prog = self.startup_program.clone()
+        block = prog.global_block
+        if self.dist_table_ops:
+            block.ops = [
+                op for op in block.ops
+                if not (set(op.output_arg_names()) & set(self.dist_table_ops))]
+            for table in self.dist_table_ops:
+                block.vars.pop(table, None)
+        return prog
+
     # -- pserver side ------------------------------------------------------
     def _ep_sections(self, endpoint: str) -> List[_Section]:
-        return [s for s in self.sections if s.endpoint == endpoint]
+        return [s for s in self.sections + self.table_sections
+                if s.endpoint == endpoint]
 
     def _acc_name(self, acc: str, sec: _Section) -> str:
         return f"{acc}@BLOCK{sec.index}" if sec.sliced else acc
@@ -306,14 +391,23 @@ class DistributeTranspiler:
                 "lr_fetch": lr_fetch,
                 "dense_merge": "mean",
                 "persist_names": sorted(set(persist_names)),
-                "dist_tables": {},
+                "dist_tables": {
+                    s.param: {"var": s.pname, "offset": s.offset,
+                              "rows": s.rows}
+                    for s in secs if s.is_table},
                 OP_ROLE_ATTR: OpRole.RPC,
             })
         return prog
 
     def get_startup_program(self, endpoint: str) -> Program:
         """Pserver startup: initialize this endpoint's param sections (and
-        accumulators / LR vars) with values identical to the local run."""
+        accumulators / LR vars) with values identical to the local run.
+
+        Sliced vars draw the full named init and slice out their rows, so
+        the full array exists transiently *inside the startup executable*
+        (freed by XLA when startup returns; steady-state holds only the
+        shard).  For tables too large even for that, pre-shard offline and
+        load with io.load_vars instead of initializer ops."""
         src_startup = self.startup_program.global_block
         src_main = self.origin_program.global_block
         init_by_out: Dict[str, Operator] = {}

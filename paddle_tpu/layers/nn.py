@@ -78,11 +78,11 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     gradient a SelectedRows row-slice pair (no dense [V, D] grad is ever
     materialised); ``is_distributed`` marks the table for the pserver
     transpiler's sharded-table path."""
-    if is_distributed:
-        raise NotImplementedError(
-            "is_distributed=True requires the DistributeTranspiler "
-            "sharded-table path; pass is_sparse=True for local sparse "
-            "gradients")
+    if is_distributed and not is_sparse:
+        raise ValueError(
+            "embedding(is_distributed=True) requires is_sparse=True: the "
+            "sharded-table gradient travels as a SelectedRows row slice "
+            "(reference nn.py:272 remote-prefetch path)")
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(param_attr, size, dtype)
     out_shape = tuple(input.shape[:-1] if input.shape[-1] == 1 else input.shape) + (size[1],)

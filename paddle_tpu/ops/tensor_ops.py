@@ -153,19 +153,28 @@ def _lookup_table_grad(ctx, ins, attrs):
     lookup_table_op.cc grad → SelectedRows, selected_rows.h:32)."""
     from ..core.selected_rows import SelectedRows
 
-    w, ids = ins["W"][0], ins["Ids"][0]
+    ids = ins["Ids"][0]
     gout = ins["Out@GRAD"][0]
     if gout is None:
         return {}
+    # W may be absent: the DistributeTranspiler strips the table var from
+    # the trainer (only its prefetched rows exist there) and supplies
+    # height/dtype as attrs instead
+    w = ins["W"][0] if ins.get("W") else None
+    height = int(attrs["height"]) if w is None else w.shape[0]
+    wdtype = np_dtype(attrs["w_dtype"]) if w is None else w.dtype
     if ids.ndim >= 2 and ids.shape[-1] == 1:
         ids = ids.squeeze(-1)
     pad = attrs.get("padding_idx", -1)
     if pad is not None and pad != -1:
         gout = gout * (ids != pad)[..., None].astype(gout.dtype)
     rows = ids.reshape(-1)
-    vals = gout.reshape((-1,) + gout.shape[ids.ndim:]).astype(w.dtype)
+    vals = gout.reshape((-1,) + gout.shape[ids.ndim:]).astype(wdtype)
     if attrs.get("is_sparse", False):
-        return {"W@GRAD": [SelectedRows(rows, vals, w.shape[0])]}
+        return {"W@GRAD": [SelectedRows(rows, vals, height)]}
+    if w is None:
+        dense = jnp.zeros((height,) + vals.shape[1:], wdtype)
+        return {"W@GRAD": [dense.at[rows].add(vals)]}
     return {"W@GRAD": [jnp.zeros_like(w).at[rows].add(vals)]}
 
 

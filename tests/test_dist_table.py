@@ -1,0 +1,173 @@
+"""Distributed lookup table: sharded sparse embedding across pservers with
+remote prefetch (reference _distributed_lookup_table rewrite +
+prefetch_op.cc:27 + lookup_sparse_table semantics)."""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.distributed import notify_complete, transport
+
+VOCAB, DIM = 64, 8
+N_STEPS = 4
+BS = 8
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build(distributed, optimizer="sgd"):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        ids = fluid.layers.data("ids", [5], dtype="int64")
+        y = fluid.layers.data("y", [1])
+        emb = fluid.layers.embedding(
+            ids, size=[VOCAB, DIM], is_sparse=True,
+            is_distributed=distributed)
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        pred = fluid.layers.fc(pooled, 1)
+        diff = fluid.layers.elementwise_sub(pred, y)
+        loss = fluid.layers.mean(fluid.layers.square(diff))
+        if optimizer == "adam":
+            fluid.optimizer.Adam(0.1).minimize(loss)
+        else:
+            fluid.optimizer.SGD(0.5).minimize(loss)
+    return prog, startup, loss
+
+
+def batches(seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(N_STEPS):
+        ids = rng.randint(0, VOCAB, size=(BS, 5)).astype("int64")
+        y = rng.randn(BS, 1).astype("float32")
+        out.append((ids, y))
+    return out
+
+
+def table_name(prog):
+    (w,) = [p.name for p in prog.all_parameters() if "embedding" in p.name]
+    return w
+
+
+def run_local(optimizer="sgd"):
+    prog, startup, loss = build(distributed=False, optimizer=optimizer)
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    for ids, y in batches():
+        exe.run(prog, feed={"ids": ids, "y": y}, fetch_list=[loss],
+                scope=scope)
+    return np.asarray(scope.find_var(table_name(prog)))
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_dist_table_matches_local_sparse(optimizer):
+    """2 trainers × sharded table across 2 pservers == local sparse run."""
+    endpoints = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    errors, done = [], {}
+
+    def transpile(tid):
+        prog, startup, loss = build(distributed=True, optimizer=optimizer)
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=tid, program=prog,
+                    pservers=",".join(endpoints), trainers=2,
+                    sync_mode=True, startup_program=startup)
+        return t, prog, startup, loss
+
+    def ps(startup, pserver_prog):
+        try:
+            scope = Scope()
+            exe = Executor()
+            exe.run(startup, scope=scope)
+            exe.run(pserver_prog, scope=scope)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def tr(t, prog, startup, tp, loss, tid):
+        try:
+            scope = Scope()
+            exe = Executor()
+            exe.run(startup, scope=scope)
+            # the trainer never holds the table: neither startup nor the
+            # trainer program mention the full [V, D] var
+            assert table_name(prog) not in tp.global_block.vars
+            assert scope.find_var(table_name(prog)) is None
+            for ids, y in batches():
+                half = slice(tid * BS // 2, (tid + 1) * BS // 2)
+                exe.run(tp, feed={"ids": ids[half], "y": y[half]},
+                        fetch_list=[loss], scope=scope)
+            if tid == 0:
+                # reassemble the sharded table straight off the pservers
+                client = transport.get_client(0)
+                shards = [np.asarray(client.get_var(s.endpoint, s.pname))
+                          for s in t.table_sections]
+                done["table"] = np.concatenate(shards, axis=0)
+            notify_complete(endpoints, trainer_id=tid)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+            try:
+                notify_complete(endpoints, trainer_id=tid)
+            except Exception:
+                pass
+
+    # program construction is single-threaded (process-global program/
+    # unique_name state); only execution is concurrent
+    threads = []
+    for i in range(2):
+        t, _, _, _ = transpile(0)
+        threads.append(threading.Thread(
+            target=ps, args=(t.get_startup_program(endpoints[i]),
+                             t.get_pserver_program(endpoints[i])),
+            daemon=True))
+    for tid in range(2):
+        t, prog, startup, loss = transpile(tid)
+        threads.append(threading.Thread(
+            target=tr, args=(t, prog, t.get_trainer_startup_program(),
+                             t.get_trainer_program(), loss, tid),
+            daemon=True))
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+        assert not th.is_alive(), "distributed table run timed out"
+    assert not errors, errors
+
+    want = run_local(optimizer=optimizer)
+    np.testing.assert_allclose(done["table"], want, rtol=3e-4, atol=3e-5)
+
+
+def test_trainer_program_uses_prefetch():
+    endpoints = ["127.0.0.1:7191", "127.0.0.1:7192"]
+    prog, startup, loss = build(distributed=True)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=prog, pservers=",".join(endpoints),
+                trainers=2, sync_mode=True, startup_program=startup)
+    tp = t.get_trainer_program()
+    types = [op.type for op in tp.global_block.ops]
+    assert "prefetch" in types
+    assert "split_selected_rows" in types
+    assert "lookup_table" not in types
+    # the table itself is never recv'd — only prefetched rows travel
+    (recv_op,) = [op for op in tp.global_block.ops if op.type == "recv"]
+    w = table_name(prog)
+    assert not any(n.startswith(w) for n in recv_op.output("Out"))
+    # both pservers hold a shard + its optimize block
+    for ep in endpoints:
+        pp = t.get_pserver_program(ep)
+        ls = pp.global_block.ops[0]
+        assert ls.attr("dist_tables"), ep

@@ -50,33 +50,26 @@ def _transpiler(trainer_id, endpoints, sync_mode=True, slice_var_up=False,
     return t, prog, startup, loss
 
 
-def _pserver_thread(endpoints, idx, sync_mode, slice_var_up, optimizer,
-                    decay, errors):
+def _pserver_thread(startup, pserver_prog, errors, idx):
     try:
-        t, _, _, _ = _transpiler(0, endpoints, sync_mode, slice_var_up,
-                                 optimizer, decay)
-        ep = endpoints[idx]
         scope = Scope()
         exe = Executor()
-        exe.run(t.get_startup_program(ep), scope=scope)
-        exe.run(t.get_pserver_program(ep), scope=scope)
+        exe.run(startup, scope=scope)
+        exe.run(pserver_prog, scope=scope)
     except Exception as e:  # pragma: no cover
         errors.append(("pserver", idx, e))
 
 
-def _trainer_thread(endpoints, tid, sync_mode, slice_var_up, optimizer,
-                    decay, results, errors):
+def _trainer_thread(endpoints, tid, prog, startup, trainer_prog, loss,
+                    results, errors):
     try:
-        t, prog, startup, loss = _transpiler(tid, endpoints, sync_mode,
-                                             slice_var_up, optimizer, decay)
-        tp = t.get_trainer_program()
         scope = Scope()
         exe = Executor()
         exe.run(startup, scope=scope)
         losses = []
         for x, y in batches(N_STEPS):
             half = slice(tid * 4, (tid + 1) * 4)
-            (lv,) = exe.run(tp, feed={"x": x[half], "y": y[half]},
+            (lv,) = exe.run(trainer_prog, feed={"x": x[half], "y": y[half]},
                             fetch_list=[loss], scope=scope)
             losses.append(float(lv))
         results[tid] = (losses, param_values(prog, scope))
@@ -93,18 +86,27 @@ def _run_cluster(sync_mode=True, slice_var_up=False, optimizer="sgd",
                  decay=False):
     endpoints = [f"127.0.0.1:{p}" for p in free_ports(2)]
     errors, results = [], {}
-    threads = [
-        threading.Thread(target=_pserver_thread,
-                         args=(endpoints, i, sync_mode, slice_var_up,
-                               optimizer, decay, errors), daemon=True)
-        for i in range(2)
-    ] + [
-        threading.Thread(target=_trainer_thread,
-                         args=(endpoints, tid, sync_mode, slice_var_up,
-                               optimizer, decay, results, errors),
-                         daemon=True)
-        for tid in range(2)
-    ]
+    # build every role's programs sequentially: program construction uses
+    # process-global default-program/unique_name state and is not
+    # thread-safe (only execution runs concurrently below)
+    threads = []
+    for i in range(2):
+        t, _, _, _ = _transpiler(0, endpoints, sync_mode, slice_var_up,
+                                 optimizer, decay)
+        ep = endpoints[i]
+        threads.append(threading.Thread(
+            target=_pserver_thread,
+            args=(t.get_startup_program(ep), t.get_pserver_program(ep),
+                  errors, i),
+            daemon=True))
+    for tid in range(2):
+        t, prog, startup, loss = _transpiler(tid, endpoints, sync_mode,
+                                             slice_var_up, optimizer, decay)
+        threads.append(threading.Thread(
+            target=_trainer_thread,
+            args=(endpoints, tid, prog, t.get_trainer_startup_program(),
+                  t.get_trainer_program(), loss, results, errors),
+            daemon=True))
     for th in threads:
         th.start()
     for th in threads:

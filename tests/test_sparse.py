@@ -198,12 +198,23 @@ def test_unsupported_sparse_optimizer_raises():
                     fetch_list=[loss])
 
 
-def test_is_distributed_errors_without_transpiler():
+def test_is_distributed_requires_sparse_grads():
+    """The sharded-table path moves SelectedRows slices; a dense gradient
+    for a distributed table is rejected loudly (no silent downgrade)."""
     prog, startup = Program(), Program()
     with program_guard(prog, startup), unique_name.guard():
         ids = fluid.layers.data("ids", [4], dtype="int64")
-        with pytest.raises(NotImplementedError, match="is_distributed"):
-            fluid.layers.embedding(ids, [V, D], is_distributed=True)
+        with pytest.raises(ValueError, match="is_sparse"):
+            fluid.layers.embedding(ids, [V, D], is_distributed=True,
+                                   is_sparse=False)
+        # supported spelling: builds a lookup_table op marked for the
+        # DistributeTranspiler's prefetch rewrite
+        out = fluid.layers.embedding(ids, [V, D], is_distributed=True,
+                                     is_sparse=True)
+        (op,) = [o for o in prog.global_block.ops
+                 if o.type == "lookup_table"]
+        assert op.attr("is_distributed") is True
+        assert out.shape[-1] == D
 
 
 def test_sparse_grads_under_dp_mesh():

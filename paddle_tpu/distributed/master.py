@@ -1,0 +1,245 @@
+"""Elastic task master: leased data-chunk dispatch with retry + snapshot.
+
+TPU-native redesign of the Go fault-tolerant master
+(``go/master/service.go``): trainers are stateless task consumers —
+``GetTask:368`` leases a chunk with a timeout (``checkTimeoutFunc:341``),
+``TaskFinished:411`` retires it, ``TaskFailed:455`` requeues until
+``failureMax`` (``processFailedTask:313``), and every state change is
+snapshotted (``snapshot:207``) so a restarted master ``recover:166``s with
+pending leases requeued.  The etcd store becomes an atomically-replaced
+local snapshot file (the coordination point on a TPU pod is the shared
+filesystem / the single master process, not a quorum store).
+
+Rides the same framed-TCP transport as the pserver ops; a master is just
+another ``RPCServer`` service.  The trainer-side ``task_reader`` wraps
+GetTask/TaskFinished into a plain sample iterator — the role of the v2
+``cloud_reader`` (``python/paddle/v2/reader/creator.py:91-109``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from . import transport
+from .transport import OK, RPCServer
+
+GET_TASK = 16
+TASK_FINISHED = 17
+TASK_FAILED = 18
+SET_DATASET = 19
+MASTER_STATE = 20
+
+
+class TaskMaster:
+    """Service object for an RPCServer (go/master/service.go:89)."""
+
+    def __init__(self, snapshot_path: Optional[str] = None,
+                 lease_timeout: float = 10.0, failure_max: int = 3,
+                 snapshot_every: int = 1):
+        self.snapshot_path = snapshot_path
+        self.lease_timeout = lease_timeout
+        self.failure_max = failure_max
+        # durability/throughput knob: snapshot every N state transitions
+        # (1 = every transition, like the Go master's per-change etcd put)
+        self.snapshot_every = max(1, snapshot_every)
+        self._transitions = 0
+        self.lock = threading.Lock()
+        self.todo: deque = deque()          # [task dict]
+        self.pending: Dict[int, dict] = {}  # id -> {task, deadline, owner}
+        self.done: List[int] = []
+        self.failures: Dict[int, int] = {}
+        self.discarded: List[int] = []
+        self.next_id = 0
+        self.pass_id = 0
+        self._pass_rolled = True  # no pass in flight yet
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+
+    # -- persistence (service.go:207 snapshot / :166 recover) --------------
+    def _snapshot(self, force: bool = False) -> None:
+        if not self.snapshot_path:
+            return
+        self._transitions += 1
+        if not force and self._transitions % self.snapshot_every:
+            return
+        state = {
+            "todo": list(self.todo),
+            "pending": [e["task"] for e in self.pending.values()],
+            "done": self.done,
+            "failures": {str(k): v for k, v in self.failures.items()},
+            "discarded": self.discarded,
+            "next_id": self.next_id,
+            "pass_id": self.pass_id,
+            "pass_rolled": self._pass_rolled,
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)  # atomic like the etcd put
+
+    def _recover(self) -> None:
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        # leases die with the old master: pending goes back to todo
+        self.todo = deque(state["todo"] + state["pending"])
+        self.done = state["done"]
+        self.failures = {int(k): v for k, v in state["failures"].items()}
+        self.discarded = state.get("discarded", [])
+        self.next_id = state["next_id"]
+        self.pass_id = state.get("pass_id", 0)
+        self._pass_rolled = state.get("pass_rolled", not (self.todo or self.pending))
+
+    # -- core ops (locked) -------------------------------------------------
+    def set_dataset(self, chunks: List) -> None:
+        """Partition a chunk list into tasks (service.go:280 SetDataset +
+        partition:106).  Idempotent while a pass is in flight; starting a
+        new pass prunes the previous pass's bookkeeping."""
+        with self.lock:
+            if self.todo or self.pending:
+                return
+            self.done.clear()
+            self.failures.clear()
+            self.discarded.clear()
+            self._pass_rolled = False
+            for payload in chunks:
+                self.todo.append({"id": self.next_id, "payload": payload,
+                                  "pass": self.pass_id})
+                self.next_id += 1
+            self._snapshot(force=True)
+
+    def _requeue_expired(self) -> None:
+        now = time.monotonic()
+        expired = [tid for tid, e in self.pending.items()
+                   if e["deadline"] <= now]
+        for tid in expired:
+            task = self.pending.pop(tid)["task"]
+            self._note_failure(task)
+
+    def _note_failure(self, task: dict) -> None:
+        tid = task["id"]
+        self.failures[tid] = self.failures.get(tid, 0) + 1
+        if self.failures[tid] > self.failure_max:
+            self.discarded.append(tid)  # service.go:313 processFailedTask
+        else:
+            self.todo.append(task)
+
+    def get_task(self, owner: int) -> Optional[dict]:
+        with self.lock:
+            self._requeue_expired()
+            if not self.todo:
+                if not self.pending and not self._pass_rolled:
+                    self.pass_id += 1  # pass finished (rolls over once)
+                    self._pass_rolled = True
+                    self._snapshot(force=True)
+                return None
+            task = self.todo.popleft()
+            self.pending[task["id"]] = {
+                "task": task, "owner": owner,
+                "deadline": time.monotonic() + self.lease_timeout}
+            self._snapshot()
+            return task
+
+    def task_finished(self, task_id: int) -> None:
+        with self.lock:
+            if task_id in self.pending:
+                self.pending.pop(task_id)
+                self.done.append(task_id)
+                self.failures.pop(task_id, None)
+                self._snapshot()
+
+    def task_failed(self, task_id: int) -> None:
+        with self.lock:
+            entry = self.pending.pop(task_id, None)
+            if entry is not None:
+                self._note_failure(entry["task"])
+                self._snapshot()
+
+    def state(self) -> dict:
+        with self.lock:
+            self._requeue_expired()
+            return {"todo": len(self.todo), "pending": len(self.pending),
+                    "done": sorted(self.done),
+                    "discarded": sorted(self.discarded),
+                    "pass_id": self.pass_id}
+
+    # -- transport glue ----------------------------------------------------
+    def handle(self, msg_type, trainer_id, name, payload):
+        if msg_type == GET_TASK:
+            task = self.get_task(trainer_id)
+            return OK, json.dumps(task).encode("utf-8")
+        if msg_type == TASK_FINISHED:
+            self.task_finished(int(name))
+            return OK, b""
+        if msg_type == TASK_FAILED:
+            self.task_failed(int(name))
+            return OK, b""
+        if msg_type == SET_DATASET:
+            self.set_dataset(json.loads(payload.decode("utf-8")))
+            return OK, b""
+        if msg_type == MASTER_STATE:
+            return OK, json.dumps(self.state()).encode("utf-8")
+        raise ValueError(f"unknown master message type {msg_type}")
+
+
+def serve_master(endpoint: str, snapshot_path: Optional[str] = None,
+                 lease_timeout: float = 10.0, failure_max: int = 3):
+    """Start a master service; returns (master, server) — call
+    ``server.stop()`` to kill it (tests simulate master failure this way)."""
+    master = TaskMaster(snapshot_path, lease_timeout, failure_max)
+    server = RPCServer(endpoint, master)
+    server.start()
+    return master, server
+
+
+class MasterClient:
+    """Trainer-side master client (go/master/client.go + c bindings)."""
+
+    def __init__(self, endpoint: str, trainer_id: int = 0):
+        self.endpoint = endpoint
+        self._rpc = transport.get_client(trainer_id)
+
+    def set_dataset(self, chunks: List) -> None:
+        self._rpc._request(self.endpoint, SET_DATASET,
+                           payload=json.dumps(chunks).encode("utf-8"))
+
+    def get_task(self) -> Optional[dict]:
+        out = self._rpc._request(self.endpoint, GET_TASK)
+        return json.loads(out.decode("utf-8"))
+
+    def task_finished(self, task_id: int) -> None:
+        self._rpc._request(self.endpoint, TASK_FINISHED, str(task_id))
+
+    def task_failed(self, task_id: int) -> None:
+        self._rpc._request(self.endpoint, TASK_FAILED, str(task_id))
+
+    def state(self) -> dict:
+        out = self._rpc._request(self.endpoint, MASTER_STATE)
+        return json.loads(out.decode("utf-8"))
+
+
+def task_reader(client: MasterClient, make_reader: Callable,
+                poll_interval: float = 0.2):
+    """Sample iterator over master-leased tasks (cloud_reader analogue:
+    python/paddle/v2/reader/creator.py:91-109).  ``make_reader(payload)``
+    yields the samples of one chunk.  Stops when the pass is exhausted;
+    a chunk whose reader raises is reported failed (and will be retried
+    by another consumer) before the error propagates."""
+    while True:
+        task = client.get_task()
+        if task is None:
+            # distinguish "pass done" from "all chunks leased elsewhere"
+            st = client.state()
+            if st["pending"] == 0 and st["todo"] == 0:
+                return
+            time.sleep(poll_interval)
+            continue
+        try:
+            yield from make_reader(task["payload"])
+        except Exception:
+            client.task_failed(task["id"])
+            raise
+        client.task_finished(task["id"])

@@ -204,6 +204,30 @@ class PServerLoop:
         self.lr_lock = threading.Lock()
         self._async_sends = 0
 
+        # periodic self-checkpoint + recovery (go/pserver/service.go:346
+        # checkpoint / :175 LoadCheckpoint)
+        self.ckpt_dir = op.attr("checkpoint_dir") or None
+        self.ckpt_every = int(op.attr("checkpoint_every_rounds", 0) or 0)
+        if self.ckpt_dir and os.path.exists(self._ckpt_path()):
+            with np.load(self._ckpt_path()) as data:
+                for n in data.files:
+                    self.scope.set_var(n, data[n])
+
+    def _ckpt_path(self) -> str:
+        # keyed by shard index, not endpoint: a restarted pserver may come
+        # back on a different host:port but owns the same param shards
+        idx = self.op.attr("ps_index", 0)
+        return os.path.join(self.ckpt_dir, f"pserver_{idx}.npz")
+
+    def _checkpoint(self) -> None:
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        arrs = {n: np.asarray(self.scope.find_var(n))
+                for n in self.persist_names
+                if self.scope.find_var(n) is not None}
+        tmp = self._ckpt_path() + ".tmp.npz"
+        np.savez(tmp, **arrs)
+        os.replace(tmp, self._ckpt_path())  # atomic like the Go rename
+
     # -- optimize-block execution -----------------------------------------
     def _run_lr(self):
         if self.lr_prog is None:
@@ -242,6 +266,9 @@ class PServerLoop:
             self._run_lr()
             for bidx in sorted(set(self.grad_to_block.values())):
                 self._run_block(bidx)
+            if self.ckpt_dir and self.ckpt_every > 0 and \
+                    (self.applied_rounds + 1) % self.ckpt_every == 0:
+                self._checkpoint()
         except Exception as e:
             # record + still advance the round so waiting GETs wake up and
             # surface the error instead of deadlocking (exception_holder.h
@@ -273,9 +300,18 @@ class PServerLoop:
                         if self._async_sends % n_grads == 0:
                             self._run_lr()
                         self._async_sends += 1
+                        ckpt_now = (
+                            self.ckpt_dir and self.ckpt_every > 0
+                            and self._async_sends %
+                            (n_grads * self.ckpt_every) == 0)
                     with self.block_locks[bidx]:
                         self.scope.set_var(name, value)
                         self._run_block(bidx)
+                    if ckpt_now:
+                        # hogwild checkpoint: per-var snapshot consistency
+                        # only, like the Go async pserver (service.go:346)
+                        with self.lr_lock:
+                            self._checkpoint()
             return OK, b""
 
         if msg_type == BATCH_BARRIER:

@@ -34,11 +34,16 @@ from ..core.program import (OP_ROLE_ATTR, OP_ROLE_VAR_ATTR, Operator, OpRole,
 
 
 class DistributeTranspilerConfig:
-    """Reference DistributeTranspilerConfig (distribute_transpiler.py:125)."""
+    """Reference DistributeTranspilerConfig (distribute_transpiler.py:125).
+
+    ``checkpoint_dir``/``checkpoint_every_rounds`` enable periodic pserver
+    self-checkpoints with restart recovery (go/pserver/service.go:346)."""
 
     slice_var_up: bool = True
     min_block_size: int = 8192
     split_method: str = "RoundRobin"  # or "HashName"
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_rounds: int = 0
 
 
 class _Section:
@@ -274,10 +279,15 @@ class DistributeTranspiler:
         return prog
 
     def get_trainer_startup_program(self) -> Program:
-        """Trainer startup without distributed-table initialization: the
-        table lives only as pserver shards, so a trainer must not allocate
-        the full [V, D] array at startup (the reference equivalently
-        splices table init out of the trainer startup program)."""
+        """Trainer startup for pserver mode.
+
+        - distributed-table init is stripped (the table lives only as
+          pserver shards; a trainer must not allocate the full [V, D]
+          array — the reference equivalently splices table init out);
+        - current params are pulled from the pservers after local init
+          (recv + concat), so a trainer joining a running or
+          checkpoint-recovered cluster starts from the live state, not
+          from fresh init (reference startup-program recv splicing)."""
         prog = self.startup_program.clone()
         block = prog.global_block
         if self.dist_table_ops:
@@ -286,6 +296,34 @@ class DistributeTranspiler:
                 if not (set(op.output_arg_names()) & set(self.dist_table_ops))]
             for table in self.dist_table_ops:
                 block.vars.pop(table, None)
+
+        rpc_attrs = {"trainer_id": self.trainer_id,
+                     OP_ROLE_ATTR: OpRole.RPC}
+        main = self.origin_program.global_block
+        for p, secs in self.param_sections.items():
+            for s in secs:
+                if s.is_table:
+                    continue
+                pvar = main.var(p)
+                block.create_var(
+                    name=s.pname, shape=(s.rows,) + tuple(pvar.shape[1:]),
+                    dtype=pvar.dtype)
+        if self.sections:
+            block.append_op(
+                "recv", {}, {"Out": [s.pname for s in self.sections]},
+                {**rpc_attrs, "epmap": [s.endpoint for s in self.sections]})
+            block.append_op("fetch_barrier", {}, {},
+                            {**rpc_attrs, "endpoints": self.endpoints})
+        for p, secs in self.param_sections.items():
+            if len(secs) == 1 or secs[0].is_table:
+                continue
+            if p not in block.vars:
+                pvar = main.var(p)
+                block.create_var(name=p, shape=pvar.shape, dtype=pvar.dtype,
+                                 persistable=True)
+            block.append_op(
+                "concat", {"X": [s.pname for s in secs]}, {"Out": [p]},
+                {"axis": 0, OP_ROLE_ATTR: OpRole.Dist})
         return prog
 
     # -- pserver side ------------------------------------------------------
@@ -384,12 +422,15 @@ class DistributeTranspiler:
             "listen_and_serv", {}, {},
             {
                 "endpoint": endpoint,
+                "ps_index": self.endpoints.index(endpoint),
                 "sync_mode": self.sync_mode,
                 "Fanin": self.trainers,
                 "grad_to_block_id": grad_to_block,
                 "lr_block": lr_block_idx,
                 "lr_fetch": lr_fetch,
                 "dense_merge": "mean",
+                "checkpoint_dir": self.config.checkpoint_dir,
+                "checkpoint_every_rounds": self.config.checkpoint_every_rounds,
                 "persist_names": sorted(set(persist_names)),
                 "dist_tables": {
                     s.param: {"var": s.pname, "offset": s.offset,

@@ -55,3 +55,18 @@ def run_local(n_steps, optimizer="sgd", decay=False):
                         scope=scope)
         losses.append(float(lv))
     return losses, param_values(prog, scope)
+
+
+def free_ports(n):
+    """Allocate n distinct free localhost ports (bind-to-0 then release)."""
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports

@@ -13,21 +13,11 @@ from paddle_tpu.core.executor import Executor, Scope
 from paddle_tpu.core.program import Program, program_guard
 from paddle_tpu.distributed import notify_complete, transport
 
+from dist_model import free_ports
+
 VOCAB, DIM = 64, 8
 N_STEPS = 4
 BS = 8
-
-
-def free_ports(n):
-    socks = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
 
 
 def build(distributed, optimizer="sgd"):

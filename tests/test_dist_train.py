@@ -20,21 +20,9 @@ import paddle_tpu as fluid
 from paddle_tpu.core.executor import Executor, Scope
 from paddle_tpu.distributed import notify_complete
 
-from dist_model import batches, build, param_values, run_local
+from dist_model import batches, build, free_ports, param_values, run_local
 
 N_STEPS = 5
-
-
-def free_ports(n):
-    socks = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
 
 
 def _transpiler(trainer_id, endpoints, sync_mode=True, slice_var_up=False,

@@ -168,8 +168,11 @@ class Executor:
             scope.set_var(RNG_STATE_VAR, rng_out)
 
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            return [self._fetch_to_numpy(v) for v in fetches]
         return list(fetches)
+
+    def _fetch_to_numpy(self, v):
+        return np.asarray(v)
 
     # -- host-op segmented execution ---------------------------------------
     # Blocks containing host ops (core/host_ops.py: RPC, pserver loop, IO)

@@ -219,14 +219,18 @@ class PServerLoop:
         idx = self.op.attr("ps_index", 0)
         return os.path.join(self.ckpt_dir, f"pserver_{idx}.npz")
 
-    def _checkpoint(self) -> None:
-        os.makedirs(self.ckpt_dir, exist_ok=True)
+    def _checkpoint(self, dirname: str = None) -> None:
+        dirname = dirname or self.ckpt_dir
+        os.makedirs(dirname, exist_ok=True)
+        path = (self._ckpt_path() if dirname == self.ckpt_dir else
+                os.path.join(dirname,
+                             f"pserver_{self.op.attr('ps_index', 0)}.npz"))
         arrs = {n: np.asarray(self.scope.find_var(n))
                 for n in self.persist_names
                 if self.scope.find_var(n) is not None}
-        tmp = self._ckpt_path() + ".tmp.npz"
+        tmp = path + ".tmp.npz"
         np.savez(tmp, **arrs)
-        os.replace(tmp, self._ckpt_path())  # atomic like the Go rename
+        os.replace(tmp, path)  # atomic like the Go rename
 
     # -- optimize-block execution -----------------------------------------
     def _run_lr(self):
@@ -266,9 +270,6 @@ class PServerLoop:
             self._run_lr()
             for bidx in sorted(set(self.grad_to_block.values())):
                 self._run_block(bidx)
-            if self.ckpt_dir and self.ckpt_every > 0 and \
-                    (self.applied_rounds + 1) % self.ckpt_every == 0:
-                self._checkpoint()
         except Exception as e:
             # record + still advance the round so waiting GETs wake up and
             # surface the error instead of deadlocking (exception_holder.h
@@ -278,6 +279,15 @@ class PServerLoop:
         finally:
             self.applied_rounds += 1
             self.lock.notify_all()  # caller holds the condition
+        # a failed snapshot must not poison training: in-memory state is
+        # intact, so warn and carry on (next interval retries)
+        if self.ckpt_dir and self.ckpt_every > 0 and \
+                self.applied_rounds % self.ckpt_every == 0:
+            try:
+                self._checkpoint()
+            except OSError as e:
+                import warnings
+                warnings.warn(f"pserver checkpoint failed (continuing): {e}")
 
     # -- service entry (one call per request, many threads) ----------------
     def handle(self, msg_type, trainer_id, name, payload):
@@ -360,15 +370,7 @@ class PServerLoop:
             return OK, b""
 
         if msg_type == CHECKPOINT_NOTIFY:
-            dirname = name
-            os.makedirs(dirname, exist_ok=True)
-            fname = os.path.join(
-                dirname, "pserver_%s.npz" % self.op.attr("endpoint")
-                .replace(":", "_").replace("/", "_"))
-            arrs = {n: np.asarray(self.scope.find_var(n))
-                    for n in self.persist_names
-                    if self.scope.find_var(n) is not None}
-            np.savez(fname, **arrs)
+            self._checkpoint(dirname=name)
             return OK, b""
 
         if msg_type == COMPLETE:

@@ -1,4 +1,5 @@
 from .parallel_executor import ParallelExecutor, make_mesh  # noqa: F401
+from .multihost import init_from_env  # noqa: F401
 from .strategy import (  # noqa: F401
     BuildStrategy,
     ExecutionStrategy,

@@ -1,0 +1,57 @@
+"""Multi-host bring-up: env-var topology → jax.distributed world.
+
+TPU-native replacement for the reference's "nccl2 mode" bootstrap: the
+transpiler appends a ``gen_nccl_id`` op, trainer 0 gRPC-serves the
+``ncclUniqueId``, and every trainer builds a flat NCCL world with
+``NCCLContextMap(places, id, num_trainers, trainer_id)``
+(``operators/gen_nccl_id_op.cc:31,78``, ``platform/nccl_helper.h:105-120``,
+``transpiler/distribute_transpiler.py:125`` mode="nccl2").
+
+Here the same contract — cluster topology arrives as ``PADDLE_*`` env vars
+(``benchmark/fluid/fluid_benchmark.py:63-109``), process 0 is the
+rendezvous point — drives ``jax.distributed.initialize``; the XLA runtime
+replaces NCCL id exchange with its own coordination service, and the
+resulting *global* device list forms one ``Mesh`` spanning hosts, so the
+same ParallelExecutor program runs unchanged with collectives riding
+ICI within a host/pod slice and DCN across.
+"""
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional, Tuple
+
+import jax
+
+
+def init_from_env(environ: Optional[Mapping[str, str]] = None) -> Tuple[int, int]:
+    """Initialize the multi-process JAX world from PADDLE_* env vars.
+
+    Recognized (first form wins):
+    - ``PADDLE_TRAINER_ENDPOINTS`` (comma list; entry 0 is the coordinator)
+      + ``PADDLE_TRAINER_ID``
+    - ``PADDLE_COORDINATOR`` + ``PADDLE_TRAINERS_NUM`` + ``PADDLE_TRAINER_ID``
+
+    Returns (trainer_id, num_trainers).  No-ops (returning the current
+    world) if the distributed runtime is already initialized.
+    """
+    env = environ if environ is not None else os.environ
+    # do NOT touch jax.process_count() here: it would initialize the XLA
+    # backend, after which jax.distributed.initialize refuses to run
+    from jax._src import distributed as _dist
+    if _dist.global_state.client is not None:
+        return jax.process_index(), jax.process_count()
+
+    endpoints = env.get("PADDLE_TRAINER_ENDPOINTS", "")
+    trainer_id = int(env.get("PADDLE_TRAINER_ID", "0"))
+    if endpoints:
+        eps = [e.strip() for e in endpoints.split(",") if e.strip()]
+        coordinator, num_trainers = eps[0], len(eps)
+    else:
+        coordinator = env.get("PADDLE_COORDINATOR", "")
+        num_trainers = int(env.get("PADDLE_TRAINERS_NUM", "1"))
+    if num_trainers <= 1:
+        return 0, 1
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_trainers,
+                               process_id=trainer_id)
+    return trainer_id, num_trainers

@@ -99,6 +99,10 @@ class ParallelExecutor(Executor):
         self._dp_axis = "dp" if "dp" in self.mesh.axis_names else self.mesh.axis_names[0]
         self._placed: set = set()
         self._scaled_programs: Dict[int, Program] = {}
+        # multi-host: the mesh spans every process's devices (nccl2-mode
+        # flat world, nccl_helper.h:105-120); each process contributes its
+        # local slice of feeds/state via make_array_from_* below
+        self._multiproc = jax.process_count() > 1
 
     # -- public API (reference parallel_executor.py:169 signature) ---------
     def run(self, fetch_list=None, feed=None, feed_dict=None,
@@ -146,6 +150,25 @@ class ParallelExecutor(Executor):
 
     def _put_feed(self, arr):
         dp = self.mesh.shape[self._dp_axis]
+        if self._multiproc:
+            # each process feeds its LOCAL batch (nccl2-mode trainers each
+            # read their own shard); the global batch is their dp-concat
+            local_dp = dp // jax.process_count()
+            if arr.ndim == 0:
+                # scalar feeds (e.g. the kCustomized loss-grad seed) are by
+                # contract identical on every trainer → replicate
+                return self._make_global(arr, self._replicated())
+            if local_dp > 0 and arr.shape[0] > 0 \
+                    and arr.shape[0] % local_dp == 0:
+                sharding = NamedSharding(
+                    self.mesh, P(self._dp_axis, *([None] * (arr.ndim - 1))))
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(arr))
+            raise ValueError(
+                f"multi-host feed of shape {getattr(arr, 'shape', ())} does "
+                f"not divide the local dp degree {local_dp}; pad the batch "
+                f"(replicated fallback would need identical data on every "
+                f"trainer)")
         if arr.ndim >= 1 and arr.shape[0] % dp == 0 and arr.shape[0] > 0:
             sharding = NamedSharding(
                 self.mesh, P(self._dp_axis, *([None] * (arr.ndim - 1))))
@@ -156,14 +179,34 @@ class ParallelExecutor(Executor):
         return jax.device_put(arr, sharding)
 
     def _put_rng(self, rng):
+        if self._multiproc:
+            return self._make_global(rng, self._replicated())
         return jax.device_put(rng, self._replicated())
+
+    def _make_global(self, val, sharding):
+        """Build a global array from this process's full local copy (every
+        process holds identical full values — named-PRNG init guarantees
+        it), reading each device's shard out of the local copy."""
+        val = np.asarray(val)
+        return jax.make_array_from_callback(val.shape, sharding,
+                                            lambda idx: val[idx])
 
     def _put_state(self, name: str, val):
         if name in self._placed:
             return val
         self._placed.add(name)
         # initial placement = the reference's param broadcast
+        if self._multiproc:
+            return self._make_global(val, self._state_sharding(name, np.asarray(val)))
         return jax.device_put(val, self._state_sharding(name, val))
+
+    def _fetch_to_numpy(self, v):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            if v.is_fully_replicated:
+                return np.asarray(v)
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+        return np.asarray(v)
 
     def _note_state_write(self, name: str) -> None:
         self._placed.add(name)

@@ -1,0 +1,50 @@
+"""Subprocess entry for the multi-host test: one trainer process of a
+2-process world, 4 virtual CPU devices each → one global dp=8 mesh.
+
+Mirrors the reference's nccl2-mode trainer (test_dist_base.py with
+--update_method nccl2): topology from PADDLE_* env vars, every process
+runs the SAME ParallelExecutor program, each feeding its own batch shard.
+"""
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.executor import Executor, Scope
+    from paddle_tpu.parallel import init_from_env
+
+    tid, n = init_from_env()
+    assert n == int(os.environ["PADDLE_TRAINERS_NUM"]), (tid, n)
+
+    from dist_model import batches, build, param_values
+
+    prog, startup, loss = build()
+    scope = Scope()
+    Executor().run(startup, scope=scope)
+
+    pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=prog,
+                                scope=scope)
+    assert pe.mesh.size == 8, pe.mesh  # global mesh spans both processes
+
+    losses = []
+    for x, y in batches(int(os.environ.get("DIST_STEPS", "5"))):
+        half = slice(tid * 4, (tid + 1) * 4)  # this trainer's batch shard
+        (lv,) = pe.run(feed={"x": x[half], "y": y[half]}, fetch_list=[loss])
+        losses.append(float(lv))
+
+    out = os.environ.get("DIST_OUT")
+    if out:
+        np.savez(out, losses=np.asarray(losses),
+                 **{k: np.asarray(v) for k, v in
+                    param_values(prog, scope).items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,6 @@
 """Distributed lookup table: sharded sparse embedding across pservers with
 remote prefetch (reference _distributed_lookup_table rewrite +
 prefetch_op.cc:27 + lookup_sparse_table semantics)."""
-import socket
 import threading
 
 import numpy as np

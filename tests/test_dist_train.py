@@ -7,7 +7,6 @@ pattern — 2 pservers + 2 trainers as localhost processes, trainer results
 compared against the single-process run.
 """
 import os
-import socket
 import subprocess
 import sys
 import tempfile

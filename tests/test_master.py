@@ -3,7 +3,6 @@ worker-death survival, pserver checkpoint kill-and-resume (reference
 go/master/service_internal_test.go + go/pserver/client/client_test.go
 failure-simulation style, in-process)."""
 import os
-import socket
 import threading
 import time
 

@@ -1,0 +1,61 @@
+"""Multi-host harness test: 2 localhost processes × 4 virtual CPU devices
+form one dp=8 mesh via jax.distributed (nccl2-mode analogue); the same
+ParallelExecutor program runs in both and must match the single-process
+run (reference test_dist_base.py --update_method nccl2 pattern)."""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from dist_model import free_ports, run_local
+
+N_STEPS = 5
+
+
+@pytest.mark.slow
+def test_two_process_mesh_matches_local():
+    (coord_port,) = free_ports(1)
+    endpoints = [f"127.0.0.1:{coord_port}", "127.0.0.1:0"]
+    here = os.path.dirname(os.path.abspath(__file__))
+    env_base = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "JAX_ENABLE_X64": "1",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_TRAINERS_NUM": "2",
+        "DIST_STEPS": str(N_STEPS),
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(here), here, os.environ.get("PYTHONPATH", "")]),
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        procs = []
+        for tid in range(2):
+            env = {**env_base, "PADDLE_TRAINER_ID": str(tid),
+                   "DIST_OUT": os.path.join(tmp, f"trainer{tid}.npz")}
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(here, "multihost_runner.py")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("multi-host process timed out")
+            assert p.returncode == 0, err.decode()
+
+        local_losses, local_params = run_local(N_STEPS)
+        for tid in range(2):
+            data = np.load(os.path.join(tmp, f"trainer{tid}.npz"))
+            # every process observes the same global-batch losses …
+            np.testing.assert_allclose(data["losses"], local_losses,
+                                       rtol=2e-4, atol=1e-5)
+            # … and ends with the same replicated params
+            for name, want in local_params.items():
+                np.testing.assert_allclose(data[name], want, rtol=2e-4,
+                                           atol=2e-5,
+                                           err_msg=f"trainer {tid} {name}")

@@ -81,6 +81,9 @@ def append_backward(
 
     # contributions: var name -> list of grad var names feeding it
     contribs: Dict[str, List[str]] = {}
+    # monotone per-var counter for @RENAME@k grad names (stays unique even
+    # when an in-place rewrite resets the contribution list below)
+    grad_counts: Dict[str, int] = {}
 
     def add_contrib(var_name: str, grad_name: str):
         contribs.setdefault(var_name, []).append(grad_name)
@@ -126,21 +129,23 @@ def append_backward(
         op = block.ops[idx]
         if op.attr(OP_ROLE_ATTR, OpRole.Forward) != OpRole.Forward:
             continue
-        if op.type in ("while", "conditional_block"):
+        if op.type == "while" and not op.attr("max_iters"):
             raise NotImplementedError(
-                f"gradient of {op.type!r} is not supported yet — use "
-                f"StaticRNN (lax.scan, fully differentiable) for trainable "
-                f"recurrence; while/conditional_block are inference-path ops")
-        if op.type == "static_rnn":
-            # grad re-traces the scan; rng-consuming ops inside would draw
-            # fresh keys and silently corrupt gradients — reject them
+                "gradient of While needs a static trip-count bound: "
+                "While(cond, max_iters=N) — the backward pass re-runs the "
+                "loop as an N-step masked scan (the functional form of "
+                "while_grad's step-scope replay, while_op.cc:101)")
+        if op.type in ("static_rnn", "dynamic_rnn", "while",
+                       "conditional_block"):
+            # grad re-traces the sub-block; rng-consuming ops inside would
+            # draw fresh keys and silently corrupt gradients — reject them
             sub = program.blocks[op.attr("sub_block")]
             for sop in sub.ops:
                 if registry.has(sop.type) and registry.get(sop.type).stateful:
                     raise NotImplementedError(
-                        f"op {sop.type!r} inside a StaticRNN step block is "
+                        f"op {sop.type!r} inside a {op.type} sub-block is "
                         f"not differentiable (rng re-traced in the reverse "
-                        f"scan); hoist it outside the rnn or use is_test")
+                        f"pass); hoist it outside or use is_test")
         if not registry.has(op.type):
             raise KeyError(f"cannot differentiate unregistered op {op.type!r}")
         opdef = registry.get(op.type)
@@ -172,6 +177,16 @@ def append_backward(
             g_inputs[slot] = list(names)
         g_inputs.update(out_grad_inputs)
 
+        # in-place rewrites (op input name == output name, e.g. a
+        # conditional_block/while carry): the downstream cotangent was just
+        # consumed via Out@GRAD; earlier writers of the var must see ONLY
+        # the grad wrt the pre-op value this grad op emits (the reference's
+        # _rename_arg_ SSA discipline, backward.py:135)
+        for n in set(op.input_arg_names()) & set(op.output_arg_names()):
+            if n and n != EMPTY_VAR and contribs.get(n):
+                grad_counts[n] = grad_counts.get(n, 0) + len(contribs[n])
+                contribs[n] = []
+
         # grad op outputs: grads of differentiable inputs (renamed when a
         # var already has a partial, summed lazily at consumption)
         g_outputs: Dict[str, List[str]] = {}
@@ -185,7 +200,7 @@ def append_backward(
                 if not _grad_allowed(block, n, no_grad):
                     outs.append(EMPTY_VAR)
                     continue
-                k = len(contribs.get(n, []))
+                k = grad_counts.get(n, 0) + len(contribs.get(n, []))
                 gname = grad_var_name(n) if k == 0 else f"{grad_var_name(n)}@RENAME@{k}"
                 _make_grad_var(block, gname, n)
                 add_contrib(n, gname)
@@ -212,6 +227,13 @@ def append_backward(
             for gn in g_outputs.get("W@GRAD", ()):
                 if gn != EMPTY_VAR:
                     block.var(gn).type = VarType.SELECTED_ROWS
+
+    # canonicalize: any var left with several partials gets its summed
+    # ``<var>@GRAD`` materialized, so fetching a leaf gradient by name sees
+    # the total, not one partial (reference _addup_repetitive_outputs_
+    # sums eagerly; we sum lazily, so flush here)
+    for n in [n for n, lst in contribs.items() if len(lst) > 1]:
+        resolve_out_grad(n)
 
     # collect (param, grad) pairs
     params = (

@@ -222,9 +222,8 @@ class PServerLoop:
     def _checkpoint(self, dirname: str = None) -> None:
         dirname = dirname or self.ckpt_dir
         os.makedirs(dirname, exist_ok=True)
-        path = (self._ckpt_path() if dirname == self.ckpt_dir else
-                os.path.join(dirname,
-                             f"pserver_{self.op.attr('ps_index', 0)}.npz"))
+        path = os.path.join(dirname,
+                            f"pserver_{self.op.attr('ps_index', 0)}.npz")
         arrs = {n: np.asarray(self.scope.find_var(n))
                 for n in self.persist_names
                 if self.scope.find_var(n) is not None}
@@ -285,7 +284,7 @@ class PServerLoop:
                 self.applied_rounds % self.ckpt_every == 0:
             try:
                 self._checkpoint()
-            except OSError as e:
+            except Exception as e:
                 import warnings
                 warnings.warn(f"pserver checkpoint failed (continuing): {e}")
 

@@ -1,11 +1,25 @@
 from . import control_flow, io, learning_rate_scheduler, nn, ops, tensor  # noqa: F401
-from .control_flow import ConditionalBlock, StaticRNN, Switch, While  # noqa: F401
+from .control_flow import (  # noqa: F401
+    ConditionalBlock,
+    DynamicRNN,
+    StaticRNN,
+    Switch,
+    While,
+    array_length,
+    array_read,
+    array_write,
+    beam_search,
+    beam_search_decode,
+    create_array,
+    less_than,
+)
 from .io import data  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401
     assign,
     create_global_var,
+    create_parameter,
     create_tensor,
     fill_constant,
     fill_constant_batch_size_like,

@@ -23,6 +23,37 @@ def _written_names(block) -> List[str]:
     return out
 
 
+def _unstop_float_carries(parent, carries) -> None:
+    """Loop/branch carries are mutable state, not constants: a float TEMP
+    var written inside the block becomes differentiable even if its initial
+    value came from a stop-gradient source (fill_constant init — the
+    decoder-state pattern, machine_translation.py:104).  Persistable vars
+    keep their flag: an explicit user freeze (target nets, running stats)
+    must not be overridden."""
+    from ..core.types import is_float
+
+    for n in carries:
+        v = parent.var_or_none(n)
+        if v is not None and not v.persistable \
+                and (v.dtype is None or is_float(v.dtype)):
+            v.stop_gradient = False
+
+
+def _copy_carry_inits(parent, sub_idx, names) -> List[str]:
+    """Snapshot pre-block carry values into explicit ``@INIT`` vars (assign
+    ops before the control-flow op).  The grad lowering reads these — they
+    survive host-op segmentation, unlike a trace-local stash (the
+    step-scope capture of while_op.cc:56 as program state)."""
+    out = []
+    for n in names:
+        v = parent.var(n)
+        init = parent.create_var(name=f"{n}@INIT@{sub_idx}", shape=v.shape,
+                                 dtype=v.dtype, stop_gradient=True)
+        parent.append_op("assign", {"X": [n]}, {"Out": [init.name]})
+        out.append(init.name)
+    return out
+
+
 def _captured_names(block, exclude) -> List[str]:
     defined = set(exclude)
     captured = []
@@ -51,12 +82,15 @@ class BlockGuard:
 class While:
     """while loop (control_flow.py:654).  The sub-block must reassign the
     condition var; vars assigned in the block that exist outside become the
-    loop carry."""
+    loop carry.  ``max_iters`` (a static trip-count bound) makes the loop
+    differentiable: the backward pass replays it as a masked scan."""
 
-    def __init__(self, cond: Variable, name: Optional[str] = None):
+    def __init__(self, cond: Variable, name: Optional[str] = None,
+                 max_iters: Optional[int] = None):
         self.helper = LayerHelper("while", name=name)
         assert cond.dtype == "bool", "While condition must be bool"
         self.cond_var = cond
+        self.max_iters = max_iters
 
     def block(self):
         return _WhileGuard(self)
@@ -77,11 +111,18 @@ class _WhileGuard(BlockGuard):
         cond_name = self.while_op.cond_var.name
         carries = [n for n in _written_names(sub)
                    if parent.var_or_none(n) is not None and n != cond_name]
+        captured = [n for n in _captured_names(sub, [cond_name] + carries)
+                    if parent.var_or_none(n) is not None]
+        _unstop_float_carries(parent, carries)
+        init_names = _copy_carry_inits(parent, sub.idx, [cond_name] + carries)
         parent.append_op(
             "while",
-            {"Condition": [cond_name], "X": carries},
+            {"Condition": [cond_name], "X": carries, "Captured": captured,
+             "Init": init_names},
             {"Out": carries},
-            {"sub_block": sub.idx, "carry_vars": [cond_name] + carries},
+            {"sub_block": sub.idx, "carry_vars": [cond_name] + carries,
+             "captured_vars": captured,
+             "max_iters": self.while_op.max_iters or 0},
         )
         return False
 
@@ -240,11 +281,18 @@ class _CondGuard(BlockGuard):
         parent = self.program.current_block()
         carries = [n for n in _written_names(sub)
                    if parent.var_or_none(n) is not None]
+        cond_name = self.cb.cond.name
+        captured = [n for n in _captured_names(sub, [cond_name] + carries)
+                    if parent.var_or_none(n) is not None]
+        _unstop_float_carries(parent, carries)
+        init_names = _copy_carry_inits(parent, sub.idx, carries)
         parent.append_op(
             "conditional_block",
-            {"Condition": [self.cb.cond.name], "X": carries},
+            {"Condition": [cond_name], "X": carries, "Captured": captured,
+             "Init": init_names},
             {"Out": carries},
-            {"sub_block": sub.idx, "carry_vars": carries},
+            {"sub_block": sub.idx, "carry_vars": carries,
+             "captured_vars": captured},
         )
         return False
 
@@ -294,6 +342,67 @@ class Switch:
         return False
 
 
+class DynamicRNN(StaticRNN):
+    """Variable-length RNN over padded sequences (control_flow.py:1541).
+
+    The reference sorts sequences with a LoDRankTable and shrinks the
+    batch per step; the TPU redesign scans the padded [B, T, ...] layout
+    and masks memory updates + outputs by each row's sequence length (the
+    ``@LEN`` companion of the lod_level>=1 input) — rows past their length
+    keep their last state and emit zeros.  Same lax.scan reverse-mode
+    gradient as StaticRNN.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name=name)
+        self._seq_len_name = None
+
+    def block(self):  # reference API name
+        return self.step()
+
+    def step_input(self, x: Variable) -> Variable:
+        from .nn import seq_len_var
+
+        lv = seq_len_var(x)
+        if lv is None:
+            raise ValueError(
+                f"DynamicRNN.step_input needs a sequence input with a "
+                f"length companion (data(lod_level=1)); {x.name!r} has "
+                f"none — use StaticRNN for fixed-length input")
+        if self._seq_len_name is None:
+            self._seq_len_name = lv.name
+        return super().step_input(x)
+
+    def _complete(self):
+        sub = self._sub_block
+        parent = sub.parent_block
+        assert all(rec[2] is not None for rec in self._memories), \
+            "every memory needs update_memory"
+        inner_defined = [inner.name for _, inner in self._step_inputs] + \
+            [rec[0] for rec in self._memories]
+        captured = _captured_names(sub, inner_defined)
+        parent.append_op(
+            "dynamic_rnn",
+            {"X": [outer for outer, _ in self._step_inputs],
+             "Init": [rec[1] for rec in self._memories],
+             "Captured": captured,
+             "SeqLen": [self._seq_len_name]},
+            {"Out": [outer.name for _, outer in self._outputs]},
+            {"sub_block": sub.idx,
+             "step_inputs": [outer for outer, _ in self._step_inputs],
+             "step_input_vars": [inner.name for _, inner in self._step_inputs],
+             "memories": self._memories,
+             "step_outputs": [[inner, outer.name]
+                              for inner, outer in self._outputs]},
+        )
+        # outputs are padded sequences with the same lengths as the input
+        from .nn import _alias_len
+
+        seq_len = parent.var(self._seq_len_name)
+        for _, outer in self._outputs:
+            _alias_len(outer, seq_len)
+
+
 def less_than(x, y, cond=None):
     helper = LayerHelper("less_than")
     if cond is None:
@@ -302,6 +411,97 @@ def less_than(x, y, cond=None):
     return cond
 
 
-def array_length(x):  # parity stub for TensorArray API
-    raise NotImplementedError(
-        "TensorArray ops land with the decoder stack; use StaticRNN/scan")
+# ---------------------------------------------------------------------------
+# TensorArray (preallocated [max_len, ...] + int64 length; ops/array_ops.py)
+# ---------------------------------------------------------------------------
+
+def _array_len_var(array: Variable) -> Variable:
+    return array.block.var(array.name + "@ALEN")
+
+
+def create_array(dtype, element_shape, max_len, name=None) -> Variable:
+    """TensorArray of capacity ``max_len`` (LoDTensorArray analogue;
+    the reference grows on write — XLA needs the bound up front)."""
+    helper = LayerHelper("array", name=name)
+    arr = helper.create_variable_for_type_inference(
+        dtype, shape=(max_len,) + tuple(element_shape))
+    ln = arr.block.create_var(name=arr.name + "@ALEN", dtype="int64",
+                              shape=(1,))
+    helper.append_op("fill_constant", {}, {"Out": [arr]},
+                     {"shape": [max_len] + list(element_shape),
+                      "dtype": arr.dtype, "value": 0.0})
+    helper.append_op("fill_constant", {}, {"Out": [ln]},
+                     {"shape": [1], "dtype": "int64", "value": 0})
+    return arr
+
+
+def array_write(x: Variable, i: Variable, array: Variable) -> Variable:
+    """array[i] = x (tensor_array_read_write_op.cc WriteToArray)."""
+    ln = _array_len_var(array)
+    array.block.program.current_block().append_op(
+        "array_write",
+        {"X": [x.name], "I": [i.name], "Array": [array.name],
+         "ArrayLen": [ln.name]},
+        {"Out": [array.name], "LenOut": [ln.name]})
+    return array
+
+
+def array_read(array: Variable, i: Variable) -> Variable:
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(
+        array.dtype, shape=tuple(array.shape[1:]))
+    helper.append_op("array_read", {"Array": [array], "I": [i]},
+                     {"Out": [out]})
+    return out
+
+
+def array_length(array: Variable) -> Variable:
+    """Number of written slots (reference array_length op)."""
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64", shape=(1,))
+    helper.append_op("assign", {"X": [_array_len_var(array)]},
+                     {"Out": [out]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# beam search (ops/array_ops.py; reference beam_search_op.cc)
+# ---------------------------------------------------------------------------
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                name=None):
+    """One step of beam search over [batch*beam, K] candidates; returns
+    (selected_ids [BW,1], selected_scores [BW,1], parent_idx [BW]).
+    Seed pre_scores with 0 for beam 0 and -inf for the others of each
+    group at step 0 (see ops/array_ops.py beam_search docstring)."""
+    helper = LayerHelper("beam_search", name=name)
+    bw = ids.shape[0]
+    sel_ids = helper.create_variable_for_type_inference("int64", shape=(bw, 1))
+    sel_scores = helper.create_variable_for_type_inference(
+        scores.dtype, shape=(bw, 1))
+    parent = helper.create_variable_for_type_inference("int64", shape=(bw,))
+    helper.append_op(
+        "beam_search",
+        {"PreIds": [pre_ids], "PreScores": [pre_scores], "Ids": [ids],
+         "Scores": [scores]},
+        {"SelectedIds": [sel_ids], "SelectedScores": [sel_scores],
+         "ParentIdx": [parent]},
+        {"beam_size": beam_size, "end_id": end_id})
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids_array, parents_array, beam_size, end_id,
+                       name=None):
+    """Backtrack TensorArrays of per-step selections into sequences
+    [batch*beam, max_len] (beam_search_decode_op.cc)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    t_max, bw = ids_array.shape[0], ids_array.shape[1]
+    sents = helper.create_variable_for_type_inference(
+        "int64", shape=(bw, t_max))
+    helper.append_op(
+        "beam_search_decode",
+        {"Ids": [ids_array], "Parents": [parents_array],
+         "ArrayLen": [_array_len_var(ids_array)]},
+        {"SentenceIds": [sents]},
+        {"end_id": end_id, "beam_size": beam_size})
+    return sents

@@ -7,6 +7,18 @@ from ..core.program import Variable
 from ..layer_helper import LayerHelper
 
 
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone trainable parameter (reference layers/tensor.py
+    create_parameter)."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter")
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
 def create_tensor(dtype, name=None, persistable=False):
     helper = LayerHelper("create_tensor", name=name)
     return helper.create_global_variable(

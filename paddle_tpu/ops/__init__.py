@@ -1,4 +1,5 @@
 """Importing this package registers every op lowering rule."""
+from . import array_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import math_ops  # noqa: F401

@@ -1,0 +1,121 @@
+"""TensorArray + beam-search ops: the dynamic-decode toolkit.
+
+Reference: ``paddle/fluid/operators/tensor_array_read_write_op.cc``,
+``beam_search_op.cc``, ``beam_search_decode_op.cc`` and the
+LoDTensorArray type (``framework/lod_tensor_array.h``).
+
+TPU-native redesign: a TensorArray is a *preallocated* ``[max_len, ...]``
+tensor plus an int64 length scalar (XLA wants static shapes; the
+reference's grow-on-write vector of LoDTensors cannot trace).  Reads and
+writes are dynamic-index gathers/scatters — differentiable, so the same
+machinery backs while-grad.  Beam search works on the padded
+``[batch*beam, ...]`` layout (the LoD-free translation of the reference's
+per-source candidate lists), with finished beams persisting via an extra
+stay-finished candidate slot.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+@register("array_write", no_grad_slots=("I", "ArrayLen"))
+def _array_write(ctx, ins, attrs):
+    """array[i] = x; length = max(length, i+1)
+    (tensor_array_read_write_op.cc WriteToArray)."""
+    arr, x = ins["Array"][0], ins["X"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int32)
+    length = ins["ArrayLen"][0]
+    new_len = jnp.maximum(length.reshape(()),
+                          (i + 1).astype(length.dtype)).reshape(length.shape)
+    return {"Out": [arr.at[i].set(x.astype(arr.dtype))],
+            "LenOut": [new_len]}
+
+
+@register("array_read", no_grad_slots=("I",))
+def _array_read(ctx, ins, attrs):
+    """out = array[i] (ReadFromArray)."""
+    arr = ins["Array"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int32)
+    return {"Out": [arr[i]]}
+
+
+@register("beam_search", no_grad_slots=("PreIds", "PreScores", "Ids", "Scores"))
+def _beam_search(ctx, ins, attrs):
+    """One beam-search step (beam_search_op.cc, LoD-free layout).
+
+    Inputs (BW = batch * beam_size):
+      PreIds     [BW, 1] int64 — last selected token per beam
+      PreScores  [BW, 1] — accumulated log-prob per beam
+      Ids        [BW, K] int64 — candidate tokens (e.g. per-beam top-K)
+      Scores     [BW, K] — accumulated scores of those candidates
+    A finished beam (PreIds == end_id) contributes one stay-finished
+    candidate (end_id at its frozen score) instead of its K expansions —
+    the reference's pruning of ended hypotheses.  Step 0 convention: seed
+    PreScores with 0 for beam 0 and -inf for the rest of each group so
+    identical initial beams don't multiply (kInf trick).
+
+    Outputs: SelectedIds [BW, 1], SelectedScores [BW, 1],
+             ParentIdx [BW] int64 (global source-beam index per selection).
+    """
+    beam = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    pre_ids = ins["PreIds"][0].reshape(-1)
+    pre_scores = ins["PreScores"][0].reshape(-1)
+    ids = ins["Ids"][0]
+    scores = ins["Scores"][0]
+    bw, k = scores.shape
+    assert bw % beam == 0, f"batch*beam {bw} not divisible by beam {beam}"
+    b = bw // beam
+    neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
+
+    finished = pre_ids == end_id
+    live_scores = jnp.where(finished[:, None], neg_inf, scores)
+    stay = jnp.where(finished, pre_scores, neg_inf)[:, None]
+    cand_scores = jnp.concatenate([live_scores, stay], axis=1)     # [BW,K+1]
+    cand_ids = jnp.concatenate(
+        [ids, jnp.full((bw, 1), end_id, ids.dtype)], axis=1)
+
+    grouped = cand_scores.reshape(b, beam * (k + 1))
+    top_scores, top_idx = lax.top_k(grouped, beam)                 # [B,beam]
+    parent_local = top_idx // (k + 1)
+    parent = (parent_local
+              + (jnp.arange(b, dtype=top_idx.dtype) * beam)[:, None])
+    sel_ids = jnp.take_along_axis(cand_ids.reshape(b, -1), top_idx, axis=1)
+    return {
+        "SelectedIds": [sel_ids.reshape(bw, 1).astype(jnp.int64)],
+        "SelectedScores": [top_scores.reshape(bw, 1)],
+        "ParentIdx": [parent.reshape(bw).astype(jnp.int64)],
+    }
+
+
+@register("beam_search_decode", no_grad_slots=("Ids", "Parents", "ArrayLen"))
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack stacked per-step selections into full sequences
+    (beam_search_decode_op.cc).
+
+    Ids, Parents: [T_max, BW] (TensorArray data); ArrayLen: written steps.
+    Walks parent pointers from the last written step back to step 0;
+    steps beyond ArrayLen are padded with end_id.
+    Outputs: SentenceIds [BW, T_max] int64, SentenceScores passthrough of
+    the final beam order (identity — scores already live per final beam).
+    """
+    ids = ins["Ids"][0]          # [T, BW]
+    parents = ins["Parents"][0]  # [T, BW]
+    t_max, bw = ids.shape
+    end_id = int(attrs["end_id"])
+    length = ins["ArrayLen"][0].reshape(()).astype(jnp.int32) \
+        if ins.get("ArrayLen") else jnp.asarray(t_max, jnp.int32)
+
+    def step(cur, tp):
+        t, ids_t, par_t = tp
+        active = t < length
+        tok = jnp.where(active, ids_t[cur], jnp.asarray(end_id, ids.dtype))
+        nxt = jnp.where(active, par_t[cur], cur)
+        return nxt, tok
+
+    ts = jnp.arange(t_max - 1, -1, -1)
+    _, toks = lax.scan(step, jnp.arange(bw), (ts, ids[::-1], parents[::-1]))
+    return {"SentenceIds": [toks[::-1].T.astype(jnp.int64)]}

@@ -32,15 +32,41 @@ def _never(ctx, ins, attrs):  # pragma: no cover
 
 # registry entries let append_backward build grad op descs generically
 register("static_rnn", no_grad_slots=())(_never)
+register("dynamic_rnn", no_grad_slots=("SeqLen",))(_never)
+register("while", no_grad_slots=("Condition", "Init"))(_never)
+register("conditional_block", no_grad_slots=("Condition", "Init"))(_never)
+
+
+def _carry_inits(op, env) -> Dict:
+    """Pre-op carry values from the explicit @INIT snapshot vars the layer
+    emitted (while_op.cc:56 step-scope capture as program state; survives
+    host-op segmentation, unlike a trace-local stash)."""
+    carried = op.attr("carry_vars")
+    init_names = op.input("Init")
+    if len(init_names) != len(carried):
+        # conditional_block: carry_vars excludes the condition
+        carried = [n for n in carried if n != op.input("Condition")[0]]
+    return {n: env[i] for n, i in zip(carried, init_names)}
 
 
 def lower_while(ctx, program, op, env: Dict, lower_block_ops) -> None:
     """while op: attrs sub_block (idx), carry_vars (names, first is the
     condition var).  Repeats the sub-block until the condition var, which
-    the block must reassign, is false."""
+    the block must reassign, is false.  With ``max_iters`` set the loop
+    lowers as a bounded masked scan — identical iteration semantics, and
+    exactly the computation the grad lowering differentiates (exceeding
+    the bound then truncates forward AND backward consistently, loudly
+    visible in the loss rather than silently only in the grads)."""
     sub = program.blocks[op.attr("sub_block")]
     cond_name = op.input("Condition")[0]
     carry_names = [n for n in op.attr("carry_vars") if n != cond_name]
+
+    if op.attr("max_iters"):
+        inits = {n: env[n] for n in [cond_name] + carry_names}
+        out = _while_as_masked_scan(ctx, program, op, env, lower_block_ops,
+                                    inits, {})
+        env.update(out)
+        return
 
     def cond_fn(carry):
         return carry[0].reshape(()).astype(jnp.bool_)
@@ -56,6 +82,89 @@ def lower_while(ctx, program, op, env: Dict, lower_block_ops) -> None:
     res = lax.while_loop(cond_fn, body_fn, init)
     env[cond_name] = res[0]
     env.update(zip(carry_names, res[1:]))
+
+
+def _while_as_masked_scan(ctx, program, op, env: Dict, lower_block_ops,
+                          inits: Dict, overrides: Dict):
+    """Differentiable forward of a bounded while: a ``max_iters``-step scan
+    whose iterations after the condition turns false are select-no-ops.
+    The reverse scan jax derives from this is the functional equivalent of
+    while_grad's reversed step-scope walk (while_op.cc:101-263)."""
+    sub = program.blocks[op.attr("sub_block")]
+    cond_name = op.input("Condition")[0]
+    carry_names = [n for n in op.attr("carry_vars") if n != cond_name]
+    max_iters = int(op.attr("max_iters"))
+
+    def body(carry, _):
+        cond, state = carry[0], carry[1:]
+        benv = dict(env)
+        benv.update(overrides)
+        benv[cond_name] = cond
+        benv.update(zip(carry_names, state))
+        lower_block_ops(ctx, program, sub, benv)
+        active = cond.reshape(()).astype(jnp.bool_)
+        new_state = tuple(
+            jnp.where(active, benv[n].astype(jnp.result_type(old)), old)
+            for n, old in zip(carry_names, state))
+        new_cond = jnp.where(active, benv[cond_name], cond)
+        return (new_cond,) + new_state, None
+
+    init = (inits[cond_name],) + tuple(inits[n] for n in carry_names)
+    final, _ = lax.scan(body, init, None, length=max_iters)
+    out = dict(zip([cond_name] + carry_names, final))
+    return out
+
+
+def _is_float_val(v):
+    return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+
+
+def _subblock_vjp(op, env, inits, fwd, diff_carries, diff_capt) -> None:
+    """Shared grad plumbing for while/conditional_block: vjp of ``fwd``
+    over {diff carries (init values) + diff captured (env values)},
+    cotangents from the Out@GRAD slots, results written to the X@GRAD /
+    Captured@GRAD slots."""
+    primal_in = {**{n: inits[n] for n in diff_carries},
+                 **{n: env[n] for n in diff_capt}}
+    primals, vjp_fn = jax.vjp(fwd, primal_in)
+    grad_of = dict(zip(op.input("Out"), op.input("Out@GRAD")))
+    cot = {}
+    for n in diff_carries:
+        gname = grad_of.get(n)
+        g = env.get(gname) if gname and gname != EMPTY_VAR else None
+        cot[n] = g if g is not None else jnp.zeros_like(primals[n])
+    (grads,) = vjp_fn(cot)
+    for slot in ("X", "Captured"):
+        for src, dst in zip(op.input(slot), op.output(slot + "@GRAD")):
+            if dst and dst != EMPTY_VAR and src in grads:
+                env[dst] = grads[src]
+
+
+def lower_while_grad(ctx, program, op, env: Dict, lower_block_ops) -> None:
+    """Grad of a bounded while: vjp over the masked-scan forward, from the
+    @INIT-snapshot carry values.  Differentiates wrt float carries and
+    captured outer vars (while_op.cc:101 while_grad)."""
+    if not op.attr("max_iters"):
+        raise NotImplementedError(
+            "gradient of While requires max_iters (a static trip-count "
+            "bound): While(cond, max_iters=N)")
+    cond_name = op.input("Condition")[0]
+    carry_names = [n for n in op.attr("carry_vars") if n != cond_name]
+    captured = [n for n in op.attr("captured_vars", ()) or ()]
+    inits = _carry_inits(op, env)
+
+    diff_carries = [n for n in carry_names if _is_float_val(inits[n])]
+    diff_capt = [n for n in captured if n in env and _is_float_val(env[n])]
+
+    def fwd(vals: Dict):
+        full_inits = dict(inits)
+        full_inits.update({n: vals[n] for n in diff_carries})
+        overrides = {n: vals[n] for n in diff_capt}
+        out = _while_as_masked_scan(ctx, program, op, env, lower_block_ops,
+                                    full_inits, overrides)
+        return {n: out[n] for n in diff_carries}
+
+    _subblock_vjp(op, env, inits, fwd, diff_carries, diff_capt)
 
 
 def lower_conditional_block(ctx, program, op, env: Dict, lower_block_ops) -> None:
@@ -88,6 +197,38 @@ def lower_conditional_block(ctx, program, op, env: Dict, lower_block_ops) -> Non
     env.update(zip(carry_names, res))
 
 
+def lower_conditional_block_grad(ctx, program, op, env: Dict,
+                                 lower_block_ops) -> None:
+    """Grad of conditional_block: vjp through lax.cond from the
+    @INIT-snapshot carry values; differentiates wrt float carries and
+    captured outer vars (conditional_block_op.cc grad)."""
+    sub = program.blocks[op.attr("sub_block")]
+    cond = env[op.input("Condition")[0]].reshape(()).astype(jnp.bool_)
+    carry_names = list(op.attr("carry_vars"))
+    captured = [n for n in op.attr("captured_vars", ()) or ()]
+    inits = _carry_inits(op, env)
+
+    diff_carries = [n for n in carry_names if _is_float_val(inits[n])]
+    diff_capt = [n for n in captured if n in env and _is_float_val(env[n])]
+
+    def fwd(vals: Dict):
+        def true_branch(v):
+            benv = dict(env)
+            benv.update(inits)
+            benv.update(v)
+            lower_block_ops(ctx, program, sub, benv)
+            return {n: benv[n] for n in diff_carries}
+
+        def false_branch(v):
+            out = dict(inits)
+            out.update({n: v[n] for n in diff_carries})
+            return {n: out[n] for n in diff_carries}
+
+        return lax.cond(cond, true_branch, false_branch, vals)
+
+    _subblock_vjp(op, env, inits, fwd, diff_carries, diff_capt)
+
+
 def lower_static_rnn(ctx, program, op, env: Dict, lower_block_ops) -> None:
     """static_rnn op (recurrent_op.cc:222 redesigned as lax.scan).
 
@@ -100,22 +241,42 @@ def lower_static_rnn(ctx, program, op, env: Dict, lower_block_ops) -> None:
     step_in_inner = op.attr("step_input_vars")
     memories = op.attr("memories")  # list of [mem, init, updated]
     step_outputs = op.attr("step_outputs")  # list of [inner, outer]
+    # dynamic_rnn: per-row sequence lengths mask memory updates + outputs
+    # (the scan translation of the reference's rank-table batch shrinking,
+    # layers/control_flow.py:1541 DynamicRNN / lod_rank_table)
+    seq_len = env[op.input("SeqLen")[0]] if op.input("SeqLen") else None
 
     xs = tuple(jnp.swapaxes(env[n], 0, 1) for n in step_in_outer)  # [T,B,...]
     init = tuple(env[init_n] for _, init_n, _ in memories)
+    t_steps = xs[0].shape[0] if xs else int(op.attr("max_len", 0))
 
-    def body(carry, x_t):
+    def mask_to(active, new, old):
+        m = active.reshape(active.shape + (1,) * (new.ndim - active.ndim))
+        return jnp.where(m, new, old)
+
+    def body(carry, tx):
+        t, x_t = tx
         benv = dict(env)
         for (mem, _, _), c in zip(memories, carry):
             benv[mem] = c
         for name, v in zip(step_in_inner, x_t):
             benv[name] = v
         lower_block_ops(ctx, program, sub, benv)
-        new_carry = tuple(benv[upd] for _, _, upd in memories)
-        outs = tuple(benv[inner] for inner, _ in step_outputs)
+        if seq_len is None:
+            new_carry = tuple(benv[upd] for _, _, upd in memories)
+            outs = tuple(benv[inner] for inner, _ in step_outputs)
+        else:
+            active = t < seq_len.reshape(-1).astype(t.dtype)  # [B]
+            new_carry = tuple(
+                mask_to(active, benv[upd], c)
+                for (_, _, upd), c in zip(memories, carry))
+            outs = tuple(
+                mask_to(active, benv[inner], jnp.zeros_like(benv[inner]))
+                for inner, _ in step_outputs)
         return new_carry, outs
 
-    last_carry, stacked = lax.scan(body, init, xs)
+    ts = jnp.arange(t_steps)
+    last_carry, stacked = lax.scan(body, init, (ts, xs))
     for (inner, outer), seq in zip(step_outputs, stacked):
         env[outer] = jnp.swapaxes(seq, 0, 1)  # back to [B,T,...]
     for (mem, _, _), c in zip(memories, last_carry):
@@ -124,8 +285,11 @@ def lower_static_rnn(ctx, program, op, env: Dict, lower_block_ops) -> None:
 
 CONTROL_FLOW_OPS = {
     "while": lower_while,
+    "while_grad": lower_while_grad,
     "conditional_block": lower_conditional_block,
+    "conditional_block_grad": lower_conditional_block_grad,
     "static_rnn": lower_static_rnn,
+    "dynamic_rnn": lower_static_rnn,
 }
 
 
@@ -163,3 +327,4 @@ def lower_static_rnn_grad(ctx, program, op, env: Dict, lower_block_ops) -> None:
 
 
 CONTROL_FLOW_OPS["static_rnn_grad"] = lower_static_rnn_grad
+CONTROL_FLOW_OPS["dynamic_rnn_grad"] = lower_static_rnn_grad

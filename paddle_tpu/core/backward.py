@@ -231,9 +231,18 @@ def append_backward(
     # canonicalize: any var left with several partials gets its summed
     # ``<var>@GRAD`` materialized, so fetching a leaf gradient by name sees
     # the total, not one partial (reference _addup_repetitive_outputs_
-    # sums eagerly; we sum lazily, so flush here)
-    for n in [n for n, lst in contribs.items() if len(lst) > 1]:
-        resolve_out_grad(n)
+    # sums eagerly; we sum lazily, so flush here).  A single surviving
+    # @RENAME partial (in-place carry reset) is assigned onto the
+    # canonical name too — else the fetch would see the stale pre-reset
+    # partial.
+    for n, lst in list(contribs.items()):
+        canonical = grad_var_name(n)
+        if len(lst) > 1:
+            resolve_out_grad(n)
+        elif lst and lst[0] != canonical and grad_counts.get(n, 0):
+            _make_grad_var(block, canonical, n)
+            block.append_op("assign", {"X": [lst[0]]}, {"Out": [canonical]},
+                            {OP_ROLE_ATTR: OpRole.Backward})
 
     # collect (param, grad) pairs
     params = (

@@ -7,7 +7,10 @@ attention; three implementations sit behind one function:
 
 - ``xla``:    plain jnp einsum/softmax chain (XLA fuses; always available)
 - ``pallas``: tiled online-softmax flash-attention kernel (MXU-sized tiles,
-              VMEM accumulators; interpret mode off-TPU)
+              VMEM accumulators; interpret mode off-TPU), with optional
+              in-kernel attention-probability dropout (TPU PRNG seeded per
+              (batch·head, q-block, k-block) tile — regenerated bit-exactly
+              by the backward kernels, so no mask is ever materialized)
 - ``ring``:   sequence-parallel attention over a mesh axis — K/V shards
               rotate around the ring via ``lax.ppermute`` with online
               softmax merging, so attention over sequence length S uses
@@ -15,10 +18,11 @@ attention; three implementations sit behind one function:
               mechanism (SURVEY.md §5: absent in the 2018 reference,
               required here as first-class).
 
-Gradients: ``jax.custom_vjp`` — forward may run the Pallas kernel; backward
-recomputes with the XLA math (flash-style recompute; a Pallas backward
-kernel is a later optimization).  Ring attention differentiates through
-shard_map/ppermute natively.
+Gradients: ``jax.custom_vjp``.  The Pallas path saves only (out, LSE) and
+runs tiled backward kernels (dq accumulation over k-blocks; dk/dv
+accumulation over q-blocks) — O(block) memory for training at any sequence
+length, the FlashAttention-2 backward scheme.  Ring attention
+differentiates through shard_map/ppermute natively.
 """
 from __future__ import annotations
 
@@ -38,11 +42,15 @@ NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 def mha_xla(q, k, v, kv_mask=None, causal=False, sm_scale=None,
-            q_offset=0, kv_offset=0):
+            q_offset=0, kv_offset=0, dropout_rate=0.0, dropout_seed=None):
     """q,k,v: [B,H,Tq|Tk,D]; kv_mask: [B,Tk] 1/0; returns [B,H,Tq,D].
 
     q_offset/kv_offset give global positions for causal masking when the
-    sequence is sharded (ring attention)."""
+    sequence is sharded (ring attention).  ``dropout_rate`` applies
+    attention-prob dropout keyed by ``dropout_seed`` (deterministic per
+    seed, so a re-lowered backward sees the same mask; the bits differ
+    from the pallas kernel's tile hash — same distribution, either path
+    is self-consistent)."""
     if sm_scale is None:
         sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
@@ -53,6 +61,13 @@ def mha_xla(q, k, v, kv_mask=None, causal=False, sm_scale=None,
         ki = jnp.arange(k.shape[2])[None, :] + kv_offset
         s = jnp.where(qi >= ki, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate and dropout_rate > 0.0:
+        seed = (jnp.zeros((), jnp.int32) if dropout_seed is None
+                else jnp.asarray(dropout_seed, jnp.int32).reshape(()))
+        key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+        key = jax.random.fold_in(key, q_offset * 131071 + kv_offset)
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
@@ -60,14 +75,57 @@ def mha_xla(q, k, v, kv_mask=None, causal=False, sm_scale=None,
 # Pallas flash-attention forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+def _tile_scores(q_ref, k_ref, mask_ref, qi, kb, *, sm_scale, causal,
+                 block_q, block_k):
+    """Masked scaled scores for one (q-block, k-block) tile."""
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    k_blk = k_ref[:].astype(jnp.float32)
+    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+    mask = mask_ref[0, :]
+    s = jnp.where(mask[None, :] > 0, s, NEG_INF)
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _tile_dropout(seed_ref, bh, qi, kb, shape, rate: float):
+    """Regenerable dropout multiplier for one tile: a counter-based hash of
+    (base seed, tile coords, element coords) in plain vector ops — the same
+    bits in compiled and interpret mode, so forward and both backward
+    kernels reproduce the identical mask with nothing stored (reference
+    dropout_op.cc's saved Mask, made unnecessary).  Murmur3-style finalizer
+    over distinct odd multipliers per coordinate."""
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    x = rows * jnp.uint32(0x9E3779B1) ^ cols * jnp.uint32(0x85EBCA77)
+    x = x ^ (seed_ref[0].astype(jnp.uint32)
+             + jnp.uint32(bh).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+             + jnp.uint32(qi).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+             + jnp.uint32(kb).astype(jnp.uint32) * jnp.uint32(0x165667B1))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    # top 24 bits → uniform [0,1); mosaic lacks uint32→f32, so bitcast to
+    # int32 first (values < 2^24, sign-safe)
+    u = (jax.lax.bitcast_convert_type(x >> 8, jnp.int32)
+         .astype(jnp.float32) * jnp.float32(1.0 / (1 << 24)))
+    keep = u >= jnp.float32(rate)
+    return jnp.where(keep, 1.0 / (1.0 - rate), 0.0).astype(jnp.float32)
+
+
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                       m_scr, l_scr, acc_scr, *,
-                      sm_scale: float, causal: bool,
+                      sm_scale: float, causal: bool, dropout_rate: float,
                       block_q: int, block_k: int, num_kb: int):
     """Grid (B*H, nq, nk); K/V stream through VMEM one block_k tile at a
     time (nk is the sequential minor grid axis on TPU, so the online-softmax
     state lives in VMEM scratch across k iterations — O(block) memory at any
-    sequence length)."""
+    sequence length).  Emits the per-row logsumexp for the backward pass."""
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -77,16 +135,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[:].astype(jnp.float32) * sm_scale
-    k_blk = k_ref[:].astype(jnp.float32)
+    s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+                     causal=causal, block_q=block_q, block_k=block_k)
     v_blk = v_ref[:].astype(jnp.float32)
-    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-    mask = mask_ref[0, :]
-    s = jnp.where(mask[None, :] > 0, s, NEG_INF)
-    if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
 
     m, l, acc = m_scr[:], l_scr[:], acc_scr[:]
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -94,11 +145,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
     alpha = jnp.exp(m - m_new)
     m_scr[:] = m_new
     l_scr[:] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    if dropout_rate > 0.0:
+        # dropout applies to normalized probs; l accumulates undropped
+        p = p * _tile_dropout(seed_ref, bh, qi, kb, p.shape, dropout_rate)
     acc_scr[:] = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
 
     @pl.when(kb == num_kb - 1)
     def _finish():
-        o_ref[:] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        l_fin = l_scr[:]
+        o_ref[:] = (acc_scr[:] / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+        # rows with no unmasked keys (query padding): +inf LSE → p == 0
+        # everywhere in the backward kernels, never NaN.  LSE rides a
+        # whole-row [1, Tq] block (TPU tiling forbids 1D per-q-block
+        # outputs); each q-block writes its slice.
+        lse = jnp.where(l_fin > 0.0, m_scr[:] + jnp.log(jnp.maximum(l_fin, 1e-30)),
+                        jnp.float32(1e30))
+        lse_ref[0, pl.dslice(qi * block_q, block_q)] = lse[:, 0].astype(lse_ref.dtype)
 
 
 try:  # pallas import kept lazy-safe for exotic builds
@@ -119,79 +181,275 @@ def _pad_to(x, multiple, axis):
     return jnp.pad(x, widths), pad
 
 
-def mha_pallas(q, k, v, kv_mask=None, causal=False, sm_scale=None,
-               block_q=128, block_k=128, interpret=None):
-    """Flash-attention forward via pallas_call; grid (B*H, Tq/block_q)."""
-    if not _HAVE_PALLAS:
-        return mha_xla(q, k, v, kv_mask, causal, sm_scale)
+def _prep_padded(q, k, v, kv_mask, block_q, block_k):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Tk), jnp.float32)
+    q4, _ = _pad_to(q, block_q, 2)
+    k4, _ = _pad_to(k, block_k, 2)
+    v4, _ = _pad_to(v, block_k, 2)
+    mask2, _ = _pad_to(kv_mask.astype(jnp.float32), block_k, 1)
+    Tq_p, Tk_p = q4.shape[2], k4.shape[2]
+    qf = q4.reshape(B * H, Tq_p, D)
+    kf = k4.reshape(B * H, Tk_p, D)
+    vf = v4.reshape(B * H, Tk_p, D)
+    maskf = jnp.repeat(mask2[:, None, :], H, axis=1).reshape(B * H, 1, Tk_p)
+    return qf, kf, vf, maskf, Tq_p, Tk_p
+
+
+def _seed_arr(dropout_seed):
+    if dropout_seed is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+
+
+def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
+                dropout_seed=None, block_q=128, block_k=128, interpret=None):
+    """Returns (out [B,H,Tq,D], lse [B*H, Tq_padded])."""
     if sm_scale is None:
         sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, H, Tq, D = q.shape
-    Tk = k.shape[2]
-    if kv_mask is None:
-        kv_mask = jnp.ones((B, Tk), jnp.float32)
-
-    q4, pad_q = _pad_to(q, block_q, 2)
-    k4, pad_k = _pad_to(k, block_k, 2)
-    v4, _ = _pad_to(v, block_k, 2)
-    mask2, _ = _pad_to(kv_mask.astype(jnp.float32), block_k, 1)
-    Tq_p, Tk_p = q4.shape[2], k4.shape[2]
+    qf, kf, vf, maskf, Tq_p, Tk_p = _prep_padded(q, k, v, kv_mask,
+                                                 block_q, block_k)
     num_kb = Tk_p // block_k
-
-    qf = q4.reshape(B * H, Tq_p, D)
-    kf = k4.reshape(B * H, Tk_p, D)
-    vf = v4.reshape(B * H, Tk_p, D)
-    maskf = jnp.repeat(mask2[:, None, :], H, axis=1).reshape(B * H, 1, Tk_p)
-
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, sm_scale=sm_scale,
-        causal=causal, block_q=block_q, num_kb=num_kb)
-    out = pl.pallas_call(
+        causal=causal, dropout_rate=float(dropout_rate),
+        block_q=block_q, num_kb=num_kb)
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, Tq_p), jnp.float32),
+        ],
         grid=(B * H, Tq_p // block_q, num_kb),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # seed
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, 1, block_k), lambda b, i, j: (b, 0, j)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, 1, Tq_p), lambda b, i, j: (b, 0, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, maskf)
-    out = out.reshape(B, H, Tq_p, D)
-    return out[:, :, :Tq, :]
+    )(_seed_arr(dropout_seed), qf, kf, vf, maskf)
+    return out.reshape(B, H, Tq_p, D)[:, :, :Tq, :], lse
 
 
-# ---------------------------------------------------------------------------
-# custom-vjp wrapper: pallas forward, XLA-recompute backward
-# ---------------------------------------------------------------------------
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_attention(q, k, v, kv_mask, causal=False, sm_scale=None):
-    return mha_pallas(q, k, v, kv_mask, causal, sm_scale)
-
-
-def _fa_fwd(q, k, v, kv_mask, causal, sm_scale):
-    out = mha_pallas(q, k, v, kv_mask, causal, sm_scale)
-    return out, (q, k, v, kv_mask)
-
-
-def _fa_bwd(causal, sm_scale, res, g):
-    q, k, v, kv_mask = res
-    # recompute with the XLA math and differentiate it (flash recompute)
-    def f(q, k, v):
+def mha_pallas(q, k, v, kv_mask=None, causal=False, sm_scale=None,
+               block_q=128, block_k=128, interpret=None,
+               dropout_rate=0.0, dropout_seed=None):
+    """Flash-attention forward via pallas_call; grid (B*H, Tq/block_q)."""
+    if not _HAVE_PALLAS:
         return mha_xla(q, k, v, kv_mask, causal, sm_scale)
-    _, vjp_fn = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp_fn(g)
-    return dq, dk, dv, None
+    out, _ = _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate,
+                         dropout_seed, block_q, block_k, interpret)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention backward kernels (FlashAttention-2 scheme)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
+                         lse_ref, delta_ref, dq_ref, dq_scr, *,
+                         sm_scale, causal, dropout_rate,
+                         block_q, block_k, num_kb):
+    """Grid (B*H, nq, nk): dq accumulates across k-blocks in VMEM."""
+    bh, qi, kb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+                     causal=causal, block_q=block_q, block_k=block_k)
+    lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
+    delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
+    p = jnp.exp(s - lse[:, None])                           # [bq, bk]
+    do = do_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+    if dropout_rate > 0.0:
+        dp = dp * _tile_dropout(seed_ref, bh, qi, kb, dp.shape, dropout_rate)
+    ds = p * (dp - delta[:, None])
+    k_raw = k_ref[:].astype(jnp.float32)
+    dq_scr[:] += jnp.dot(ds, k_raw, preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
+                          lse_ref, delta_ref, dk_ref, dv_ref,
+                          dk_scr, dv_scr, *,
+                          sm_scale, causal, dropout_rate,
+                          block_q, block_k, num_qb):
+    """Grid (B*H, nk, nq): dk/dv accumulate across q-blocks in VMEM."""
+    bh, kb, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+                     causal=causal, block_q=block_q, block_k=block_k)
+    lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
+    delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
+    p = jnp.exp(s - lse[:, None])                           # [bq, bk]
+    do = do_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+    if dropout_rate > 0.0:
+        # same (bh, qi, kb) seeding as forward/dq → identical bits
+        drop = _tile_dropout(seed_ref, bh, qi, kb, p.shape, dropout_rate)
+        dv_scr[:] += jnp.dot((p * drop).T, do,
+                             preferred_element_type=jnp.float32)
+        dp = dp * drop
+    else:
+        dv_scr[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
+    q_raw = q_ref[:].astype(jnp.float32)
+    dk_scr[:] += jnp.dot(ds.T, q_raw, preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(qi == num_qb - 1)
+    def _finish():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
+                dropout_rate=0.0, dropout_seed=None,
+                block_q=128, block_k=128, interpret=None):
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Tq, D = q.shape
+    qf, kf, vf, maskf, Tq_p, Tk_p = _prep_padded(q, k, v, kv_mask,
+                                                 block_q, block_k)
+    gof, _ = _pad_to(g.reshape(B * H, Tq, D), block_q, 1)
+    outf, _ = _pad_to(out.reshape(B * H, Tq, D), block_q, 1)
+    delta = jnp.sum(gof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1)[:, None, :]                     # [BH, 1, Tq_p]
+    num_qb, num_kb = Tq_p // block_q, Tk_p // block_k
+    seed = _seed_arr(dropout_seed)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        dropout_rate=float(dropout_rate), block_q=block_q, block_k=block_k,
+        num_kb=num_kb)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+        grid=(B * H, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, 1, Tq_p), lambda b, i, j: (b, 0, 0)),
+            pl.BlockSpec((None, 1, Tq_p), lambda b, i, j: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(seed, qf, kf, vf, maskf, gof, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        dropout_rate=float(dropout_rate), block_q=block_q, block_k=block_k,
+        num_qb=num_qb)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk_p, D), v.dtype),
+        ],
+        grid=(B * H, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, 1, block_k), lambda b, j, i: (b, 0, j)),
+            pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, 1, Tq_p), lambda b, j, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, Tq_p), lambda b, j, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed, qf, kf, vf, maskf, gof, lse, delta)
+
+    Tk = k.shape[2]
+    dq = dq.reshape(B, H, Tq_p, D)[:, :, :Tq, :]
+    dk = dk.reshape(B, H, Tk_p, D)[:, :, :Tk, :]
+    dv = dv.reshape(B, H, Tk_p, D)[:, :, :Tk, :]
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper: pallas forward AND pallas backward (O(block) memory)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, kv_mask, causal=False, sm_scale=None,
+                    dropout_rate=0.0, dropout_seed=None):
+    """Flash attention with optional in-kernel attention-prob dropout.
+    ``dropout_seed``: int32 scalar/array; required when dropout_rate > 0
+    (vary it per training step for fresh masks)."""
+    if not _HAVE_PALLAS:
+        return mha_xla(q, k, v, kv_mask, causal, sm_scale,
+                       dropout_rate=dropout_rate, dropout_seed=dropout_seed)
+    return mha_pallas(q, k, v, kv_mask, causal, sm_scale,
+                      dropout_rate=dropout_rate, dropout_seed=dropout_seed)
+
+
+def _fa_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate, dropout_seed):
+    if not _HAVE_PALLAS:
+        out = mha_xla(q, k, v, kv_mask, causal, sm_scale,
+                      dropout_rate=dropout_rate, dropout_seed=dropout_seed)
+        return out, (q, k, v, kv_mask, dropout_seed, out, None)
+    out, lse = _pallas_fwd(q, k, v, kv_mask, causal, sm_scale,
+                           dropout_rate, dropout_seed)
+    return out, (q, k, v, kv_mask, dropout_seed, out, lse)
+
+
+def _fa_bwd(causal, sm_scale, dropout_rate, res, g):
+    q, k, v, kv_mask, dropout_seed, out, lse = res
+    if lse is None:  # no-pallas fallback: XLA recompute, same seed
+        def f(q, k, v):
+            return mha_xla(q, k, v, kv_mask, causal, sm_scale,
+                           dropout_rate=dropout_rate,
+                           dropout_seed=dropout_seed)
+        _, vjp_fn = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp_fn(g)
+        return dq, dk, dv, None, None
+    dq, dk, dv = _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
+                             dropout_rate, dropout_seed)
+    return dq, dk, dv, None, None
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -202,19 +460,27 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 # ---------------------------------------------------------------------------
 
 def ring_attention(q, k, v, kv_mask, axis_name: str, causal=False,
-                   sm_scale=None):
+                   sm_scale=None, dropout_rate=0.0, dropout_seed=None):
     """Blockwise ring attention (to be called under shard_map with the
     sequence dimension sharded over ``axis_name``).
 
     Each device holds local q/k/v shards [B,H,S/sp,D].  K/V rotate around
     the ring; partial attention outputs merge with online softmax, so no
     device ever materializes full-sequence scores — O(S/sp) memory.
+    Dropout (flash-style): l accumulates undropped probability mass while
+    o accumulates dropped contributions, keyed per (q-shard, kv-shard).
     """
     if sm_scale is None:
         sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     S_local = q.shape[2]
+    drop_key = None
+    if dropout_rate and dropout_rate > 0.0:
+        seed = (jnp.zeros((), jnp.int32) if dropout_seed is None
+                else jnp.asarray(dropout_seed, jnp.int32).reshape(()))
+        drop_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+        drop_key = jax.random.fold_in(drop_key, idx)
 
     def partial_attn(k_blk, v_blk, m_blk, kv_idx):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk).astype(jnp.float32) * sm_scale
@@ -226,6 +492,11 @@ def ring_attention(q, k, v, kv_mask, axis_name: str, causal=False,
         m_new = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m_new)
         l_new = jnp.sum(p, axis=-1, keepdims=True)
+        if drop_key is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(drop_key, kv_idx),
+                1.0 - dropout_rate, p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         o_new = jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
         return m_new, l_new, o_new
 

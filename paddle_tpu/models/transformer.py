@@ -70,19 +70,30 @@ def multi_head_attention(q_in, k_in, v_in, attn_bias, d_model, n_head,
             raise ValueError(
                 "attention_impl != 'base' requires the [B,T] kv_mask "
                 "(padding handled inside fused_attention)")
-        if dropout_rate:
-            warnings.warn(
-                "fused attention drops attention-probability dropout "
-                "(residual dropout still applies); use attention_impl='base' "
-                "for exact dropout parity", stacklevel=3)
         from ..layer_helper import LayerHelper
         helper = LayerHelper(param_prefix + ".fa")
         ctx = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+        inputs = {"Q": [q], "K": [k], "V": [v], "KvMask": [kv_mask]}
+        if dropout_rate:
+            # per-step int32 seed for the in-kernel attention-prob dropout
+            # (explicit program input → fwd and grad see identical bits).
+            # Drawn in the GLOBAL block: a stateful op inside a While/RNN
+            # sub-block would make the sub-block non-differentiable.
+            gb = helper.main_program.global_block
+            u = gb.create_var(name=helper.name + ".seed_u", dtype="float32",
+                              shape=(1,), stop_gradient=True)
+            gb.append_op(
+                "uniform_random", {}, {"Out": [u.name]},
+                {"shape": [1], "dtype": "float32", "min": 0.0, "max": 2.0e9})
+            seed = gb.create_var(name=helper.name + ".seed", dtype="int32",
+                                 shape=(1,), stop_gradient=True)
+            gb.append_op("cast", {"X": [u.name]}, {"Out": [seed.name]},
+                         {"out_dtype": "int32"})
+            inputs["Seed"] = [seed]
         helper.append_op(
-            "fused_attention",
-            {"Q": [q], "K": [k], "V": [v], "KvMask": [kv_mask]},
-            {"Out": [ctx]},
-            {"impl": impl, "causal": causal, "scale": d_key ** -0.5})
+            "fused_attention", inputs, {"Out": [ctx]},
+            {"impl": impl, "causal": causal, "scale": d_key ** -0.5,
+             "dropout_rate": dropout_rate})
     else:
         scores = fluid.layers.matmul(q, k, transpose_y=True, alpha=d_key ** -0.5)
         if attn_bias is not None:

@@ -21,7 +21,7 @@ from ..core.registry import register, register_grad
 from ..kernels import attention as A
 
 
-@register("fused_attention", no_grad_slots=("KvMask",))
+@register("fused_attention", no_grad_slots=("KvMask", "Seed"))
 def _fused_attention(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     kv_mask = ins["KvMask"][0] if ins.get("KvMask") else jnp.ones(
@@ -29,33 +29,49 @@ def _fused_attention(ctx, ins, attrs):
     causal = attrs.get("causal", False)
     scale = attrs.get("scale", None)
     impl = attrs.get("impl", "auto")
+    # attention-prob dropout runs INSIDE the flash kernel; the seed is an
+    # explicit program input (drawn per step by the layer) so the grad op
+    # re-lowers the identical computation — no stored mask, no stale rng
+    rate = float(attrs.get("dropout_rate", 0.0) or 0.0)
+    if not ctx.training or attrs.get("is_test", False):
+        rate = 0.0
+    seed = ins["Seed"][0] if ins.get("Seed") else None
     if impl == "auto":
         # the flash kernel wins at longer sequences; XLA's fused chain is
         # faster below its 128-wide block size (measured on v5e)
         impl = "pallas" if (jax.default_backend() == "tpu"
                             and k.shape[2] >= 256) else "xla"
+        if rate > 0.0:
+            impl = "pallas"  # in-kernel dropout needs the pallas path
 
     if impl == "xla":
-        out = A.mha_xla(q, k, v, kv_mask, causal, scale)
+        out = A.mha_xla(q, k, v, kv_mask, causal, scale,
+                        dropout_rate=rate, dropout_seed=seed)
     elif impl == "pallas":
-        out = A.flash_attention(q, k, v, kv_mask, causal, scale)
+        out = A.flash_attention(q, k, v, kv_mask, causal, scale,
+                                dropout_rate=rate, dropout_seed=seed)
     elif impl == "ring":
         mesh = ctx.mesh
         sp = attrs.get("sp_axis", "sp")
         if mesh is None or sp not in mesh.axis_names:
-            out = A.mha_xla(q, k, v, kv_mask, causal, scale)
+            out = A.mha_xla(q, k, v, kv_mask, causal, scale,
+                            dropout_rate=rate, dropout_seed=seed)
         else:
             dp = "dp" if "dp" in mesh.axis_names else None
             qspec = P(dp, None, sp, None)
             mspec = P(dp, sp)
+            sspec = P()
 
-            def ring(q, k, v, m):
-                return A.ring_attention(q, k, v, m, sp, causal, scale)
+            def ring(q, k, v, m, s):
+                return A.ring_attention(q, k, v, m, sp, causal, scale,
+                                        dropout_rate=rate, dropout_seed=s)
 
+            seed_in = (seed if seed is not None
+                       else jnp.zeros((1,), jnp.int32))
             out = jax.shard_map(
                 ring, mesh=mesh,
-                in_specs=(qspec, qspec, qspec, mspec),
-                out_specs=qspec)(q, k, v, kv_mask)
+                in_specs=(qspec, qspec, qspec, mspec, sspec),
+                out_specs=qspec)(q, k, v, kv_mask, seed_in)
     else:
         raise ValueError(f"unknown attention impl {impl!r}")
     return {"Out": [out]}
@@ -69,10 +85,13 @@ def _fused_attention_grad(ctx, ins, attrs):
     kv_mask = ins["KvMask"][0] if ins.get("KvMask") else jnp.ones(
         (q.shape[0], k.shape[2]), jnp.float32)
     g = ins["Out@GRAD"][0]
+    extra = {"KvMask": [kv_mask]}
+    if ins.get("Seed"):
+        extra["Seed"] = ins["Seed"]  # same seed → identical dropout bits
 
     def f(q, k, v):
         return _fused_attention(ctx, {"Q": [q], "K": [k], "V": [v],
-                                      "KvMask": [kv_mask]}, attrs)["Out"][0]
+                                      **extra}, attrs)["Out"][0]
 
     _, vjp_fn = jax.vjp(f, q, k, v)
     dq, dk, dv = vjp_fn(g)

@@ -43,9 +43,9 @@ def _carry_inits(op, env) -> Dict:
     host-op segmentation, unlike a trace-local stash)."""
     carried = op.attr("carry_vars")
     init_names = op.input("Init")
-    if len(init_names) != len(carried):
-        # conditional_block: carry_vars excludes the condition
-        carried = [n for n in carried if n != op.input("Condition")[0]]
+    assert len(init_names) == len(carried), (
+        f"Init snapshot count {len(init_names)} != carries {len(carried)} "
+        f"for {op.type}")
     return {n: env[i] for n, i in zip(carried, init_names)}
 
 
@@ -61,7 +61,10 @@ def lower_while(ctx, program, op, env: Dict, lower_block_ops) -> None:
     cond_name = op.input("Condition")[0]
     carry_names = [n for n in op.attr("carry_vars") if n != cond_name]
 
-    if op.attr("max_iters"):
+    if op.attr("max_iters") and ctx.training:
+        # training: the same masked scan the grad differentiates (fwd/bwd
+        # truncate together at the bound); inference keeps lax.while_loop
+        # and exits early instead of paying max_iters masked iterations
         inits = {n: env[n] for n in [cond_name] + carry_names}
         out = _while_as_masked_scan(ctx, program, op, env, lower_block_ops,
                                     inits, {})

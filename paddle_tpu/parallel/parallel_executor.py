@@ -154,17 +154,19 @@ class ParallelExecutor(Executor):
             # each process feeds its LOCAL batch (nccl2-mode trainers each
             # read their own shard); the global batch is their dp-concat
             local_dp = dp // jax.process_count()
-            if local_dp > 0 and arr.ndim >= 1 and arr.shape[0] > 0 \
+            # scalar / unit-leading-dim feeds (e.g. the kCustomized
+            # loss-grad seed) are by contract identical on every trainer →
+            # replicate.  Checked BEFORE the shard branch: with
+            # local_dp == 1 a (1,)-shaped seed would otherwise be
+            # dp-concatenated across processes and shape-mismatch the var.
+            if arr.ndim == 0 or arr.shape[0] == 1:
+                return self._make_global(arr, self._replicated())
+            if local_dp > 0 and arr.shape[0] > 0 \
                     and arr.shape[0] % local_dp == 0:
                 sharding = NamedSharding(
                     self.mesh, P(self._dp_axis, *([None] * (arr.ndim - 1))))
                 return jax.make_array_from_process_local_data(
                     sharding, np.asarray(arr))
-            if arr.ndim == 0 or (arr.ndim >= 1 and arr.shape[0] == 1):
-                # scalar / unit-leading-dim feeds (e.g. the kCustomized
-                # loss-grad seed) are by contract identical on every
-                # trainer → replicate
-                return self._make_global(arr, self._replicated())
             raise ValueError(
                 f"multi-host feed of shape {getattr(arr, 'shape', ())} does "
                 f"not divide the local dp degree {local_dp}; pad the batch "

@@ -161,3 +161,71 @@ def test_transformer_attention_impl_parity():
     base, fused, pallas = run("base"), run("xla"), run("pallas")
     assert abs(base - fused) < 2e-4, (base, fused)
     assert abs(base - pallas) < 1e-3, (base, pallas)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels + in-kernel dropout
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_bwd_kernels_match_xla(causal):
+    """dq/dk/dv from the tiled Pallas backward == XLA autodiff, with key
+    padding masks and multi-block grids."""
+    q, k, v, mask = qkv(T=96)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(A.flash_attention(q, k, v, mask, causal, None) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(A.mha_xla(q, k, v, mask, causal) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_dropout_deterministic_and_scaled():
+    q, k, v, mask = qkv(T=64)
+    seed = jnp.asarray([42], jnp.int32)
+    a1 = A.flash_attention(q, k, v, mask, False, None, 0.3, seed)
+    a2 = A.flash_attention(q, k, v, mask, False, None, 0.3, seed)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    b = A.flash_attention(q, k, v, mask, False, None, 0.3,
+                          jnp.asarray([43], jnp.int32))
+    assert np.abs(np.asarray(a1) - np.asarray(b)).max() > 1e-4
+    # dropout preserves the expectation (inverted scaling): means close
+    base = A.flash_attention(q, k, v, mask, False, None)
+    outs = [A.flash_attention(q, k, v, mask, False, None, 0.3,
+                              jnp.asarray([s], jnp.int32))
+            for s in range(16)]
+    avg = np.mean([np.asarray(o) for o in outs], axis=0)
+    corr = np.corrcoef(avg.ravel(), np.asarray(base).ravel())[0, 1]
+    assert corr > 0.95, corr
+
+
+def test_flash_dropout_grad_is_directional_derivative():
+    """With a fixed seed the dropped attention is a deterministic function;
+    its autodiff gradient must match a finite-difference directional
+    derivative (validates the regenerated masks agree across fwd/dq/dkv)."""
+    q, k, v, mask = qkv(B=1, H=2, T=32, D=16)
+    seed = jnp.asarray([7], jnp.int32)
+    rate = 0.4
+
+    def f(q, k, v):
+        return jnp.sum(A.flash_attention(q, k, v, mask, False, None,
+                                         rate, seed) ** 2)
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    rs = np.random.RandomState(3)
+    for i, x in enumerate((q, k, v)):
+        d = rs.randn(*x.shape).astype("float32")
+        eps = 1e-2
+        args_p = [q, k, v]
+        args_m = [q, k, v]
+        args_p[i] = x + eps * d
+        args_m[i] = x - eps * d
+        num = (float(f(*args_p)) - float(f(*args_m))) / (2 * eps)
+        ana = float(jnp.vdot(g[i], d))
+        np.testing.assert_allclose(num, ana, rtol=2e-2, atol=2e-2)

@@ -27,6 +27,7 @@ from . import clip  # noqa: F401
 from . import data  # noqa: F401
 from . import initializer  # noqa: F401
 from . import io  # noqa: F401
+from . import metrics  # noqa: F401
 from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

@@ -324,6 +324,87 @@ def accuracy(input, label, k=1, correct=None, total=None):
     return acc
 
 
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=0):
+    """Streaming in-graph ROC-AUC (reference layers/metric_op.py auc /
+    auc_op.cc).  Threshold-bucket histograms live as persistable state
+    vars updated every step; returns (auc_value, [stat_pos, stat_neg])."""
+    from ..initializer import ConstantInitializer
+
+    if curve != "ROC":
+        raise NotImplementedError(f"auc curve={curve!r}: only ROC is "
+                                  f"implemented (PR-AUC is not)")
+    if topk != 1 or slide_steps not in (0, 1):
+        raise NotImplementedError(
+            "auc topk>1 / sliding-window accumulation are not implemented; "
+            "use the default all-time accumulation")
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        shape=(num_thresholds + 1,), dtype="int64", persistable=True,
+        name=helper.name + ".stat_pos")
+    stat_neg = helper.create_global_variable(
+        shape=(num_thresholds + 1,), dtype="int64", persistable=True,
+        name=helper.name + ".stat_neg")
+    helper.set_variable_initializer(stat_pos, ConstantInitializer(0.0))
+    helper.set_variable_initializer(stat_neg, ConstantInitializer(0.0))
+    auc_out = helper.create_variable_for_type_inference(
+        "float32", shape=(), stop_gradient=True)
+    helper.append_op(
+        "auc",
+        {"Predict": [input], "Label": [label],
+         "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        {"AUC": [auc_out], "StatPosOut": [stat_pos],
+         "StatNegOut": [stat_neg]},
+        {"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out, [stat_pos, stat_neg]
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """Batched Levenshtein distance over padded id sequences (reference
+    nn.py edit_distance / edit_distance_op.cc); returns (dist [B,1],
+    seq_num)."""
+    helper = LayerHelper("edit_distance", name=name)
+    dist = helper.create_variable_for_type_inference(
+        "float32", shape=(input.shape[0], 1), stop_gradient=True)
+    seq_num = helper.create_variable_for_type_inference(
+        "int64", shape=(), stop_gradient=True)
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLen"] = [input_length]
+    if label_length is not None:
+        ins["RefsLen"] = [label_length]
+    helper.append_op("edit_distance", ins,
+                     {"Out": [dist], "SequenceNum": [seq_num]},
+                     {"normalized": normalized})
+    return dist, seq_num
+
+
+def precision_recall(max_probs, indices, labels, class_number, name=None):
+    """Multi-class precision/recall with running per-class stats
+    (precision_recall_op.cc); returns (batch_metrics[6], accum_metrics[6])
+    = macro/micro precision, recall, F1."""
+    from ..initializer import ConstantInitializer
+
+    helper = LayerHelper("precision_recall", name=name)
+    states = helper.create_global_variable(
+        shape=(class_number, 4), dtype="float32", persistable=True,
+        name=helper.name + ".states")
+    helper.set_variable_initializer(states, ConstantInitializer(0.0))
+    batch_m = helper.create_variable_for_type_inference(
+        "float32", shape=(6,), stop_gradient=True)
+    accum_m = helper.create_variable_for_type_inference(
+        "float32", shape=(6,), stop_gradient=True)
+    helper.append_op(
+        "precision_recall",
+        {"MaxProbs": [max_probs], "Indices": [indices], "Labels": [labels],
+         "StatesInfo": [states]},
+        {"BatchMetrics": [batch_m], "AccumMetrics": [accum_m],
+         "AccumStatesInfo": [states]},
+        {"class_number": class_number})
+    return batch_m, accum_m
+
+
 # ---------------------------------------------------------------------------
 # tensor manipulation
 # ---------------------------------------------------------------------------

@@ -1,0 +1,293 @@
+"""Python-side metric accumulators (reference
+``python/paddle/fluid/metrics.py``): numpy state updated from fetched
+batch outputs, queried with ``eval()``.  In-graph counterparts live in
+``ops/metric_ops.py`` (auc / precision_recall / edit_distance) and
+``layers.accuracy``/``layers.auc``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
+           "Accuracy", "ChunkEvaluator", "EditDistance", "Auc",
+           "DetectionMAP"]
+
+
+def _to_np(x):
+    return np.asarray(x)
+
+
+class MetricBase:
+    """State-holder contract: update(...) per batch, eval() -> metric,
+    reset() between passes (metrics.py:46 MetricBase)."""
+
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+    def get_config(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+
+class CompositeMetric(MetricBase):
+    """Bundle of metrics updated together (metrics.py:141)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise TypeError("add_metric expects a MetricBase instance")
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    """Binary precision over {0,1} predictions (metrics.py:190)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_np(preds)).reshape(-1).astype(np.int64)
+        labels = _to_np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        return self.tp / (self.tp + self.fp) if self.tp + self.fp else 0.0
+
+
+class Recall(MetricBase):
+    """Binary recall (metrics.py:239)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_np(preds)).reshape(-1).astype(np.int64)
+        labels = _to_np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        return self.tp / (self.tp + self.fn) if self.tp + self.fn else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted running mean of the in-graph accuracy op's batch values
+    (metrics.py:286)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        if weight < 0:
+            raise ValueError("weight must be non-negative")
+        self.value += float(value) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy has accumulated no batches")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """Chunk-level precision/recall/F1 from per-batch chunk counts
+    (metrics.py:336, fed by chunk counting — see ``extract_chunks`` for
+    IOB-style tag decoding)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+    @staticmethod
+    def extract_chunks(tags, scheme="IOB", num_types=None):
+        """Decode an IOB tag sequence (0=O; B=1+2t, I=2+2t for type t)
+        into {(start, end, type)} — the chunk_eval_op.cc decoding."""
+        chunks = set()
+        start, ctype = None, None
+        for i, tag in enumerate(list(tags) + [0]):
+            tag = int(tag)
+            if tag == 0:
+                t, kind = None, "O"
+            else:
+                t, kind = (tag - 1) // 2, ("B" if (tag - 1) % 2 == 0 else "I")
+            if start is not None and (kind in ("B", "O") or t != ctype):
+                chunks.add((start, i - 1, ctype))
+                start, ctype = None, None
+            if kind == "B":
+                start, ctype = i, t
+            elif kind == "I" and start is None:
+                start, ctype = i, t  # tolerant IOB: I after O starts a chunk
+        return chunks
+
+    def update_from_tags(self, infer_tags, label_tags, seq_lens=None):
+        """Convenience: update from padded tag matrices [B, T]."""
+        infer_tags = _to_np(infer_tags)
+        label_tags = _to_np(label_tags)
+        for b in range(infer_tags.shape[0]):
+            ln = (int(seq_lens[b]) if seq_lens is not None
+                  else infer_tags.shape[1])
+            inf = self.extract_chunks(infer_tags[b, :ln])
+            lab = self.extract_chunks(label_tags[b, :ln])
+            self.update(len(inf), len(lab), len(inf & lab))
+
+
+class EditDistance(MetricBase):
+    """Average edit distance + instance error rate, fed by the
+    edit_distance op's batch outputs (metrics.py:445)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        d = _to_np(distances).reshape(-1)
+        self.total_distance += float(np.sum(d))
+        self.seq_num += int(seq_num) if seq_num is not None else d.size
+        self.instance_error += int(np.sum(d > 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance has accumulated no sequences")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    """Streaming ROC-AUC with threshold buckets — the python twin of the
+    auc op (metrics.py:524)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self._num_thresholds + 1, np.int64)
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        pos_score = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 \
+            else preds.reshape(-1)
+        labels = _to_np(labels).reshape(-1).astype(np.int64)
+        bucket = np.clip((pos_score * self._num_thresholds).astype(np.int64),
+                         0, self._num_thresholds)
+        np.add.at(self._stat_pos, bucket[labels == 1], 1)
+        np.add.at(self._stat_neg, bucket[labels == 0], 1)
+
+    def eval(self):
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tot = tp[-1] * fp[-1]
+        if tot == 0:
+            return 0.0
+        tp_prev = np.concatenate([[0], tp[:-1]])
+        fp_prev = np.concatenate([[0], fp[:-1]])
+        area = np.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+        return float(area / tot)
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision over detection results (metrics.py:600
+    capability; takes per-image lists of (class, score, matched) records
+    accumulated against ground-truth counts)."""
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 ap_version="integral"):
+        super().__init__(name)
+        self.ap_version = ap_version
+        self.overlap_threshold = overlap_threshold
+        self.reset()
+
+    def reset(self):
+        self._records = {}   # class -> list of (score, is_tp)
+        self._gt_counts = {}
+
+    def update(self, detections, gt_counts):
+        """detections: iterable of (class_id, score, is_true_positive);
+        gt_counts: {class_id: #ground-truth boxes in this batch}."""
+        for cls, score, is_tp in detections:
+            self._records.setdefault(int(cls), []).append(
+                (float(score), bool(is_tp)))
+        for cls, cnt in gt_counts.items():
+            self._gt_counts[int(cls)] = self._gt_counts.get(int(cls), 0) + int(cnt)
+
+    def eval(self):
+        aps = []
+        for cls, gt in self._gt_counts.items():
+            if gt == 0:
+                continue
+            recs = sorted(self._records.get(cls, []), reverse=True)
+            tp_cum, ap_points = 0, []
+            for i, (score, is_tp) in enumerate(recs):
+                tp_cum += int(is_tp)
+                ap_points.append((tp_cum / gt, tp_cum / (i + 1)))
+            ap, prev_recall = 0.0, 0.0
+            for recall, precision in ap_points:
+                ap += (recall - prev_recall) * precision
+                prev_recall = recall
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

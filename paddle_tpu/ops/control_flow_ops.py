@@ -71,20 +71,29 @@ def lower_while(ctx, program, op, env: Dict, lower_block_ops) -> None:
         env.update(out)
         return
 
+    # max_iters stays a hard cap at inference too (early exit, but never
+    # more than the bound — matching the training masked scan)
+    max_iters = int(op.attr("max_iters", 0) or 0)
+
     def cond_fn(carry):
-        return carry[0].reshape(()).astype(jnp.bool_)
+        alive = carry[0].reshape(()).astype(jnp.bool_)
+        if max_iters:
+            alive = jnp.logical_and(alive, carry[1] < max_iters)
+        return alive
 
     def body_fn(carry):
         benv = dict(env)
         benv[cond_name] = carry[0]
-        benv.update(zip(carry_names, carry[1:]))
+        benv.update(zip(carry_names, carry[2:]))
         lower_block_ops(ctx, program, sub, benv)
-        return (benv[cond_name],) + tuple(benv[n] for n in carry_names)
+        return ((benv[cond_name], carry[1] + 1)
+                + tuple(benv[n] for n in carry_names))
 
-    init = (env[cond_name],) + tuple(env[n] for n in carry_names)
+    init = ((env[cond_name], jnp.zeros((), jnp.int32))
+            + tuple(env[n] for n in carry_names))
     res = lax.while_loop(cond_fn, body_fn, init)
     env[cond_name] = res[0]
-    env.update(zip(carry_names, res[1:]))
+    env.update(zip(carry_names, res[2:]))
 
 
 def _while_as_masked_scan(ctx, program, op, env: Dict, lower_block_ops,

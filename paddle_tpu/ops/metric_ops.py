@@ -1,0 +1,145 @@
+"""In-graph metric ops: auc, precision_recall stats, edit_distance.
+
+Reference: ``paddle/fluid/operators/auc_op.cc`` (threshold-bucketed
+TP/FP histograms accumulated across batches as in/out state tensors),
+``precision_recall_op.cc`` and ``edit_distance_op.cc`` (per-pair
+Levenshtein).  The python-side accumulators in ``paddle_tpu/metrics.py``
+wrap these (reference ``python/paddle/fluid/metrics.py``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+@register("auc", no_grad_slots=("Predict", "Label", "StatPos", "StatNeg"))
+def _auc(ctx, ins, attrs):
+    """ROC-AUC over accumulated threshold buckets (auc_op.cc).
+
+    Predict [N, 2] (P(neg), P(pos)) or [N, 1]/[N] positive scores;
+    Label [N, 1] {0,1}; StatPos/StatNeg [T+1] running histograms.
+    Outputs AUC scalar + updated stats (write them back to the same
+    persistable vars to accumulate across batches).
+    """
+    num_t = int(attrs.get("num_thresholds", 4095))
+    pred = ins["Predict"][0]
+    if pred.ndim == 2 and pred.shape[1] == 2:
+        pos_score = pred[:, 1]
+    else:
+        pos_score = pred.reshape(-1)
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+
+    bucket = jnp.clip((pos_score * num_t).astype(jnp.int32), 0, num_t)
+    one = jnp.ones_like(bucket, dtype=stat_pos.dtype)
+    new_pos = stat_pos.at[bucket].add(jnp.where(label == 1, one, 0))
+    new_neg = stat_neg.at[bucket].add(jnp.where(label == 0, one, 0))
+
+    # trapezoid rule over buckets scanned from the highest threshold
+    pos_r = new_pos[::-1]
+    neg_r = new_neg[::-1]
+    tp = jnp.cumsum(pos_r)
+    fp = jnp.cumsum(neg_r)
+    tp_prev = jnp.concatenate([jnp.zeros((1,), tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros((1,), fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    total = tp[-1] * fp[-1]
+    auc = jnp.where(total > 0, area / jnp.maximum(total, 1), 0.0)
+    return {"AUC": [auc.astype(jnp.float32)],
+            "StatPosOut": [new_pos], "StatNegOut": [new_neg]}
+
+
+@register("precision_recall",
+          no_grad_slots=("MaxProbs", "Indices", "Labels", "StatesInfo"))
+def _precision_recall(ctx, ins, attrs):
+    """Multi-class precision/recall stats (precision_recall_op.cc).
+
+    Indices [N,1] predicted class, Labels [N,1]; StatesInfo [C,4] running
+    (TP, FP, TN, FN) per class.  Outputs BatchMetrics/AccumMetrics
+    [6] = (macro-P, macro-R, macro-F1, micro-P, micro-R, micro-F1) and
+    the updated StatesInfo.
+    """
+    num_classes = int(attrs["class_number"])
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    states = ins["StatesInfo"][0]  # [C, 4]
+
+    onehot_pred = jax.nn.one_hot(idx, num_classes, dtype=states.dtype)
+    onehot_lbl = jax.nn.one_hot(label, num_classes, dtype=states.dtype)
+    tp = jnp.sum(onehot_pred * onehot_lbl, axis=0)
+    fp = jnp.sum(onehot_pred * (1 - onehot_lbl), axis=0)
+    fn = jnp.sum((1 - onehot_pred) * onehot_lbl, axis=0)
+    n = idx.shape[0]
+    tn = jnp.full_like(tp, n) - tp - fp - fn
+
+    def metrics(tp, fp, tn, fn):
+        prec = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1), 0.0)
+        rec = jnp.where(tp + fn > 0, tp / jnp.maximum(tp + fn, 1), 0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+        macro = (jnp.mean(prec), jnp.mean(rec), jnp.mean(f1))
+        stp, sfp, sfn = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+        mp = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1), 0.0)
+        mr = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12),
+                       0.0)
+        return jnp.stack(macro + (mp, mr, mf)).astype(jnp.float32)
+
+    batch = metrics(tp, fp, tn, fn)
+    new_states = states + jnp.stack([tp, fp, tn, fn], axis=1)
+    accum = metrics(new_states[:, 0], new_states[:, 1], new_states[:, 2],
+                    new_states[:, 3])
+    return {"BatchMetrics": [batch], "AccumMetrics": [accum],
+            "AccumStatesInfo": [new_states]}
+
+
+@register("edit_distance", no_grad_slots=("Hyps", "Refs", "HypsLen", "RefsLen"))
+def _edit_distance(ctx, ins, attrs):
+    """Batched Levenshtein distance over padded id sequences
+    (edit_distance_op.cc).  Hyps [B, Th], Refs [B, Tr] + length vectors;
+    ``normalized`` divides by the reference length."""
+    hyps = ins["Hyps"][0].astype(jnp.int32)
+    refs = ins["Refs"][0].astype(jnp.int32)
+    b, th = hyps.shape
+    tr = refs.shape[1]
+    hyp_len = (ins["HypsLen"][0].reshape(-1).astype(jnp.int32)
+               if ins.get("HypsLen") else jnp.full((b,), th, jnp.int32))
+    ref_len = (ins["RefsLen"][0].reshape(-1).astype(jnp.int32)
+               if ins.get("RefsLen") else jnp.full((b,), tr, jnp.int32))
+
+    # DP rows: carry [B, Tr+1]; row_i[j] = dist(hyp[:i], ref[:j]).
+    # Positions beyond a sequence's length are frozen by masking.
+    init = jnp.broadcast_to(
+        jnp.minimum(jnp.arange(tr + 1), ref_len[:, None]).astype(jnp.float32),
+        (b, tr + 1))
+
+    def step(row, ti):
+        h_t = hyps[:, ti]                                     # [B]
+        sub_cost = (refs != h_t[:, None]).astype(jnp.float32)  # [B, Tr]
+        active = (ti < hyp_len).astype(jnp.float32)[:, None]
+
+        def inner(left, j):
+            up = row[:, j + 1] + 1.0
+            diag = row[:, j] + sub_cost[:, j]
+            val = jnp.minimum(jnp.minimum(left + 1.0, up), diag)
+            # columns beyond ref_len freeze at the ref_len column value
+            val = jnp.where(j + 1 <= ref_len, val, left)
+            return val, val
+
+        first = row[:, 0] + 1.0
+        _, cols = lax.scan(inner, first, jnp.arange(tr))
+        new_row = jnp.concatenate([first[None, :], cols], axis=0).T  # [B,Tr+1]
+        row = active * new_row + (1.0 - active) * row
+        return row, None
+
+    final, _ = lax.scan(step, init, jnp.arange(th))
+    dist = jnp.take_along_axis(final, ref_len[:, None].astype(jnp.int32),
+                               axis=1)                        # [B,1]
+    if attrs.get("normalized", True):
+        dist = dist / jnp.maximum(ref_len[:, None].astype(jnp.float32), 1.0)
+    return {"Out": [dist.astype(jnp.float32)],
+            "SequenceNum": [jnp.asarray(b, jnp.int64)]}

@@ -154,12 +154,12 @@ class ParallelExecutor(Executor):
             # each process feeds its LOCAL batch (nccl2-mode trainers each
             # read their own shard); the global batch is their dp-concat
             local_dp = dp // jax.process_count()
-            # scalar / unit-leading-dim feeds (e.g. the kCustomized
-            # loss-grad seed) are by contract identical on every trainer →
-            # replicate.  Checked BEFORE the shard branch: with
-            # local_dp == 1 a (1,)-shaped seed would otherwise be
-            # dp-concatenated across processes and shape-mismatch the var.
-            if arr.ndim == 0 or arr.shape[0] == 1:
+            # ONLY true scalars replicate implicitly (the kCustomized
+            # loss-grad seed as shape ()); a (1,)-leading feed is
+            # ambiguous — it could be a genuine per-trainer batch of one —
+            # so it goes through the shard/error paths below and a
+            # replicated-by-contract (1,) seed must be fed as shape ()
+            if arr.ndim == 0:
                 return self._make_global(arr, self._replicated())
             if local_dp > 0 and arr.shape[0] > 0 \
                     and arr.shape[0] % local_dp == 0:

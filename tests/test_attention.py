@@ -170,8 +170,10 @@ def test_transformer_attention_impl_parity():
 @pytest.mark.parametrize("causal", [False, True])
 def test_pallas_bwd_kernels_match_xla(causal):
     """dq/dk/dv from the tiled Pallas backward == XLA autodiff, with key
-    padding masks and multi-block grids."""
-    q, k, v, mask = qkv(T=96)
+    padding masks and a genuinely multi-block grid (T=300 > 2x128: three
+    q-blocks x three k-blocks exercises scratch resets and cross-block
+    accumulation, plus ragged padding)."""
+    q, k, v, mask = qkv(T=300, D=16)
 
     def loss_flash(q, k, v):
         return jnp.sum(A.flash_attention(q, k, v, mask, causal, None) ** 2)

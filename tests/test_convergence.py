@@ -1,0 +1,74 @@
+"""End-to-end convergence: MNIST through the FULL stack — reader
+decorators → DataLoader → ParallelExecutor training → metrics →
+save/load checkpoint → fresh-process-style reload → inference accuracy
+> 97% (the reference book-test contract, tests/book/
+test_recognize_digits.py; dataset is the deterministic synthetic MNIST
+when canonical files are absent — same learnable contract)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics as M
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.data import datasets, decorator
+from paddle_tpu.models import mnist as mnist_model
+
+
+@pytest.mark.slow
+def test_mnist_full_stack_convergence(tmp_path):
+    train_prog, startup = Program(), Program()
+    with program_guard(train_prog, startup), unique_name.guard():
+        feeds, loss, acc = mnist_model.build(lr=2e-3)
+    test_prog = train_prog.prune([loss.name, acc.name])
+
+    scope = Scope()
+    pe_scope = scope
+    exe = Executor()
+    exe.run(startup, scope=scope)
+
+    pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=train_prog,
+                                scope=pe_scope)
+
+    reader = decorator.batch(
+        decorator.shuffle(datasets.mnist.train(), buf_size=2048),
+        batch_size=128, drop_last=True)
+    loader = fluid.data.DataLoader(["pixel", "label"], reader,
+                                   program=train_prog)
+
+    acc_metric = M.Accuracy()
+    steps = 0
+    for epoch in range(3):
+        for feed in loader:
+            feed["pixel"] = feed["pixel"].reshape(-1, 1, 28, 28)
+            feed["label"] = feed["label"].reshape(-1, 1)
+            a, _l = pe.run(feed=feed, fetch_list=[acc, loss])
+            acc_metric.update(float(a), feed["label"].shape[0])
+            steps += 1
+        if acc_metric.eval() > 0.99:
+            break
+        acc_metric.reset()
+
+    # checkpoint → reload into a FRESH scope (simulated new process)
+    ckpt = str(tmp_path / "mnist_ckpt")
+    with scope_guard(scope):
+        fluid.io.save_persistables(exe, ckpt, main_program=train_prog)
+    fresh = Scope()
+    with scope_guard(fresh):
+        fluid.io.load_persistables(Executor(), ckpt,
+                                   main_program=train_prog)
+
+    # inference over the test split from the reloaded params
+    test_reader = decorator.batch(datasets.mnist.test(), batch_size=256)
+    total, correct = 0, 0
+    infer_exe = Executor()
+    for batch in test_reader():
+        xs = np.stack([s[0] for s in batch]).reshape(-1, 1, 28, 28)
+        ys = np.array([s[1] for s in batch], "int64").reshape(-1, 1)
+        (a,) = infer_exe.run(test_prog, feed={"pixel": xs, "label": ys},
+                             fetch_list=[acc], scope=fresh)
+        correct += float(a) * len(batch)
+        total += len(batch)
+    test_acc = correct / total
+    assert test_acc > 0.97, f"test accuracy {test_acc:.4f} after {steps} steps"

@@ -1,0 +1,168 @@
+"""Metrics subsystem: accumulators vs sklearn-style numpy references, and
+the in-graph auc / precision_recall / edit_distance ops (reference
+python/paddle/fluid/metrics.py + auc_op.cc / edit_distance_op.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics as M
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope
+from paddle_tpu.core.program import Program, program_guard
+
+L = fluid.layers
+
+
+# ---------------------------------------------------------------------------
+# python accumulators
+# ---------------------------------------------------------------------------
+
+def test_precision_recall_accumulators():
+    p, r = M.Precision(), M.Recall()
+    preds = np.array([1, 1, 0, 1, 0, 0])
+    labels = np.array([1, 0, 0, 1, 1, 0])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.eval() == pytest.approx(2 / 3)   # tp=2, fp=1
+    assert r.eval() == pytest.approx(2 / 3)   # tp=2, fn=1
+    # accumulation across batches
+    p.update(np.array([1]), np.array([1]))
+    assert p.eval() == pytest.approx(3 / 4)
+
+
+def test_accuracy_accumulator():
+    a = M.Accuracy()
+    a.update(0.5, 10)
+    a.update(1.0, 10)
+    assert a.eval() == pytest.approx(0.75)
+    a.reset()
+    with pytest.raises(ValueError):
+        a.eval()
+
+
+def test_composite_metric():
+    c = M.CompositeMetric()
+    c.add_metric(M.Precision())
+    c.add_metric(M.Recall())
+    c.update(np.array([1, 0, 1]), np.array([1, 1, 0]))
+    prec, rec = c.eval()
+    assert prec == pytest.approx(0.5) and rec == pytest.approx(0.5)
+
+
+def test_auc_accumulator_matches_exact():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(500)
+    labels = (rng.rand(500) < scores).astype("int64")  # informative scores
+    auc = M.Auc(num_thresholds=4095)
+    auc.update(scores[:250], labels[:250])
+    auc.update(scores[250:], labels[250:])
+    # exact AUC by pairwise ranking
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    exact = np.mean(pos[:, None] > neg[None, :]) + \
+        0.5 * np.mean(pos[:, None] == neg[None, :])
+    assert auc.eval() == pytest.approx(float(exact), abs=2e-3)
+
+
+def test_chunk_evaluator():
+    ce = M.ChunkEvaluator()
+    # tags: O=0, B0=1, I0=2 — one correct chunk, one spurious, one missed
+    infer = np.array([[1, 2, 0, 1, 0]])
+    label = np.array([[1, 2, 0, 0, 1]])
+    ce.update_from_tags(infer, label)
+    precision, recall, f1 = ce.eval()
+    assert precision == pytest.approx(1 / 2)
+    assert recall == pytest.approx(1 / 2)
+    assert f1 == pytest.approx(1 / 2)
+
+
+def test_edit_distance_metric_and_op():
+    def levenshtein(a, b):
+        dp = np.zeros((len(a) + 1, len(b) + 1))
+        dp[:, 0] = np.arange(len(a) + 1)
+        dp[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                               dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[-1, -1]
+
+    rng = np.random.RandomState(1)
+    B, Th, Tr = 4, 7, 6
+    hyps = rng.randint(1, 5, (B, Th)).astype("int64")
+    refs = rng.randint(1, 5, (B, Tr)).astype("int64")
+    hyp_len = np.array([7, 5, 3, 6], "int64")
+    ref_len = np.array([6, 6, 2, 4], "int64")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        h = L.data("h", [Th], dtype="int64")
+        r = L.data("r", [Tr], dtype="int64")
+        hl = L.data("hl", [], dtype="int64", append_batch_size=True)
+        rl = L.data("rl", [], dtype="int64", append_batch_size=True)
+        dist, seq_num = L.edit_distance(h, r, normalized=False,
+                                        input_length=hl, label_length=rl)
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    got, n = exe.run(prog, feed={"h": hyps, "r": refs, "hl": hyp_len,
+                                 "rl": ref_len},
+                     fetch_list=[dist, seq_num], scope=scope)
+    want = [levenshtein(hyps[b, :hyp_len[b]], refs[b, :ref_len[b]])
+            for b in range(B)]
+    np.testing.assert_allclose(got.reshape(-1), want)
+    assert int(n) == B
+
+    em = M.EditDistance()
+    em.update(got, int(n))
+    avg, inst_err = em.eval()
+    assert avg == pytest.approx(np.mean(want))
+
+
+def test_auc_op_accumulates_across_steps():
+    rng = np.random.RandomState(2)
+    N = 200
+    scores = rng.rand(2 * N).astype("float32")
+    labels = (rng.rand(2 * N) < scores).astype("int64")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        p = L.data("p", [1])
+        y = L.data("y", [1], dtype="int64")
+        auc_v, _states = L.auc(p, y, num_thresholds=1023)
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    for i in range(2):
+        sl = slice(i * N, (i + 1) * N)
+        (got,) = exe.run(prog, feed={"p": scores[sl, None],
+                                     "y": labels[sl, None]},
+                         fetch_list=[auc_v], scope=scope)
+    ref = M.Auc(num_thresholds=1023)
+    ref.update(scores, labels)
+    assert float(got) == pytest.approx(ref.eval(), abs=1e-6)
+
+
+def test_precision_recall_op():
+    rng = np.random.RandomState(3)
+    N, C = 64, 5
+    idx = rng.randint(0, C, (N, 1)).astype("int64")
+    lbl = rng.randint(0, C, (N, 1)).astype("int64")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        probs = L.data("probs", [1])
+        i = L.data("i", [1], dtype="int64")
+        y = L.data("y", [1], dtype="int64")
+        batch_m, accum_m = L.precision_recall(probs, i, y, class_number=C)
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    bm, am = exe.run(prog, feed={"probs": np.ones((N, 1), "float32"),
+                                 "i": idx, "y": lbl},
+                     fetch_list=[batch_m, accum_m], scope=scope)
+    # micro precision == micro recall == plain accuracy for single-label
+    acc = float(np.mean(idx == lbl))
+    assert bm[3] == pytest.approx(acc, abs=1e-6)
+    assert bm[4] == pytest.approx(acc, abs=1e-6)
+    np.testing.assert_allclose(bm, am, atol=1e-6)  # first batch: equal

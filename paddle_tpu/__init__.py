@@ -26,6 +26,7 @@ from . import ops  # noqa: F401
 from . import clip  # noqa: F401
 from . import data  # noqa: F401
 from . import initializer  # noqa: F401
+from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import metrics  # noqa: F401
 from . import layers  # noqa: F401

@@ -1,0 +1,23 @@
+"""Inference subsystem: compiled-executable predictor + fusion passes.
+
+Reference: ``paddle/fluid/inference/api/paddle_inference_api.h:141,211``
+(``PaddlePredictor`` with the clone-per-thread contract,
+``CreatePaddlePredictor``), ``api/analysis_predictor.cc`` (IR fusion
+passes before compilation) and ``transpiler/inference_transpiler.py``
+(conv+bn folding).
+
+TPU-native shape: the predictor wraps a pruned inference Program + a
+weight Scope; the first ``run`` per input signature JIT-compiles the
+whole block to one XLA executable (cached thereafter — the NaiveExecutor
+hot path becomes a single device call).  ``clone()`` shares program and
+weights but owns a fresh executable cache, so clones are independently
+usable across threads.  Program-level fusion passes (fc+act, conv+bn
+fold) shrink the op graph and fold BN statistics into conv weights
+before compilation.
+"""
+from .predictor import (AnalysisConfig, NativeConfig, Predictor,
+                        create_predictor, create_paddle_predictor)
+from . import passes  # noqa: F401
+
+__all__ = ["AnalysisConfig", "NativeConfig", "Predictor",
+           "create_predictor", "create_paddle_predictor", "passes"]

@@ -1,0 +1,143 @@
+"""Program-level inference passes: is_test stamping, fc+act fusion,
+conv+bn folding.
+
+Reference: ``framework/ir/fc_fuse_pass.cc`` (mul+add+act → fc),
+``transpiler/inference_transpiler.py`` (conv+bn weight folding) and the
+analysis predictor's pass pipeline (``analysis_predictor.cc``).  These
+rewrite the serialized Program (and, for conv+bn, the weight Scope)
+before the first XLA compile — XLA fuses elementwise chains anyway, so
+the wins here are fewer ops to trace, BN statistics folded into conv
+weights (one less memory-bound op), and reference capability parity.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core import registry
+from ..core.program import Program
+
+# activations the fc fuser recognizes (fc_fuse_pass handles relu; we take
+# any registered unary activation with a plain X→Out contract)
+_FUSABLE_ACTS = {"relu", "sigmoid", "tanh", "softmax", "gelu", "relu6",
+                 "leaky_relu", "elu", "softplus", "swish"}
+
+
+def apply_is_test(program: Program) -> None:
+    """Stamp is_test=True on every op that distinguishes train/test
+    (dropout, batch_norm, fused_attention, …) — inference programs run in
+    test mode (the NaiveExecutor contract)."""
+    for block in program.blocks:
+        for op in block.ops:
+            base = op.type[:-5] if op.type.endswith("_grad") else op.type
+            if registry.has(base) and registry.get(base).stateful:
+                op.set_attr("is_test", True)
+            if op.type in ("batch_norm", "fused_attention", "dropout"):
+                op.set_attr("is_test", True)
+    program._version += 1  # invalidate any cached executable
+
+
+def _use_counts(program: Program, keep_vars=()) -> Dict[str, int]:
+    """Input-use counts; ``keep_vars`` (fetch targets) count as external
+    uses so a fetched intermediate is never fused away or left holding
+    rewritten values."""
+    uses: Dict[str, int] = {}
+    for block in program.blocks:
+        for op in block.ops:
+            for n in op.input_arg_names():
+                uses[n] = uses.get(n, 0) + 1
+    for n in keep_vars:
+        uses[n] = uses.get(n, 0) + 1
+    return uses
+
+
+def fuse_fc_act(program: Program, scope=None, keep_vars=()) -> int:
+    """mul → elementwise_add(bias) → activation collapses into one
+    ``fused_fc`` op (fc_fuse_pass.cc); also fuses the act-less mul+add
+    pair.  Returns the number of fusions applied."""
+    block = program.global_block
+    uses = _use_counts(program, keep_vars)
+    fused = 0
+    i = 0
+    while i < len(block.ops) - 1:
+        op = block.ops[i]
+        nxt = block.ops[i + 1]
+        if (op.type == "mul" and nxt.type == "elementwise_add"
+                and op.output("Out") == nxt.input("X")
+                and uses.get(op.output("Out")[0], 0) == 1):
+            act_op = block.ops[i + 2] if i + 2 < len(block.ops) else None
+            has_act = (act_op is not None
+                       and act_op.type in _FUSABLE_ACTS
+                       and act_op.input("X") == nxt.output("Out")
+                       and uses.get(nxt.output("Out")[0], 0) == 1)
+            out = (act_op.output("Out") if has_act else nxt.output("Out"))
+            attrs = {
+                "x_num_col_dims": op.attr("x_num_col_dims", 1),
+                "y_num_col_dims": op.attr("y_num_col_dims", 1),
+                "axis": nxt.attr("axis", -1),
+                "act": act_op.type if has_act else "",
+                # activation attrs travel verbatim (leaky_relu alpha, …)
+                "act_attrs": dict(act_op.attrs) if has_act else {},
+            }
+            new = block.ops[i]
+            new.type = "fused_fc"
+            new.inputs = {"X": op.input("X"), "W": op.input("Y"),
+                          "Bias": nxt.input("Y")}
+            new.outputs = {"Out": out}
+            new.attrs.update(attrs)
+            del block.ops[i + 1:i + (3 if has_act else 2)]
+            program._version += 1
+            fused += 1
+        i += 1
+    return fused
+
+
+def fuse_conv_bn(program: Program, scope, keep_vars=()) -> int:
+    """conv2d → batch_norm(is_test) folds the BN affine into the conv
+    filter and a bias add (inference_transpiler.py _fuse_param):
+    W' = W·γ/σ (per out-channel), b' = β − μ·γ/σ.  Mutates the weight
+    scope; returns the number of folds.  Shared (weight-tied) filters are
+    skipped — scaling them would corrupt the sibling conv."""
+    if scope is None:
+        return 0
+    block = program.global_block
+    uses = _use_counts(program, keep_vars)
+    folded = 0
+    i = 0
+    while i < len(block.ops) - 1:
+        op = block.ops[i]
+        nxt = block.ops[i + 1]
+        if not (op.type == "conv2d" and nxt.type == "batch_norm"
+                and nxt.input("X") == op.output("Output")
+                and uses.get(op.output("Output")[0], 0) == 1):
+            i += 1
+            continue
+        w_name = op.input("Filter")[0]
+        if uses.get(w_name, 0) > 1:
+            i += 1
+            continue
+        scale = np.asarray(scope.find_var(nxt.input("Scale")[0]))
+        bias = np.asarray(scope.find_var(nxt.input("Bias")[0]))
+        mean = np.asarray(scope.find_var(nxt.input("Mean")[0]))
+        var = np.asarray(scope.find_var(nxt.input("Variance")[0]))
+        eps = float(nxt.attr("epsilon", 1e-5))
+        std = np.sqrt(var + eps)
+        w = np.asarray(scope.find_var(w_name))
+        scope.set_var(w_name, (w * (scale / std)[:, None, None, None]
+                               ).astype(w.dtype))
+        # keyed by the BN's own scale var: unique even if filters repeat
+        fold_bias_name = nxt.input("Scale")[0] + "@BN_FOLD_BIAS"
+        fold_bias = (bias - mean * scale / std).astype(w.dtype)
+        block.create_var(name=fold_bias_name, shape=fold_bias.shape,
+                         dtype=str(w.dtype), persistable=True)
+        scope.set_var(fold_bias_name, fold_bias)
+        # batch_norm op becomes the bias add (axis=1: per channel)
+        nxt.type = "elementwise_add"
+        nxt.inputs = {"X": op.output("Output"), "Y": [fold_bias_name]}
+        nxt.outputs = {"Out": nxt.output("Y")}
+        nxt.attrs = {"axis": 1}
+        program._version += 1
+        folded += 1
+        i += 1
+    return folded

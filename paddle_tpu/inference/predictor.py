@@ -1,0 +1,110 @@
+"""Predictor: the PaddlePredictor analogue over compiled executables.
+
+Reference: ``inference/api/paddle_inference_api.h:141`` (PaddlePredictor:
+``Run``, ``Clone``), ``api_impl.cc`` (NativeConfig path) and
+``analysis_predictor.cc`` (runs IR passes first when ir_optim is on).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import io as _io
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.program import Program
+
+
+class AnalysisConfig:
+    """Predictor configuration (NativeConfig/AnalysisConfig:183)."""
+
+    def __init__(self, model_dir: Optional[str] = None):
+        self.model_dir = model_dir
+        self.ir_optim = True
+        self._passes = ["fuse_conv_bn", "fuse_fc_act"]
+
+    def set_model(self, model_dir: str) -> None:
+        self.model_dir = model_dir
+
+    def switch_ir_optim(self, flag: bool = True) -> None:
+        self.ir_optim = flag
+
+    def pass_names(self) -> List[str]:
+        return list(self._passes) if self.ir_optim else []
+
+    def delete_pass(self, name: str) -> None:
+        self._passes = [p for p in self._passes if p != name]
+
+
+NativeConfig = AnalysisConfig
+
+
+class Predictor:
+    """Compiled-program predictor with the clone-per-thread contract."""
+
+    def __init__(self, program: Program, feed_names: Sequence[str],
+                 fetch_names: Sequence[str], scope: Scope):
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._fetch_names = list(fetch_names)
+        self._scope = scope          # shared weights (clone keeps sharing)
+        self._exe = Executor()
+        self._lock = threading.Lock()  # executor cache is per-predictor
+
+    # -- PaddlePredictor::Run ---------------------------------------------
+    def run(self, inputs) -> List[np.ndarray]:
+        """inputs: dict name→array, or list of arrays in feed order."""
+        if not isinstance(inputs, dict):
+            inputs = dict(zip(self._feed_names, inputs))
+        missing = [n for n in self._feed_names if n not in inputs]
+        if missing:
+            raise ValueError(f"predictor missing feeds: {missing}")
+        with self._lock:
+            return self._exe.run(self._program, feed=inputs,
+                                 fetch_list=self._fetch_names,
+                                 scope=self._scope)
+
+    # -- PaddlePredictor::Clone -------------------------------------------
+    def clone(self) -> "Predictor":
+        """Same program + shared weights, own executable cache — safe to
+        hand one clone per serving thread (api_impl.cc Clone)."""
+        return Predictor(self._program, self._feed_names,
+                         self._fetch_names, self._scope)
+
+    @property
+    def feed_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def program(self) -> Program:
+        return self._program
+
+
+def create_predictor(config: AnalysisConfig) -> Predictor:
+    """Load an inference model dir and build a Predictor
+    (CreatePaddlePredictor:211; the analysis path applies fusion passes
+    before the first compile)."""
+    from . import passes as P
+
+    if not config.model_dir:
+        raise ValueError("AnalysisConfig.model_dir is not set")
+    scope = Scope()
+    exe = Executor()
+    with scope_guard(scope):
+        program, feed_names, fetch_vars = _io.load_inference_model(
+            config.model_dir, exe)
+    # inference programs run in test mode: stamp is_test on stateful ops
+    P.apply_is_test(program)
+    fetch_names = [v.name for v in fetch_vars]
+    for name in config.pass_names():
+        # fetch targets count as external uses: never fused away/rewritten
+        getattr(P, name)(program, scope, keep_vars=fetch_names)
+    return Predictor(program, feed_names, [v.name for v in fetch_vars],
+                     scope)
+
+
+create_paddle_predictor = create_predictor

@@ -391,3 +391,26 @@ def _gru(ctx, ins, attrs):
     if reverse:
         hs = jnp.flip(hs, 0)
     return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
+
+
+@register("fused_fc")
+def _fused_fc(ctx, ins, attrs):
+    """Fused mul + bias + activation, emitted by the inference fc fuser
+    (framework/ir/fc_fuse_pass.cc analogue; see inference/passes.py).
+    Delegates to the registered mul/elementwise_add/act lowerings so the
+    fused op is semantics-identical to the chain it replaced."""
+    from ..core import registry as _registry
+
+    out = _registry.get("mul").lower(
+        ctx, {"X": ins["X"], "Y": ins["W"]},
+        {"x_num_col_dims": attrs.get("x_num_col_dims", 1),
+         "y_num_col_dims": attrs.get("y_num_col_dims", 1)})["Out"][0]
+    if ins.get("Bias"):
+        out = _registry.get("elementwise_add").lower(
+            ctx, {"X": [out], "Y": ins["Bias"]},
+            {"axis": attrs.get("axis", -1)})["Out"][0]
+    act = attrs.get("act") or ""
+    if act:
+        out = _registry.get(act).lower(
+            ctx, {"X": [out]}, dict(attrs.get("act_attrs") or {}))["Out"][0]
+    return {"Out": [out]}

@@ -1,0 +1,156 @@
+"""Inference predictor + fusion passes (reference
+paddle_inference_api.h:141,211 Run/Clone contract, fc_fuse_pass.cc,
+inference_transpiler.py conv+bn folding)."""
+import threading
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.inference import AnalysisConfig, create_predictor, passes
+
+L = fluid.layers
+
+
+def _save_mlp(dirname, dropout=True):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [8])
+        h = L.fc(x, 16, act="relu")
+        if dropout:
+            h = L.dropout(h, dropout_prob=0.5)
+        y = L.fc(h, 4, act="softmax")
+    scope = Scope()
+    exe = Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["x"], [y], exe,
+                                      main_program=prog)
+    return prog, scope, y
+
+
+def test_predictor_runs_and_is_deterministic(tmp_path):
+    """Dropout must be off in the predictor (is_test stamping): repeated
+    runs agree exactly."""
+    d = str(tmp_path / "m")
+    _save_mlp(d, dropout=True)
+    cfg = AnalysisConfig(d)
+    pred = create_predictor(cfg)
+    x = np.random.RandomState(0).randn(4, 8).astype("float32")
+    (a,) = pred.run({"x": x})
+    (b,) = pred.run([x])
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (4, 4)
+    np.testing.assert_allclose(a.sum(axis=1), 1.0, rtol=1e-5)  # softmax
+
+
+def test_clone_shares_weights_and_is_thread_safe(tmp_path):
+    d = str(tmp_path / "m")
+    _save_mlp(d, dropout=False)
+    pred = create_predictor(AnalysisConfig(d))
+    clones = [pred.clone() for _ in range(4)]
+    x = np.random.RandomState(1).randn(16, 8).astype("float32")
+    (want,) = pred.run({"x": x})
+    results, errs = {}, []
+
+    def worker(i, p):
+        try:
+            for _ in range(5):
+                (out,) = p.run({"x": x})
+            results[i] = out
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i, p))
+               for i, p in enumerate(clones)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs
+    for out in results.values():
+        np.testing.assert_array_equal(out, want)
+
+
+def test_fc_act_fusion_preserves_outputs(tmp_path):
+    d = str(tmp_path / "m")
+    _save_mlp(d, dropout=False)
+
+    cfg_plain = AnalysisConfig(d)
+    cfg_plain.switch_ir_optim(False)
+    plain = create_predictor(cfg_plain)
+
+    fused = create_predictor(AnalysisConfig(d))
+    types = [op.type for op in fused.program().global_block.ops]
+    assert "fused_fc" in types
+    assert "mul" not in types
+
+    x = np.random.RandomState(2).randn(8, 8).astype("float32")
+    (a,) = plain.run({"x": x})
+    (b,) = fused.run({"x": x})
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_bn_folding(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [3, 16, 16])
+        c = L.conv2d(x, 8, 3, bias_attr=False)
+        bn = L.batch_norm(c, is_test=True)
+        y = L.relu(bn)
+    scope = Scope()
+    exe = Executor()
+    d = str(tmp_path / "cb")
+    with scope_guard(scope):
+        exe.run(startup)
+        # make BN stats non-trivial
+        scope.set_var([v.name for v in prog.global_block.vars.values()
+                       if "mean" in v.name][0],
+                      np.random.RandomState(3).randn(8).astype("float32") * 0.1)
+        scope.set_var([v.name for v in prog.global_block.vars.values()
+                       if "variance" in v.name][0],
+                      np.abs(np.random.RandomState(4).randn(8)).astype("float32") + 0.5)
+        fluid.io.save_inference_model(d, ["x"], [y], exe, main_program=prog)
+
+    cfg_plain = AnalysisConfig(d)
+    cfg_plain.switch_ir_optim(False)
+    plain = create_predictor(cfg_plain)
+    fused = create_predictor(AnalysisConfig(d))
+    types = [op.type for op in fused.program().global_block.ops]
+    assert "batch_norm" not in types
+
+    xv = np.random.RandomState(5).randn(2, 3, 16, 16).astype("float32")
+    (a,) = plain.run({"x": xv})
+    (b,) = fused.run({"x": xv})
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_preserves_fetched_intermediates_and_act_attrs(tmp_path):
+    """Regression: a fetched intermediate must not be fused away, and
+    parameterized activations keep their attrs through fusion."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [6])
+        h = L.fc(x, 5, act={"type": "leaky_relu", "alpha": 0.5})
+        y = L.fc(h, 3)
+    scope = Scope()
+    exe = Executor()
+    d = str(tmp_path / "m")
+    with scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [h, y], exe,
+                                      main_program=prog)
+
+    cfg_plain = AnalysisConfig(d)
+    cfg_plain.switch_ir_optim(False)
+    plain = create_predictor(cfg_plain)
+    fused = create_predictor(AnalysisConfig(d))
+
+    xv = np.random.RandomState(7).randn(4, 6).astype("float32") * 2
+    a_h, a_y = plain.run({"x": xv})
+    b_h, b_y = fused.run({"x": xv})
+    # h is a fetch target AND feeds the second fc: alpha=0.5 must survive
+    np.testing.assert_allclose(a_h, b_h, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(a_y, b_y, rtol=1e-5, atol=1e-6)

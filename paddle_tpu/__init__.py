@@ -52,30 +52,16 @@ from .parallel import (  # noqa: F401
 )
 
 
-class CPUPlace:
-    """Host-device tag (platform/place.h:36 analogue)."""
-
-    def __repr__(self):
-        return "CPUPlace"
-
-
-class TPUPlace:
-    """TPU device tag (the CUDAPlace analogue; platform/place.h:51)."""
-
-    def __init__(self, device_id: int = 0):
-        self.device_id = device_id
-
-    def __repr__(self):
-        return f"TPUPlace({self.device_id})"
-
-
-# reference-compat alias: programs written for fluid's CUDAPlace run on TPU
-CUDAPlace = TPUPlace
-
-
-def tpu_places():
-    import jax
-    return [TPUPlace(i) for i in range(len(jax.devices()))]
-
+from . import platform  # noqa: F401
+from .platform import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    DeviceContext,
+    DeviceContextPool,
+    TPUPlace,
+    device_count,
+    tpu_places,
+)
+from .core.flags import get_flags, set_flags  # noqa: F401
 
 __version__ = "0.1.0"

@@ -15,6 +15,7 @@ static-shape/recompile-cache policy SURVEY.md §7 calls out.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -159,6 +160,9 @@ class Executor:
             rng = jax.random.PRNGKey(program.random_seed or 0)
         rng = self._put_rng(rng)
 
+        from . import flags as _flags
+        t0 = time.perf_counter() if _flags.get_flags("benchmark") else None
+
         fetches, new_state, rng_out = jitted(feed_vals, donated_state, const_state, rng)
 
         for name, val in zip(plan.persist_writes, new_state):
@@ -166,6 +170,26 @@ class Executor:
             scope.set_var(name, val)
         if plan.has_stateful:
             scope.set_var(RNG_STATE_VAR, rng_out)
+
+        if _flags.get_flags("check_nan_inf"):
+            # post-block NaN/Inf scan (FLAGS_check_nan_inf, operator.cc:31
+            # post-kernel check at whole-block granularity)
+            for name, val in list(zip(fetch_names, fetches)) + \
+                    list(zip(plan.persist_writes, new_state)):
+                arr = np.asarray(val.values if isinstance(val, SelectedRows)
+                                 else val)
+                # jnp.issubdtype: ml_dtypes floats (bfloat16, float8_*)
+                # are invisible to np.issubdtype — the flagship bf16
+                # workloads must not bypass the guard
+                if jnp.issubdtype(arr.dtype, jnp.floating) and \
+                        not np.all(np.isfinite(arr)):
+                    raise FloatingPointError(
+                        f"NaN/Inf detected in {name!r} "
+                        f"(FLAGS_check_nan_inf)")
+        if t0 is not None:
+            np.asarray(fetches[0] if fetches else new_state[0])
+            print(f"[benchmark] executor run: "
+                  f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
 
         if return_numpy:
             return [self._fetch_to_numpy(v) for v in fetches]

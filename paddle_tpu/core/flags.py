@@ -1,0 +1,90 @@
+"""Framework flags: the gflags + env-var bootstrap analogue.
+
+Reference: gflags ``DEFINE_*`` at point-of-use (``executor.cc:27``,
+``operator.cc:31`` FLAGS_check_nan_inf, ``scope.cc:23-34``,
+``memory/malloc.cc:25``) re-exported to Python through
+``fluid.__init__.__bootstrap__`` collecting ``--tryfromenv`` names
+(``python/paddle/fluid/__init__.py:112-132``, ``pybind.cc:560``).
+
+Here flags live in one registry; values bootstrap from the environment
+(``FLAGS_<name>=...`` variables, the reference's spelling) at import and
+can be read/written at runtime with ``get_flags``/``set_flags`` (the
+paddle 1.x public API).  Consumers poll at use-sites, e.g. the executor's
+NaN/Inf guard.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Union
+
+_DEFS: Dict[str, dict] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def _parse(value: str, default):
+    if isinstance(default, bool):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default, help_str: str = "") -> None:
+    _DEFS[name] = {"default": default, "help": help_str}
+    env = os.environ.get("FLAGS_" + name)
+    _VALUES[name] = _parse(env, default) if env is not None else default
+
+
+def get_flags(names: Union[str, Iterable[str]]):
+    """fluid.get_flags parity: str → value; list → {name: value}."""
+    if isinstance(names, str):
+        if names.startswith("FLAGS_"):
+            names = names[len("FLAGS_"):]
+        if names not in _DEFS:
+            raise KeyError(f"unknown flag {names!r}")
+        return _VALUES[names]
+    return {n: get_flags(n) for n in names}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """fluid.set_flags parity: {\"FLAGS_x\": v} or {\"x\": v}."""
+    for name, value in flags.items():
+        if name.startswith("FLAGS_"):
+            name = name[len("FLAGS_"):]
+        if name not in _DEFS:
+            raise KeyError(f"unknown flag {name!r}")
+        default = _DEFS[name]["default"]
+        _VALUES[name] = (_parse(value, default) if isinstance(value, str)
+                         else type(default)(value) if default is not None
+                         else value)
+
+
+def all_flags() -> Dict[str, Any]:
+    return dict(_VALUES)
+
+
+# ---------------------------------------------------------------------------
+# flag definitions (the reference's DEFINE_* sites, TPU-relevant subset)
+# ---------------------------------------------------------------------------
+
+define_flag("check_nan_inf", False,
+            "after each executor run, scan fetches and updated state for "
+            "NaN/Inf and raise (operator.cc:31 post-kernel check, moved to "
+            "post-block granularity under whole-block XLA compilation)")
+define_flag("benchmark", False,
+            "log per-run wall time from the executor (executor.cc:399)")
+define_flag("eager_delete_tensor_gb", -1.0,
+            "accepted for API parity; device memory lifetime is owned by "
+            "XLA buffer assignment")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "accepted for API parity; HBM is managed by the XLA runtime")
+define_flag("cpu_deterministic", False,
+            "accepted for parity; lowerings are deterministic by "
+            "construction (threaded PRNG state)")
+define_flag("rpc_deadline", 120.0,
+            "pserver transport connect deadline in seconds "
+            "(distributed/transport.py)")
+define_flag("paddle_num_threads", 1,
+            "accepted for parity; host threading is owned by XLA")

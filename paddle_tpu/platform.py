@@ -1,0 +1,128 @@
+"""Platform layer: places, device contexts, device pool.
+
+Reference: ``paddle/fluid/platform/place.h:25-80`` (tagged device
+addresses), ``device_context.h:42-200`` (per-device handles + the
+singleton ``DeviceContextPool``), ``init.cc:76-92`` (device discovery).
+
+TPU-native shape: JAX/PJRT owns streams, allocators and kernels, so a
+DeviceContext here wraps the ``jax.Device`` (exposing the PJRT client and
+platform metadata) rather than cuBLAS/cuDNN handles; the pool is keyed by
+Place exactly like the reference.  Everything compute-related still flows
+through the Executor — this module is the device-addressing API surface
+(who am I running on, how many chips, memory stats).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Union
+
+import jax
+
+
+class CPUPlace:
+    """Host-device tag (place.h:36)."""
+
+    def __eq__(self, other):
+        return isinstance(other, CPUPlace)
+
+    def __hash__(self):
+        return hash("cpu")
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    """TPU device tag (the CUDAPlace analogue; place.h:51)."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return isinstance(other, TPUPlace) and other.device_id == self.device_id
+
+    def __hash__(self):
+        return hash(("tpu", self.device_id))
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+CUDAPlace = TPUPlace  # reference-compat alias
+Place = Union[CPUPlace, TPUPlace]
+
+
+def is_tpu_place(p) -> bool:
+    return isinstance(p, TPUPlace)
+
+
+class DeviceContext:
+    """Per-device context (device_context.h:42): wraps the jax.Device and
+    its PJRT platform metadata."""
+
+    def __init__(self, place: Place):
+        self.place = place
+        devices = jax.devices()
+        if isinstance(place, TPUPlace):
+            if place.device_id >= len(devices):
+                raise ValueError(
+                    f"{place!r}: only {len(devices)} device(s) visible")
+            self.device = devices[place.device_id]
+        else:
+            self.device = jax.devices("cpu")[0] if _has_cpu() else None
+
+    @property
+    def platform(self) -> str:
+        return self.device.platform if self.device is not None else "cpu"
+
+    def memory_stats(self) -> dict:
+        """HBM stats from PJRT (gpu_info.cc capability)."""
+        if self.device is None or not hasattr(self.device, "memory_stats"):
+            return {}
+        try:
+            return dict(self.device.memory_stats() or {})
+        except Exception:
+            return {}
+
+    def synchronize(self) -> None:
+        """Wait for outstanding work (the stream Wait analogue)."""
+        jax.effects_barrier()
+
+    def __repr__(self):
+        return f"DeviceContext({self.place!r}, {self.platform})"
+
+
+def _has_cpu() -> bool:
+    try:
+        return bool(jax.devices("cpu"))
+    except RuntimeError:
+        return False
+
+
+class DeviceContextPool:
+    """Singleton Place→DeviceContext map (device_context.h:200)."""
+
+    _instance: "DeviceContextPool" = None
+
+    def __init__(self):
+        self._ctxs: Dict[Place, DeviceContext] = {}
+
+    @classmethod
+    def instance(cls) -> "DeviceContextPool":
+        if cls._instance is None:
+            cls._instance = DeviceContextPool()
+        return cls._instance
+
+    def get(self, place: Place) -> DeviceContext:
+        if place not in self._ctxs:
+            self._ctxs[place] = DeviceContext(place)
+        return self._ctxs[place]
+
+
+def device_count() -> int:
+    """Visible accelerator count (init.cc device discovery)."""
+    return len(jax.devices())
+
+
+def tpu_places(device_ids: List[int] = None) -> List[TPUPlace]:
+    ids = device_ids if device_ids is not None else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]

@@ -1,0 +1,78 @@
+"""Platform layer + flags system (reference platform/place.h,
+device_context.h:200 pool, and the gflags env bootstrap
+python/paddle/fluid/__init__.py:112-132)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope
+from paddle_tpu.core.program import Program, program_guard
+
+
+def test_places_and_pool():
+    p0 = fluid.TPUPlace(0)
+    assert p0 == fluid.TPUPlace(0) and p0 != fluid.CPUPlace()
+    assert fluid.CUDAPlace is fluid.TPUPlace  # compat alias
+    pool = fluid.DeviceContextPool.instance()
+    ctx = pool.get(p0)
+    assert pool.get(fluid.TPUPlace(0)) is ctx  # keyed by place
+    assert ctx.platform  # cpu under tests, tpu on hardware
+    ctx.synchronize()
+    assert fluid.device_count() >= 1
+    assert len(fluid.tpu_places()) == fluid.device_count()
+
+
+def test_flags_env_types_and_api():
+    assert fluid.get_flags("check_nan_inf") is False
+    assert fluid.get_flags("FLAGS_benchmark") is False
+    multi = fluid.get_flags(["check_nan_inf", "rpc_deadline"])
+    assert multi == {"check_nan_inf": False, "rpc_deadline": 120.0}
+    fluid.set_flags({"FLAGS_rpc_deadline": "60"})
+    assert fluid.get_flags("rpc_deadline") == 60.0
+    fluid.set_flags({"rpc_deadline": 120.0})
+    with pytest.raises(KeyError):
+        fluid.get_flags("no_such_flag")
+    with pytest.raises(KeyError):
+        fluid.set_flags({"no_such_flag": 1})
+
+
+def test_check_nan_inf_flag_catches_bad_values():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [2])
+        y = fluid.layers.log(x)  # log of a negative → NaN
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    bad = np.array([[-1.0, 2.0]], "float32")
+    # off: NaN flows silently (reference default)
+    (out,) = exe.run(prog, feed={"x": bad}, fetch_list=[y], scope=scope)
+    assert np.isnan(out).any()
+    fluid.set_flags({"check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError, match="NaN/Inf"):
+            exe.run(prog, feed={"x": bad}, fetch_list=[y], scope=scope)
+        ok = np.array([[1.0, 2.0]], "float32")
+        exe.run(prog, feed={"x": ok}, fetch_list=[y], scope=scope)
+    finally:
+        fluid.set_flags({"check_nan_inf": False})
+def test_check_nan_inf_bf16():
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope
+    from paddle_tpu.core.program import Program, program_guard
+    import pytest
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [2], dtype="bfloat16")
+        y = fluid.layers.log(x)
+    exe = Executor(); scope = Scope(); exe.run(startup, scope=scope)
+    fluid.set_flags({"check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError):
+            exe.run(prog, feed={"x": np.array([[-1.0, 2.0]], "float32")},
+                    fetch_list=[y], scope=scope)
+    finally:
+        fluid.set_flags({"check_nan_inf": False})

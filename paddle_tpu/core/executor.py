@@ -187,7 +187,11 @@ class Executor:
                         f"NaN/Inf detected in {name!r} "
                         f"(FLAGS_check_nan_inf)")
         if t0 is not None:
-            np.asarray(fetches[0] if fetches else new_state[0])
+            sync = next((v for v in list(fetches) + list(new_state)
+                         if v is not None), None)
+            if sync is not None:
+                np.asarray(sync.values if isinstance(sync, SelectedRows)
+                           else sync)
             print(f"[benchmark] executor run: "
                   f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
 

@@ -1,4 +1,4 @@
-from . import control_flow, io, learning_rate_scheduler, nn, ops, tensor  # noqa: F401
+from . import control_flow, detection, io, learning_rate_scheduler, nn, ops, tensor  # noqa: F401
 from .control_flow import (  # noqa: F401
     ConditionalBlock,
     DynamicRNN,

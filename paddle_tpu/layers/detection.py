@@ -1,0 +1,92 @@
+"""Detection layers over the detection op subset (reference
+python/paddle/fluid/layers/detection.py)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "box_coder", "iou_similarity", "multiclass_nms",
+           "bipartite_match"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("prior_box", name=name)
+    H, W = input.shape[2], input.shape[3]
+    ars = list(aspect_ratios)
+    n_ar = 1 + sum(2 if flip and abs(a - 1.0) > 1e-6 else
+                   (0 if abs(a - 1.0) < 1e-6 else 1) for a in ars)
+    P = len(min_sizes) * n_ar + len(max_sizes or [])
+    boxes = helper.create_variable_for_type_inference(
+        "float32", shape=(H, W, P, 4), stop_gradient=True)
+    var = helper.create_variable_for_type_inference(
+        "float32", shape=(H, W, P, 4), stop_gradient=True)
+    helper.append_op(
+        "prior_box", {"Input": [input], "Image": [image]},
+        {"Boxes": [boxes], "Variances": [var]},
+        {"min_sizes": list(min_sizes), "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios), "variances": list(variance),
+         "flip": flip, "clip": clip, "step_w": steps[0], "step_h": steps[1],
+         "offset": offset})
+    return boxes, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    if "encode" in code_type:
+        shape = (target_box.shape[0], prior_box.shape[0], 4)
+    else:
+        shape = tuple(target_box.shape)
+    out = helper.create_variable_for_type_inference("float32", shape=shape)
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", ins, {"OutputBox": [out]},
+                     {"code_type": code_type,
+                      "box_normalized": box_normalized})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(
+        "float32", shape=(x.shape[0], y.shape[0]))
+    helper.append_op("iou_similarity", {"X": [x], "Y": [y]}, {"Out": [out]})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    m = dist_matrix.shape[1]
+    idx = helper.create_variable_for_type_inference(
+        "int32", shape=(1, m), stop_gradient=True)
+    dist = helper.create_variable_for_type_inference(
+        "float32", shape=(1, m), stop_gradient=True)
+    helper.append_op(
+        "bipartite_match", {"DistMat": [dist_matrix]},
+        {"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [dist]},
+        {"match_type": match_type or "bipartite",
+         "dist_threshold": dist_threshold or 0.5})
+    return idx, dist
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, background_label=0,
+                   name=None):
+    """Returns (Out [B, keep_top_k, 6], valid counts [B])."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    B = bboxes.shape[0]
+    out = helper.create_variable_for_type_inference(
+        "float32", shape=(B, keep_top_k, 6), stop_gradient=True)
+    num = helper.create_variable_for_type_inference(
+        "int64", shape=(B,), stop_gradient=True)
+    helper.append_op(
+        "multiclass_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+        {"Out": [out], "NmsRoisNum": [num]},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "background_label": background_label})
+    return out, num

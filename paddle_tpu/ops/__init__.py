@@ -2,9 +2,12 @@
 from . import array_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
+from . import ctc_crf_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
 from . import math_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import quant_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401

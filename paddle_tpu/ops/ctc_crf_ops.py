@@ -1,0 +1,212 @@
+"""CTC and linear-chain CRF: the structured sequence losses.
+
+Reference: ``paddle/fluid/operators/warpctc_op.cc`` (wrapping the warpctc
+CUDA/CPU library), ``ctc_align_op.cc`` (greedy decode cleanup),
+``linear_chain_crf_op.cc`` and ``crf_decoding_op.cc``.
+
+TPU-native redesign: both dynamic programs run as ``lax.scan`` over time
+in log space — fully differentiable by reverse-scan autodiff, so there is
+no hand-written gradient kernel (warpctc's grad output becomes plain
+jax.vjp through the DP).  Batched over padded sequences with explicit
+per-row logit/label lengths.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, register_grad
+
+NEG = -1e30
+
+
+def _log_softmax_time(logits):
+    return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+
+@register("warpctc", no_grad_slots=("Label", "LogitsLength", "LabelLength"))
+def _warpctc(ctx, ins, attrs):
+    """CTC loss (warpctc_op.cc capability).  Logits [B, T, C] (padded,
+    ``LogitsLength`` [B]), Label [B, L] (padded, ``LabelLength`` [B]),
+    attr ``blank``.  Returns per-sequence negative log-likelihood [B, 1].
+    norm_by_times divides by the logit length."""
+    logits = ins["Logits"][0]
+    label = ins["Label"][0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    B, T, C = logits.shape
+    L = label.shape[1]
+    blank = int(attrs.get("blank", 0))
+    logit_len = (ins["LogitsLength"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("LogitsLength")
+                 else jnp.full((B,), T, jnp.int32))
+    label_len = (ins["LabelLength"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("LabelLength")
+                 else jnp.full((B,), L, jnp.int32))
+
+    logp = _log_softmax_time(logits)                     # [B,T,C]
+    S = 2 * L + 1
+    # extended label: blank, l0, blank, l1, …, blank
+    ext = jnp.full((B, S), blank, label.dtype)
+    ext = ext.at[:, 1::2].set(label)
+    s_idx = jnp.arange(S)[None, :]
+    is_label = (s_idx % 2) == 1
+    # skip transition s-2→s allowed when ext[s] is a label differing from
+    # ext[s-2]
+    prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=blank)
+    can_skip = is_label & (ext != prev2)
+    valid_s = s_idx < (2 * label_len[:, None] + 1)
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t], ext.astype(jnp.int32), axis=1)
+
+    a0 = jnp.full((B, S), NEG, jnp.float32)
+    a0 = a0.at[:, 0].set(emit(0)[:, 0])
+    a0 = a0.at[:, 1].set(jnp.where(label_len > 0, emit(0)[:, 1], NEG))
+    a0 = jnp.where(valid_s, a0, NEG)
+
+    def step(alpha, t):
+        sh1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=NEG)
+        sh2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=NEG)
+        sh2 = jnp.where(can_skip, sh2, NEG)
+        prev = jnp.logaddexp(jnp.logaddexp(alpha, sh1), sh2)
+        new = prev + emit(t)
+        new = jnp.where(valid_s, new, NEG)
+        active = (t < logit_len)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = lax.scan(step, a0, jnp.arange(1, T))
+    # final: logsumexp over positions 2*label_len (last blank) and
+    # 2*label_len-1 (last label)
+    last = jnp.take_along_axis(alpha, (2 * label_len[:, None]), axis=1)[:, 0]
+    seclast = jnp.take_along_axis(
+        alpha, jnp.maximum(2 * label_len[:, None] - 1, 0), axis=1)[:, 0]
+    seclast = jnp.where(label_len > 0, seclast, NEG)
+    loss = -jnp.logaddexp(last, seclast)
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(logit_len.astype(jnp.float32), 1)
+    return {"Loss": [loss[:, None].astype(logits.dtype)]}
+
+
+@register("ctc_align", no_grad_slots=("Input", "InputLength"))
+def _ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode cleanup (ctc_align_op.cc): merge repeats, drop
+    blanks, left-compact.  Input [B, T] argmax ids; outputs compacted ids
+    + lengths."""
+    x = ins["Input"][0]
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    ids = x[..., 0] if squeeze else x
+    B, T = ids.shape
+    blank = int(attrs.get("blank", 0))
+    lens = (ins["InputLength"][0].reshape(-1).astype(jnp.int32)
+            if ins.get("InputLength") else jnp.full((B,), T, jnp.int32))
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    prev = jnp.pad(ids[:, :-1], ((0, 0), (1, 0)), constant_values=blank)
+    keep = valid & (ids != blank) & (ids != prev)
+    from .sequence_ops import left_compact
+    compacted, new_len = left_compact(ids, keep)
+    out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], compacted,
+                    jnp.asarray(blank, ids.dtype))
+    if squeeze:
+        out = out[..., None]
+    return {"Output": [out], "OutputLength": [new_len]}
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+# Transition layout (linear_chain_crf_op.cc): [C+2, C] — row 0: start→tag,
+# row 1: tag→stop, rows 2+c: from tag c → to tag.
+
+def _crf_parts(transition):
+    start = transition[0].astype(jnp.float32)
+    stop = transition[1].astype(jnp.float32)
+    trans = transition[2:].astype(jnp.float32)
+    return start, stop, trans
+
+
+@register("linear_chain_crf",
+          no_grad_slots=("Label", "Length"))
+def _linear_chain_crf(ctx, ins, attrs):
+    """Per-sequence log-likelihood of the gold path
+    (linear_chain_crf_op.cc): gold score − log partition, both masked by
+    per-row lengths.  Emission [B,T,C], Label [B,T], Transition [C+2,C]."""
+    emission = ins["Emission"][0].astype(jnp.float32)
+    transition = ins["Transition"][0]
+    label = ins["Label"][0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    B, T, C = emission.shape
+    lens = (ins["Length"][0].reshape(-1).astype(jnp.int32)
+            if ins.get("Length") else jnp.full((B,), T, jnp.int32))
+    start, stop, trans = _crf_parts(transition)
+    lab32 = label.astype(jnp.int32)
+
+    # gold path score
+    e_scores = jnp.take_along_axis(emission, lab32[..., None], axis=2)[..., 0]
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < lens[:, None]
+    gold = jnp.sum(jnp.where(valid, e_scores, 0.0), axis=1)
+    pair_valid = (t_idx[:, 1:] < lens[:, None])
+    pair = trans[lab32[:, :-1], lab32[:, 1:]]
+    gold = gold + jnp.sum(jnp.where(pair_valid, pair, 0.0), axis=1)
+    gold = gold + start[lab32[:, 0]]
+    last = jnp.take_along_axis(lab32, jnp.maximum(lens - 1, 0)[:, None],
+                               axis=1)[:, 0]
+    gold = gold + stop[last]
+
+    # log partition by forward scan
+    a0 = start[None, :] + emission[:, 0]
+
+    def step(alpha, t):
+        scores = alpha[:, :, None] + trans[None, :, :] + emission[:, t][:, None, :]
+        new = jax.nn.logsumexp(scores, axis=1)
+        active = (t < lens)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = lax.scan(step, a0, jnp.arange(1, T))
+    logz = jax.nn.logsumexp(alpha + stop[None, :], axis=1)
+    ll = gold - logz
+    return {"LogLikelihood": [ll[:, None]]}
+
+
+@register("crf_decoding", no_grad_slots=("Emission", "Transition", "Label",
+                                         "Length"))
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (crf_decoding_op.cc): max-product forward with
+    argmax backpointers, reverse backtrack; padded tail emits 0."""
+    emission = ins["Emission"][0].astype(jnp.float32)
+    transition = ins["Transition"][0]
+    B, T, C = emission.shape
+    lens = (ins["Length"][0].reshape(-1).astype(jnp.int32)
+            if ins.get("Length") else jnp.full((B,), T, jnp.int32))
+    start, stop, trans = _crf_parts(transition)
+
+    a0 = start[None, :] + emission[:, 0]
+
+    def fwd(alpha, t):
+        scores = alpha[:, :, None] + trans[None, :, :]    # [B, C_from, C_to]
+        best = jnp.max(scores, axis=1) + emission[:, t]
+        ptr = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        active = (t < lens)[:, None]
+        new = jnp.where(active, best, alpha)
+        ptr = jnp.where(active, ptr,
+                        jnp.arange(C, dtype=jnp.int32)[None, :])
+        return new, ptr
+
+    alpha, ptrs = lax.scan(fwd, a0, jnp.arange(1, T))     # ptrs [T-1,B,C]
+    last_tag = jnp.argmax(alpha + stop[None, :], axis=1).astype(jnp.int32)
+
+    def back(cur, ptr_t):
+        nxt = jnp.take_along_axis(ptr_t, cur[:, None], axis=1)[:, 0]
+        return nxt, cur
+
+    tag0, tags_rev = lax.scan(back, last_tag, ptrs[::-1])
+    # emitted: tag_{T-1}..tag_1; final carry: tag_0
+    path = jnp.concatenate([tag0[:, None], tags_rev[::-1].T], axis=1)  # [B,T]
+    # frozen steps carry identity pointers, so path[0:len] is already the
+    # per-row Viterbi path; zero the padded tail
+    t_idx = jnp.arange(T)[None, :]
+    out = jnp.where(t_idx < lens[:, None], path, 0)
+    return {"ViterbiPath": [out.astype(jnp.int64)]}

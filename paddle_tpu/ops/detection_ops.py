@@ -1,0 +1,234 @@
+"""Detection op subset: prior_box, box_coder, iou_similarity,
+multiclass_nms, bipartite_match.
+
+Reference: ``paddle/fluid/operators/detection/`` (prior_box_op.cc,
+box_coder_op.cc, iou_similarity_op.cc, multiclass_nms_op.cc,
+bipartite_match_op.cc) — the SSD inference path.
+
+TPU-native redesign: the reference's dynamically-sized outputs (NMS keeps
+a variable box count per image) become fixed-capacity padded outputs with
+an explicit count — NMS runs as a fixed-iteration suppression scan on
+device instead of the reference's host-side std::sort loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+@register("prior_box", no_grad_slots=("Input", "Image"))
+def _prior_box(ctx, ins, attrs):
+    """SSD anchor generation (prior_box_op.cc): per feature-map cell, one
+    box per (min_size, aspect_ratio) + optional max_size boxes.  Outputs
+    Boxes [H, W, P, 4] (normalized xmin,ymin,xmax,ymax) and Variances."""
+    feat = ins["Input"][0]
+    image = ins["Image"][0]
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        ar = float(ar)
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if attrs.get("flip", True):
+                ars.append(1.0 / ar)
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / W
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / H
+    offset = float(attrs.get("offset", 0.5))
+    clip = attrs.get("clip", True)
+
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError("prior_box: max_sizes must pair 1:1 with min_sizes")
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        for ar in ars:
+            whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+        if max_sizes:  # one sqrt(min_i * max_i) box per pair (SSD recipe)
+            xs = max_sizes[i]
+            whs.append(((ms * xs) ** 0.5, (ms * xs) ** 0.5))
+    P = len(whs)
+    wh = jnp.asarray(whs, jnp.float32)                     # [P, 2]
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                        # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    half_w = wh[None, None, :, 0] / 2
+    half_h = wh[None, None, :, 1] / 2
+    boxes = jnp.stack([(cxg - half_w) / img_w, (cyg - half_h) / img_h,
+                       (cxg + half_w) / img_w, (cyg + half_h) / img_h],
+                      axis=-1)                             # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+def _corner_to_center(b):
+    w = b[..., 2] - b[..., 0]
+    h = b[..., 3] - b[..., 1]
+    return b[..., 0] + w / 2, b[..., 1] + h / 2, w, h
+
+
+@register("box_coder", no_grad_slots=("PriorBox", "PriorBoxVar"))
+def _box_coder(ctx, ins, attrs):
+    """Encode targets against priors / decode offsets back to boxes
+    (box_coder_op.cc).  PriorBox [M,4], TargetBox [N,M,4] (decode) or
+    [N,4] (encode); variances broadcast."""
+    prior = ins["PriorBox"][0].astype(jnp.float32)
+    target = ins["TargetBox"][0].astype(jnp.float32)
+    pv = (ins["PriorBoxVar"][0].astype(jnp.float32)
+          if ins.get("PriorBoxVar") else jnp.ones_like(prior))
+    code_type = attrs.get("code_type", "encode_center_size")
+    pcx, pcy, pw, ph = _corner_to_center(prior)
+    if "encode" in code_type:
+        tcx, tcy, tw, th = _corner_to_center(target)
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :] / pv[None, :, 0],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :] / pv[None, :, 1],
+            jnp.log(tw[:, None] / pw[None, :]) / pv[None, :, 2],
+            jnp.log(th[:, None] / ph[None, :]) / pv[None, :, 3],
+        ], axis=-1)                                        # [N, M, 4]
+    else:
+        d = target                                        # [N, M, 4]
+        cx = pv[None, :, 0] * d[..., 0] * pw[None, :] + pcx[None, :]
+        cy = pv[None, :, 1] * d[..., 1] * ph[None, :] + pcy[None, :]
+        w = jnp.exp(pv[None, :, 2] * d[..., 2]) * pw[None, :]
+        h = jnp.exp(pv[None, :, 3] * d[..., 3]) * ph[None, :]
+        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                        axis=-1)
+    return {"OutputBox": [out]}
+
+
+def _iou_matrix(a, b):
+    """IoU of [N,4] x [M,4] corner boxes → [N,M]."""
+    ax1, ay1, ax2, ay2 = (a[:, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[:, i] for i in range(4))
+    ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0.0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register("iou_similarity", no_grad_slots=("X", "Y"))
+def _iou_similarity(ctx, ins, attrs):
+    return {"Out": [_iou_matrix(ins["X"][0].astype(jnp.float32),
+                                ins["Y"][0].astype(jnp.float32))]}
+
+
+@register("bipartite_match", no_grad_slots=("DistMat",))
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take
+    the globally largest entry, retire its row+column.  DistMat [N, M]
+    (rows: ground truth, cols: priors) → per-column matched row id (−1 if
+    none) + matched distance."""
+    dist = ins["DistMat"][0].astype(jnp.float32)
+    n, m = dist.shape
+    iters = min(n, m)
+
+    def step(carry, _):
+        d, row_ids, match_d = carry
+        flat = jnp.argmax(d)
+        r, c = flat // m, flat % m
+        best = d[r, c]
+        take = best > 0
+        row_ids = jnp.where(take, row_ids.at[c].set(r.astype(jnp.int32)),
+                            row_ids)
+        match_d = jnp.where(take, match_d.at[c].set(best), match_d)
+        d = jnp.where(take, d.at[r, :].set(-1.0).at[:, c].set(-1.0), d)
+        return (d, row_ids, match_d), None
+
+    init = (dist, jnp.full((m,), -1, jnp.int32), jnp.zeros((m,), jnp.float32))
+    (_, row_ids, match_d), _ = lax.scan(step, init, None, length=iters)
+    if attrs.get("match_type", "") == "per_prediction":
+        thr = float(attrs.get("dist_threshold", 0.5))
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        unmatched = row_ids < 0
+        fill = (best_val >= thr) & unmatched
+        row_ids = jnp.where(fill, best_row, row_ids)
+        match_d = jnp.where(fill, best_val, match_d)
+    return {"ColToRowMatchIndices": [row_ids[None, :]],
+            "ColToRowMatchDist": [match_d[None, :]]}
+
+
+@register("multiclass_nms", no_grad_slots=("BBoxes", "Scores"))
+def _multiclass_nms(ctx, ins, attrs):
+    """Padded multiclass NMS (multiclass_nms_op.cc): per class, iterative
+    greedy suppression for ``nms_top_k`` slots; survivors across classes
+    re-ranked to ``keep_top_k``.  Outputs Out [B, keep, 6] =
+    (label, score, x1, y1, x2, y2) with -1 labels padding, and the valid
+    count per image."""
+    bboxes = ins["BBoxes"][0].astype(jnp.float32)   # [B, M, 4]
+    scores = ins["Scores"][0].astype(jnp.float32)   # [B, C, M]
+    B, C, M = scores.shape
+    score_thr = float(attrs.get("score_threshold", 0.0))
+    nms_thr = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = min(int(attrs.get("nms_top_k", 64)), M)
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    bg = int(attrs.get("background_label", 0))
+
+    def per_class(boxes, cls_scores):
+        """[M,4],[M] → padded (scores, idx) of nms_top_k survivors."""
+        top_s, top_i = lax.top_k(cls_scores, nms_top_k)
+        top_b = boxes[top_i]
+        iou = _iou_matrix(top_b, top_b)
+
+        def body(keep, i):
+            # keep candidate i only if not suppressed by a kept earlier box
+            sup = jnp.any(keep & (jnp.arange(nms_top_k) < i)
+                          & (iou[i] > nms_thr))
+            ok = (top_s[i] > score_thr) & ~sup
+            return keep.at[i].set(ok), None
+
+        keep0 = jnp.zeros((nms_top_k,), bool)
+        keep, _ = lax.scan(body, keep0, jnp.arange(nms_top_k))
+        return jnp.where(keep, top_s, -1.0), top_i
+
+    if all(c == bg for c in range(C)):
+        raise ValueError("multiclass_nms: no non-background class "
+                         f"(C={C}, background_label={bg})")
+
+    def per_image(boxes, img_scores):
+        all_s, all_i, all_c = [], [], []
+        for c in range(C):
+            if c == bg:
+                continue
+            s, i = per_class(boxes, img_scores[c])
+            all_s.append(s)
+            all_i.append(i)
+            all_c.append(jnp.full((nms_top_k,), c, jnp.float32))
+        cat_s = jnp.concatenate(all_s)
+        cat_i = jnp.concatenate(all_i)
+        cat_c = jnp.concatenate(all_c)
+        k = min(keep_top_k, cat_s.shape[0])
+        fin_s, order = lax.top_k(cat_s, k)
+        fin_i = cat_i[order]
+        fin_c = cat_c[order]
+        fin_b = boxes[fin_i]
+        valid = fin_s > 0
+        out = jnp.concatenate(
+            [jnp.where(valid, fin_c, -1.0)[:, None], fin_s[:, None], fin_b],
+            axis=1)
+        if k < keep_top_k:  # pad to the declared [keep_top_k, 6] shape
+            pad = jnp.full((keep_top_k - k, 6), -1.0, out.dtype)
+            out = jnp.concatenate([out, pad], axis=0)
+        return out, jnp.sum(valid).astype(jnp.int64)
+
+    outs, counts = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [outs], "NmsRoisNum": [counts]}

@@ -1,0 +1,90 @@
+"""Fake-quantization ops for quantization-aware training.
+
+Reference: ``paddle/fluid/operators/fake_quantize_op.cc``
+(abs_max / moving_average_abs_max / channel_wise variants) and
+``fake_dequantize_op.cc``.  Quantize-dequantize in the forward, straight-
+through estimator in the backward (the reference grad kernels pass the
+gradient through unchanged) — registered as explicit grad rules since
+round() has zero derivative.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register, register_grad
+
+
+def _qdq(x, scale, bits):
+    r = float((1 << (bits - 1)) - 1)
+    scale = jnp.maximum(scale.astype(jnp.float32), 1e-8)
+    q = jnp.round(x.astype(jnp.float32) / scale * r)
+    q = jnp.clip(q, -r, r)
+    return (q * scale / r).astype(x.dtype)
+
+
+@register("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return {"Out": [_qdq(x, scale, bits)], "OutScale": [scale]}
+
+
+@register_grad("fake_quantize_abs_max")
+def _fake_quantize_abs_max_grad(ctx, ins, attrs):
+    return {"X@GRAD": [ins["Out@GRAD"][0]]}  # straight-through
+
+
+@register("fake_channel_wise_quantize_abs_max")
+def _fake_cw_quantize(ctx, ins, attrs):
+    """Per-output-channel (dim 0) scales — conv filter quantization."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    flat = jnp.abs(x.astype(jnp.float32)).reshape(x.shape[0], -1)
+    scale = jnp.max(flat, axis=1)
+    shaped = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": [_qdq(x, shaped, bits)], "OutScale": [scale]}
+
+
+@register_grad("fake_channel_wise_quantize_abs_max")
+def _fake_cw_quantize_grad(ctx, ins, attrs):
+    return {"X@GRAD": [ins["Out@GRAD"][0]]}
+
+
+@register("fake_quantize_moving_average_abs_max",
+          no_grad_slots=("InScale", "InAccum", "InState"))
+def _fake_quantize_mavg(ctx, ins, attrs):
+    """Running abs-max scale (fake_quantize_op.cc moving_average path):
+    state = rate·state + 1; accum = rate·accum + max|x|;
+    scale = accum/state.  State vars are persistable in/outs."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    accum = (ins["InAccum"][0].reshape(()) if ins.get("InAccum")
+             else jnp.zeros((), jnp.float32))
+    state = (ins["InState"][0].reshape(()) if ins.get("InState")
+             else jnp.zeros((), jnp.float32))
+    if ctx.training and not attrs.get("is_test", False):
+        state = rate * state + 1.0
+        accum = rate * accum + cur
+        scale = accum / jnp.maximum(state, 1e-8)
+    else:
+        scale = (ins["InScale"][0].reshape(()) if ins.get("InScale") else cur)
+    return {"Out": [_qdq(x, scale, bits)],
+            "OutScale": [scale.reshape((1,))],
+            "OutAccum": [accum.reshape((1,))],
+            "OutState": [state.reshape((1,))]}
+
+
+@register_grad("fake_quantize_moving_average_abs_max")
+def _fake_quantize_mavg_grad(ctx, ins, attrs):
+    return {"X@GRAD": [ins["Out@GRAD"][0]]}
+
+
+@register("fake_dequantize_max_abs", no_grad_slots=("Scale",))
+def _fake_dequantize(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(()).astype(jnp.float32)
+    r = float(attrs.get("max_range", 127))
+    return {"Out": [(x.astype(jnp.float32) * scale / r).astype(x.dtype)]}

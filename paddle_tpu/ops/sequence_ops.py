@@ -22,6 +22,18 @@ def _mask(x, seq_len):
     return m.reshape((B, T) + (1,) * (x.ndim - 2))
 
 
+def left_compact(ids, keep):
+    """Stable left-compaction of kept [B,T] entries: kept values move to
+    the front preserving order, with the new per-row count (shared by
+    sequence_erase and ctc_align)."""
+    T = ids.shape[1]
+    order = jnp.argsort(jnp.where(keep, 0, 1) * T + jnp.arange(T)[None, :],
+                        axis=1)
+    compacted = jnp.take_along_axis(ids, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int64)
+    return compacted, new_len
+
+
 @register("sequence_pool", no_grad_slots=("SeqLen",))
 def _sequence_pool(ctx, ins, attrs):
     x = ins["X"][0]
@@ -105,3 +117,179 @@ def _sequence_first_step(ctx, ins, attrs):
 @register("sequence_last_step", no_grad_slots=("SeqLen",))
 def _sequence_last_step(ctx, ins, attrs):
     return _sequence_pool(ctx, ins, {"pooltype": "LAST"})
+
+
+@register("sequence_conv", no_grad_slots=("SeqLen",))
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over [B,T,D] (sequence_conv_op.cc +
+    math/context_project.h): each position sees ``context_length`` steps
+    starting at ``context_start``; out-of-range and beyond-length context
+    is zero.  Filter: [context_length*D, out_dim]."""
+    x = ins["X"][0]
+    w = ins["Filter"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    cl = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    cs = int(attrs.get("contextStart", attrs.get("context_start", -(cl // 2))))
+    if int(attrs.get("contextStride", 1)) != 1:
+        raise NotImplementedError(
+            "sequence_conv: contextStride != 1 is unsupported (matches the "
+            "reference, sequence_conv_op.cc PADDLE_ENFORCE stride==1)")
+    B, T = x.shape[0], x.shape[1]
+    xm = x if seq_len is None else x * _mask(x, seq_len).astype(x.dtype)
+    cols = []
+    for i in range(cl):
+        off = cs + i
+        shifted = jnp.roll(xm, -off, axis=1)
+        t = jnp.arange(T)
+        valid = (t + off >= 0) & (t + off < T)
+        cols.append(jnp.where(valid[None, :, None], shifted, 0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)          # [B,T,cl*D]
+    out = jnp.einsum("btd,de->bte", ctx_mat, w.astype(ctx_mat.dtype))
+    if seq_len is not None:
+        out = out * _mask(out, seq_len).astype(out.dtype)
+    return {"Out": [out]}
+
+
+@register("sequence_slice", no_grad_slots=("Offset", "Length", "SeqLen"))
+def _sequence_slice(ctx, ins, attrs):
+    """Per-row [offset, offset+length) subsequence, left-aligned into the
+    padded layout (sequence_slice_op.cc)."""
+    x = ins["X"][0]
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]
+    src = jnp.clip(t + off[:, None], 0, T - 1)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    keep = (t < length[:, None]).reshape(
+        (x.shape[0], T) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(keep, out, 0)], "OutLen": [length.astype(jnp.int64)]}
+
+
+@register("sequence_erase", no_grad_slots=("SeqLen",))
+def _sequence_erase(ctx, ins, attrs):
+    """Drop listed tokens and left-compact (sequence_erase_op.cc).  Int id
+    sequences [B,T] (or [B,T,1]); emits compacted ids + new lengths."""
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    ids = x.reshape(x.shape[0], x.shape[1]) if squeeze else x
+    B, T = ids.shape
+    tokens = jnp.asarray(list(attrs.get("tokens", [])), ids.dtype)
+    valid = jnp.arange(T)[None, :] < (
+        seq_len[:, None] if seq_len is not None else T)
+    erase = jnp.isin(ids, tokens) if tokens.size else jnp.zeros_like(valid)
+    keep = valid & ~erase
+    compacted, new_len = left_compact(ids, keep)
+    out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], compacted, 0)
+    if squeeze:
+        out = out[..., None]
+    return {"Out": [out], "OutLen": [new_len]}
+
+
+@register("sequence_enumerate", no_grad_slots=("SeqLen",))
+def _sequence_enumerate(ctx, ins, attrs):
+    """Sliding windows of win_size ids per position
+    (sequence_enumerate_op.cc): [B,T] → [B,T,win]; positions whose window
+    crosses the sequence end emit pad_value."""
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    ids = x.reshape(x.shape[0], x.shape[1]) if squeeze else x
+    B, T = ids.shape
+    win = int(attrs["win_size"])
+    pad = attrs.get("pad_value", 0)
+    lens = seq_len[:, None] if seq_len is not None else jnp.full((B, 1), T)
+    outs = []
+    t = jnp.arange(T)[None, :]
+    for i in range(win):
+        shifted = jnp.roll(ids, -i, axis=1)
+        ok = (t + i) < lens
+        outs.append(jnp.where(ok, shifted, jnp.asarray(pad, ids.dtype)))
+    return {"Out": [jnp.stack(outs, axis=-1)]}
+
+
+@register("sequence_expand_as", no_grad_slots=("SeqLen",))
+def _sequence_expand_as(ctx, ins, attrs):
+    """Broadcast one row-vector per sequence across Y's time dimension
+    (sequence_expand_as_op.cc), masked by Y's lengths."""
+    x, y = ins["X"][0], ins["Y"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])
+    if seq_len is not None:
+        out = out * _mask(out, seq_len).astype(out.dtype)
+    return {"Out": [out]}
+
+
+@register("sequence_pad", no_grad_slots=("PadValue", "SeqLen"))
+def _sequence_pad(ctx, ins, attrs):
+    """Materialize padding with an explicit pad value up to padded_length
+    (sequence_pad_op.cc).  The runtime layout is already padded-with-zeros;
+    this rewrites the tail to pad_value and returns per-row lengths."""
+    x = ins["X"][0]
+    pad_value = ins["PadValue"][0].reshape(()) if ins.get("PadValue") else 0.0
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    padded_len = int(attrs.get("padded_length", -1))
+    T = x.shape[1]
+    if padded_len > 0 and padded_len != T:
+        if padded_len > T:
+            widths = [(0, 0), (0, padded_len - T)] + [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, widths)
+        else:
+            x = x[:, :padded_len]
+    lens = (seq_len if seq_len is not None
+            else jnp.full((x.shape[0],), T, jnp.int64))
+    m = _mask(x, lens)
+    out = jnp.where(m, x, jnp.asarray(pad_value, x.dtype))
+    return {"Out": [out], "Length": [lens.astype(jnp.int64)]}
+
+
+@register("sequence_unpad", no_grad_slots=("Length",))
+def _sequence_unpad(ctx, ins, attrs):
+    """Inverse of sequence_pad: zero the tail and alias the lengths
+    (sequence_unpad_op.cc — the ragged-ness lives in the length vector)."""
+    x = ins["X"][0]
+    lens = ins["Length"][0].reshape(-1)
+    m = _mask(x, lens)
+    return {"Out": [jnp.where(m, x, 0)], "OutLen": [lens.astype(jnp.int64)]}
+
+
+@register("sequence_reshape", no_grad_slots=("SeqLen",))
+def _sequence_reshape(ctx, ins, attrs):
+    """Change the step width D→new_dim, merging/splitting steps
+    (sequence_reshape_op.cc); lengths scale by D/new_dim."""
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    new_dim = int(attrs["new_dim"])
+    B, T, D = x.shape[0], x.shape[1], x.shape[-1]
+    total = T * D
+    assert total % new_dim == 0, (T, D, new_dim)
+    out = x.reshape(B, total // new_dim, new_dim)
+    lens = (seq_len * D) // new_dim if seq_len is not None else None
+    outs = {"Out": [out]}
+    if lens is not None:
+        outs["OutLen"] = [lens.astype(jnp.int64)]
+    return outs
+
+
+@register("row_conv", no_grad_slots=("SeqLen",))
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (row_conv_op.cc, DeepSpeech2):
+    out[b,t] = Σ_i x[b,t+i]·w[i], i in [0, future_context); elementwise
+    per feature."""
+    x = ins["X"][0]                    # [B,T,D]
+    w = ins["Filter"][0]               # [k, D]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    k = w.shape[0]
+    T = x.shape[1]
+    xm = x if seq_len is None else x * _mask(x, seq_len).astype(x.dtype)
+    out = jnp.zeros_like(xm)
+    t = jnp.arange(T)
+    for i in range(k):
+        shifted = jnp.roll(xm, -i, axis=1)
+        ok = (t + i) < T
+        out = out + jnp.where(ok[None, :, None], shifted, 0) * w[i][None, None, :]
+    if seq_len is not None:
+        out = out * _mask(out, seq_len).astype(out.dtype)
+    return {"Out": [out]}

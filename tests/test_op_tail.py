@@ -1,0 +1,378 @@
+"""Operator tail: CTC, linear-chain CRF, sequence_* additions, row_conv,
+fake quantization (reference warpctc_op.cc, linear_chain_crf_op.cc,
+sequence_* family, fake_quantize_op.cc)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope
+from paddle_tpu.core.program import Program, program_guard
+
+L = fluid.layers
+
+
+def _run(build, feed, fetch):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        fetches = build()
+    if not isinstance(fetches, (list, tuple)):
+        fetches = (fetches,)
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    names = [fetches[n] if isinstance(n, int) else n for n in fetch]
+    return exe.run(prog, feed=feed, fetch_list=names, scope=scope), prog, scope
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+def _ctc_brute(logp, labels, blank):
+    """Sum path probabilities over all alignments (tiny T/C only)."""
+    T, C = logp.shape
+
+    def collapse(path):
+        out, prev = [], blank
+        for p in path:
+            if p != blank and p != prev:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(labels):
+            total += np.exp(sum(logp[t, p] for t, p in enumerate(path)))
+    return -np.log(total)
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(0)
+    B, T, C = 2, 4, 3
+    logits = rng.randn(B, T, C).astype("float32")
+    labels = np.array([[1, 2], [2, 2]], "int64")
+    label_len = np.array([2, 1], "int64")
+    logit_len = np.array([4, 3], "int64")
+
+    def build():
+        x = L.data("x", [T, C])
+        y = L.data("y", [2], dtype="int64")
+        il = L.data("il", [], dtype="int64")
+        ll = L.data("ll", [], dtype="int64")
+        return L.warpctc(x, y, blank=0, input_length=il, label_length=ll)
+
+    (got,), _, _ = _run(build, {"x": logits, "y": labels, "il": logit_len,
+                                "ll": label_len}, [0])
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    want0 = _ctc_brute(logp[0, :4], [1, 2], 0)
+    want1 = _ctc_brute(logp[1, :3], [2], 0)
+    np.testing.assert_allclose(got.reshape(-1), [want0, want1], rtol=1e-4)
+
+
+def test_warpctc_trains():
+    """CTC is differentiable end-to-end: loss decreases under SGD."""
+    rng = np.random.RandomState(1)
+    B, T, C = 4, 8, 5
+    xv = rng.randn(B, T, 16).astype("float32")
+    yv = rng.randint(1, C, (B, 3)).astype("int64")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [T, 16])
+        y = L.data("y", [3], dtype="int64")
+        logits = L.fc(x, C, num_flatten_dims=2)
+        loss = L.mean(L.warpctc(logits, y, blank=0))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    losses = [float(exe.run(prog, feed={"x": xv, "y": yv},
+                            fetch_list=[loss], scope=scope)[0])
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.7, losses[::6]
+
+
+def test_ctc_greedy_decoder():
+    B, T, C = 1, 6, 4
+    probs = np.zeros((B, T, C), "float32")
+    # argmax path: 1 1 0 2 2 3 → collapse → 1 2 3
+    for t, c in enumerate([1, 1, 0, 2, 2, 3]):
+        probs[0, t, c] = 5.0
+
+    def build():
+        x = L.data("x", [T, C])
+        out, lens = L.ctc_greedy_decoder(x, blank=0)
+        return out, lens
+
+    (ids, lens), _, _ = _run(build, {"x": probs}, [0, 1])
+    assert int(lens[0]) == 3
+    np.testing.assert_array_equal(ids[0, :3], [1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+def _crf_brute(emission, trans_full, labels):
+    start, stop, trans = trans_full[0], trans_full[1], trans_full[2:]
+    T = emission.shape[0]
+
+    def score(path):
+        s = start[path[0]] + emission[0, path[0]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + emission[t, path[t]]
+        return s + stop[path[-1]]
+
+    C = emission.shape[1]
+    logz = np.log(sum(np.exp(score(p))
+                      for p in itertools.product(range(C), repeat=T)))
+    best = max(itertools.product(range(C), repeat=T), key=score)
+    return score(tuple(labels)) - logz, best
+
+
+def test_linear_chain_crf_and_decoding_match_brute_force():
+    rng = np.random.RandomState(3)
+    B, T, C = 2, 4, 3
+    emission = rng.randn(B, T, C).astype("float32")
+    trans0 = (rng.randn(C + 2, C) * 0.5).astype("float32")
+    labels = rng.randint(0, C, (B, T)).astype("int64")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [T, C])
+        y = L.data("y", [T], dtype="int64")
+        ll = L.linear_chain_crf(
+            x, y, param_attr=fluid.ParamAttr(
+                name="crf_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(trans0)))
+        path = L.crf_decoding(x, param_attr="crf_w")
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    got_ll, got_path = exe.run(prog, feed={"x": emission, "y": labels},
+                               fetch_list=[ll, path], scope=scope)
+    for b in range(B):
+        want_ll, want_path = _crf_brute(emission[b], trans0, labels[b])
+        np.testing.assert_allclose(got_ll[b, 0], want_ll, rtol=1e-4)
+        np.testing.assert_array_equal(got_path[b], want_path)
+
+
+def test_crf_trains():
+    rng = np.random.RandomState(4)
+    B, T, C = 8, 6, 4
+    xv = rng.randn(B, T, 8).astype("float32")
+    yv = rng.randint(0, C, (B, T)).astype("int64")
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [T, 8])
+        y = L.data("y", [T], dtype="int64")
+        emission = L.fc(x, C, num_flatten_dims=2)
+        ll = L.linear_chain_crf(emission, label=y)
+        loss = L.mean(L.scale(ll, scale=-1.0))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    losses = [float(exe.run(prog, feed={"x": xv, "y": yv},
+                            fetch_list=[loss], scope=scope)[0])
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.7, losses[::6]
+
+
+# ---------------------------------------------------------------------------
+# sequence tail
+# ---------------------------------------------------------------------------
+
+def test_sequence_erase_enumerate_slice():
+    ids = np.array([[3, 0, 5, 0, 7, 9]], "int64")
+    lens = np.array([5], "int64")
+
+    def build():
+        x = L.data("x", [6], dtype="int64", lod_level=1)
+        erased = L.sequence_erase(x, tokens=[0])
+        enum = L.sequence_enumerate(x, win_size=2, pad_value=-1)
+        off = L.data("off", [], dtype="int64")
+        ln = L.data("ln", [], dtype="int64")
+        sl = L.sequence_slice(x, off, ln)
+        return erased, enum, sl
+
+    (er, en, sl), _, _ = _run(
+        build, {"x": ids.reshape(1, 6), "x@LEN": lens,
+                "off": np.array([1], "int64"), "ln": np.array([3], "int64")},
+        [0, 1, 2])
+    np.testing.assert_array_equal(er[0, :3], [3, 5, 7])     # zeros erased
+    np.testing.assert_array_equal(en[0, 0], [3, 0])         # window at 0
+    np.testing.assert_array_equal(en[0, 4], [7, -1])        # crosses end
+    np.testing.assert_array_equal(sl[0, :3], [0, 5, 0])     # offset 1 len 3
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = np.arange(12, dtype="float32").reshape(1, 6, 2)
+    lens = np.array([4], "int64")
+
+    def build():
+        v = L.data("v", [6, 2], lod_level=0)
+        v.block.seq_len_map[v.name] = "v@LEN"
+        v.block.create_var(name="v@LEN", dtype="int64", shape=(-1,))
+        padded, out_len = L.sequence_pad(v, L.fill_constant([1], "float32",
+                                                            -1.0))
+        unpadded = L.sequence_unpad(padded, out_len)
+        return padded, unpadded
+
+    (p, u), _, _ = _run(build, {"v": x, "v@LEN": lens}, [0, 1])
+    assert (p[0, 4:] == -1.0).all()          # tail rewritten to pad value
+    np.testing.assert_array_equal(u[0, :4], x[0, :4])
+    assert (u[0, 4:] == 0).all()             # unpad zeroes the tail
+
+
+def test_sequence_conv_and_row_conv_shapes_and_grads():
+    rng = np.random.RandomState(5)
+    B, T, D = 2, 5, 3
+    xv = rng.randn(B, T, D).astype("float32")
+    lens = np.array([5, 3], "int64")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [D], lod_level=1)
+        sc = L.sequence_conv(x, num_filters=4, filter_size=3)
+        rc = L.row_conv(x, future_context_size=2)
+        loss = L.mean(sc) if True else None
+        loss = L.mean(L.elementwise_add(L.mean(sc), L.mean(rc)))
+        fluid.append_backward(loss)
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    out_sc, out_rc = exe.run(prog, feed={"x": xv, "x@LEN": lens},
+                             fetch_list=[sc, rc], scope=scope)
+    assert out_sc.shape == (B, T, 4)
+    assert out_rc.shape == (B, T, D)
+    # masked rows produce zeros beyond length
+    assert np.abs(out_sc[1, 3:]).max() == 0
+    assert np.abs(out_rc[1, 3:]).max() == 0
+
+
+# ---------------------------------------------------------------------------
+# fake quantization
+# ---------------------------------------------------------------------------
+
+def test_fake_quantize_abs_max_roundtrip_and_st_grad():
+    xv = np.array([[0.5, -1.0, 0.25, 0.99]], "float32")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [4])
+        x.stop_gradient = False
+        out = x.block.create_var(name="q", dtype="float32", shape=(-1, 4))
+        scale = x.block.create_var(name="qs", dtype="float32", shape=())
+        x.block.append_op("fake_quantize_abs_max", {"X": [x.name]},
+                          {"Out": [out.name], "OutScale": [scale.name]},
+                          {"bit_length": 8})
+        loss = L.mean(x.block.program.global_block.var("q")
+                      if False else out)
+        fluid.append_backward(loss)
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    q, s, g = exe.run(prog, feed={"x": xv}, fetch_list=[out, scale, "x@GRAD"],
+                      scope=scope)
+    assert s == pytest.approx(1.0)
+    np.testing.assert_allclose(q, np.round(xv * 127) / 127, atol=1e-6)
+    np.testing.assert_allclose(g, np.full_like(xv, 0.25))  # straight-through
+
+
+# ---------------------------------------------------------------------------
+# detection subset
+# ---------------------------------------------------------------------------
+
+def _np_iou(a, b):
+    ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+    ua = (a[2]-a[0])*(a[3]-a[1]) + (b[2]-b[0])*(b[3]-b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_iou_similarity_matches_numpy():
+    rng = np.random.RandomState(6)
+    a = np.sort(rng.rand(4, 4).astype("float32"), axis=1)
+    b = np.sort(rng.rand(3, 4).astype("float32"), axis=1)
+    a = a[:, [0, 1, 2, 3]]; b = b[:, [0, 1, 2, 3]]
+
+    def build():
+        x = L.data("x", [4], append_batch_size=True)
+        y = L.data("y", [4], append_batch_size=True)
+        return fluid.layers.detection.iou_similarity(x, y)
+
+    (got,), _, _ = _run(build, {"x": a, "y": b}, [0])
+    want = np.array([[_np_iou(ai, bj) for bj in b] for ai in a])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(7)
+    prior = np.sort(rng.rand(5, 4).astype("float32"), axis=1)
+    target = np.sort(rng.rand(3, 4).astype("float32"), axis=1)
+    var = np.full((5, 4), 0.1, "float32")
+
+    def build():
+        p = L.data("p", [4], append_batch_size=True)
+        v = L.data("v", [4], append_batch_size=True)
+        t = L.data("t", [4], append_batch_size=True)
+        enc = fluid.layers.detection.box_coder(p, v, t,
+                                               code_type="encode_center_size")
+        dec = fluid.layers.detection.box_coder(p, v, enc,
+                                               code_type="decode_center_size")
+        return enc, dec
+
+    (enc, dec), _, _ = _run(build, {"p": prior, "v": var, "t": target}, [0, 1])
+    # decode(encode(t)) == t broadcast across priors
+    for m in range(5):
+        np.testing.assert_allclose(dec[:, m], target, rtol=1e-4, atol=1e-5)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # two heavily-overlapping boxes + one distinct, one class
+    boxes = np.array([[[0.0, 0.0, 0.5, 0.5],
+                       [0.01, 0.01, 0.52, 0.52],
+                       [0.6, 0.6, 0.9, 0.9]]], "float32")
+    scores = np.zeros((1, 2, 3), "float32")
+    scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 (0 = background)
+
+    def build():
+        b = L.data("b", [3, 4])
+        s = L.data("s", [2, 3])
+        return fluid.layers.detection.multiclass_nms(
+            b, s, nms_threshold=0.5, nms_top_k=3, keep_top_k=3)
+
+    (out, num), _, _ = _run(build, {"b": boxes, "s": scores}, [0, 1])
+    assert int(num[0]) == 2                       # middle box suppressed
+    kept = out[0][out[0][:, 0] >= 0]
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True), [0.9, 0.7],
+                               rtol=1e-6)
+
+
+def test_prior_box_and_bipartite_match():
+    def build():
+        feat = L.data("feat", [8, 4, 4])
+        img = L.data("img", [3, 64, 64])
+        boxes, var = fluid.layers.detection.prior_box(
+            feat, img, min_sizes=[16.0], aspect_ratios=[1.0], clip=True)
+        d = L.data("d", [6], append_batch_size=True)
+        idx, dist = fluid.layers.detection.bipartite_match(d)
+        return boxes, idx
+
+    dist = np.array([[0.9, 0.1, 0.0, 0.2, 0.0, 0.0],
+                     [0.0, 0.8, 0.0, 0.0, 0.0, 0.3]], "float32")
+    feats = np.zeros((1, 8, 4, 4), "float32")
+    img = np.zeros((1, 3, 64, 64), "float32")
+    (boxes, idx), _, _ = _run(build, {"feat": feats, "img": img, "d": dist},
+                              [0, 1])
+    assert boxes.shape == (4, 4, 1, 4)
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    # greedy: (0,0)=0.9 then (1,1)=0.8
+    assert idx[0, 0] == 0 and idx[0, 1] == 1
+    assert idx[0, 2] == -1

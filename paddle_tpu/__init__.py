@@ -26,9 +26,11 @@ from . import ops  # noqa: F401
 from . import clip  # noqa: F401
 from . import data  # noqa: F401
 from . import initializer  # noqa: F401
+from . import contrib  # noqa: F401
 from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import metrics  # noqa: F401
+from . import transpiler  # noqa: F401
 from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
@@ -44,6 +46,16 @@ from .core.program import (  # noqa: F401
 from .core import unique_name  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .distributed import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .contrib import (  # noqa: F401
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Inferencer,
+    Trainer,
+)
+from .transpiler import InferenceTranspiler, memory_optimize, release_memory  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .parallel import (  # noqa: F401
     BuildStrategy,

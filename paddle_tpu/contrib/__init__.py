@@ -1,0 +1,7 @@
+"""High-level training API (reference python/paddle/fluid/contrib/)."""
+from .trainer import (BeginEpochEvent, BeginStepEvent, CheckpointConfig,
+                      EndEpochEvent, EndStepEvent, Trainer)
+from .inferencer import Inferencer
+
+__all__ = ["Trainer", "Inferencer", "BeginEpochEvent", "EndEpochEvent",
+           "BeginStepEvent", "EndStepEvent", "CheckpointConfig"]

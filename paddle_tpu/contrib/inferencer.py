@@ -1,0 +1,36 @@
+"""Inferencer (reference contrib/inferencer.py): build the infer program
+from ``infer_func``, load trained params, run batches."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .. import io as _io
+from ..core import unique_name
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.program import Program, program_guard
+from ..inference.passes import apply_is_test
+
+
+class Inferencer:
+    def __init__(self, infer_func: Callable, param_path: str, place=None,
+                 parallel: bool = False):
+        self.scope = Scope()
+        self.place = place
+        self.startup_program = Program()
+        self.inference_program = Program()
+        with program_guard(self.inference_program, self.startup_program), \
+                unique_name.guard():
+            out = infer_func()
+            self.predict_vars = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+        apply_is_test(self.inference_program)
+        self.exe = Executor(place)
+        self.exe.run(self.startup_program, scope=self.scope)
+        with scope_guard(self.scope):
+            _io.load_params(self.exe, param_path,
+                            main_program=self.inference_program)
+
+    def infer(self, inputs: Dict, return_numpy: bool = True):
+        return self.exe.run(self.inference_program, feed=inputs,
+                            fetch_list=self.predict_vars, scope=self.scope,
+                            return_numpy=return_numpy)

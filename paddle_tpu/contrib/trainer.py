@@ -1,0 +1,189 @@
+"""Event-driven Trainer (reference contrib/trainer.py:169,379).
+
+The contract: the user supplies ``train_func`` returning (loss, metrics…)
+and ``optimizer_func`` returning an Optimizer; the Trainer owns the
+programs/scope, drives epochs over a reader, emits Begin/End events, and
+checkpoints per epoch when configured.  Single-process (optionally
+ParallelExecutor over the local mesh); for distributed runs drive
+DistributeTranspiler / parallel.init_from_env directly.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .. import io as _io
+from .. import optimizer as _optimizer  # noqa: F401 (re-export surface)
+from ..core import unique_name
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.program import Program, program_guard
+from ..data_feeder import DataFeeder
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """Per-epoch checkpointing (reference contrib/trainer.py:100)."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1):
+        self.checkpoint_dir = checkpoint_dir or "checkpoints"
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+
+
+class Trainer:
+    """reference contrib/trainer.py:229.
+
+    ``train_func`` builds the model in the Trainer's programs and returns
+    the loss var (optionally [loss, metric, ...]); ``optimizer_func``
+    returns the Optimizer to minimize it.
+    """
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 place=None, parallel: bool = False,
+                 checkpoint_config: Optional[CheckpointConfig] = None):
+        self.place = place
+        self.parallel = parallel
+        self.checkpoint_cfg = checkpoint_config
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        self.__stop = False
+
+        with program_guard(self.train_program, self.startup_program), \
+                unique_name.guard():
+            out = train_func()
+            if isinstance(out, (list, tuple)):
+                self.loss = out[0]
+                self.metrics = list(out)
+            else:
+                self.loss = out
+                self.metrics = [out]
+            optimizer_func().minimize(self.loss)
+
+        self.exe = Executor(place)
+        self.exe.run(self.startup_program, scope=self.scope)
+        self._epoch_offset = 0
+        self._maybe_load_checkpoint()
+        self._pe = None
+
+    def _maybe_load_checkpoint(self):
+        cfg = self.checkpoint_cfg
+        if cfg and os.path.isdir(cfg.checkpoint_dir):
+            latest = self._latest_checkpoint()
+            if latest is not None:
+                with scope_guard(self.scope):
+                    _io.load_persistables(self.exe, latest,
+                                          main_program=self.train_program)
+                # resume numbering after the loaded epoch, so retention
+                # never deletes the freshest checkpoint
+                self._epoch_offset = int(
+                    os.path.basename(latest).split("_")[1]) + 1
+
+    def _checkpoints(self) -> List[str]:
+        cfg = self.checkpoint_cfg
+        if not cfg or not os.path.isdir(cfg.checkpoint_dir):
+            return []
+        subs = [d for d in os.listdir(cfg.checkpoint_dir)
+                if d.startswith("epoch_")]
+        return [os.path.join(cfg.checkpoint_dir, d)
+                for d in sorted(subs, key=lambda d: int(d.split("_")[1]))]
+
+    def _latest_checkpoint(self) -> Optional[str]:
+        cps = self._checkpoints()
+        return cps[-1] if cps else None
+
+    def _save_checkpoint(self, epoch_id: int) -> None:
+        cfg = self.checkpoint_cfg
+        path = os.path.join(cfg.checkpoint_dir, f"epoch_{epoch_id}")
+        with scope_guard(self.scope):
+            _io.save_persistables(self.exe, path,
+                                  main_program=self.train_program)
+        extra = self._checkpoints()[:-cfg.max_num_checkpoints]
+        import shutil
+        for old in extra:
+            shutil.rmtree(old, ignore_errors=True)
+
+    # -- public API --------------------------------------------------------
+    def stop(self):
+        self.__stop = True
+
+    def train(self, num_epochs: int, event_handler: Callable,
+              reader: Callable = None,
+              feed_order: Optional[Sequence[str]] = None):
+        if reader is None or feed_order is None:
+            raise ValueError(
+                "Trainer.train requires reader and feed_order (feed-order "
+                "inference from the program is not implemented)")
+        feeder = DataFeeder(list(feed_order), program=self.train_program)
+        runner = self._runner()
+        for epoch_id in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, data in enumerate(reader()):
+                if self.__stop:
+                    return
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                fetch = self.metrics if begin.fetch_metrics else []
+                metrics = runner(feeder.feed(data), fetch)
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+            event_handler(EndEpochEvent(epoch_id))
+            cfg = self.checkpoint_cfg
+            if cfg and (epoch_id + 1) % cfg.epoch_interval == 0:
+                self._save_checkpoint(epoch_id + self._epoch_offset)
+
+    def _runner(self):
+        if self.parallel:
+            if self._pe is None:
+                from ..parallel import ParallelExecutor
+                self._pe = ParallelExecutor(
+                    loss_name=self.loss.name,
+                    main_program=self.train_program, scope=self.scope)
+
+            def run_pe(feed, fetch):
+                return self._pe.run(feed=feed, fetch_list=fetch)
+            return run_pe
+
+        def run_exe(feed, fetch):
+            return self.exe.run(self.train_program, feed=feed,
+                                fetch_list=fetch, scope=self.scope)
+        return run_exe
+
+    def save_params(self, param_path: str) -> None:
+        with scope_guard(self.scope):
+            _io.save_params(self.exe, param_path,
+                            main_program=self.train_program)
+
+    def save_inference_model(self, param_path: str,
+                             feeded_var_names: Sequence[str],
+                             target_var_indexes: Sequence[int]) -> None:
+        targets = [self.metrics[i] for i in target_var_indexes]
+        with scope_guard(self.scope):
+            _io.save_inference_model(param_path, list(feeded_var_names),
+                                     targets, self.exe,
+                                     main_program=self.train_program)

@@ -246,3 +246,215 @@ class ctr:
     @staticmethod
     def test():
         return ctr._synth_reader(1024, 10)
+
+
+# ---------------------------------------------------------------------------
+# imikolov (dataset/imikolov.py: PTB n-gram LM tuples)
+# ---------------------------------------------------------------------------
+
+class imikolov:
+    WORD_DIM = 2074  # reference min_word_freq=50 vocab ballpark
+
+    @staticmethod
+    def _synth_reader(n, seed, window=5):
+        def reader():
+            _warn_synth("imikolov")
+            rng = np.random.RandomState(seed)
+            # markov-ish stream: next word correlates with previous
+            w = rng.randint(0, imikolov.WORD_DIM)
+            for _ in range(n):
+                gram = []
+                for _ in range(window):
+                    w = (w * 31 + rng.randint(0, 7)) % imikolov.WORD_DIM
+                    gram.append(w)
+                yield tuple(np.int64(g) for g in gram)
+        return reader
+
+    @staticmethod
+    def train(word_idx=None, n=5):
+        return imikolov._synth_reader(8192, 11, window=n)
+
+    @staticmethod
+    def test(word_idx=None, n=5):
+        return imikolov._synth_reader(1024, 12, window=n)
+
+    @staticmethod
+    def build_dict(min_word_freq=50):
+        return {i: i for i in range(imikolov.WORD_DIM)}
+
+
+# ---------------------------------------------------------------------------
+# movielens (dataset/movielens.py: (user feats…, movie feats…, rating))
+# ---------------------------------------------------------------------------
+
+class movielens:
+    USER_ID_MAX = 6040
+    MOVIE_ID_MAX = 3952
+    CATEGORIES = 18
+    AGES = 7
+    JOBS = 21
+
+    @staticmethod
+    def _synth_reader(n, seed):
+        def reader():
+            _warn_synth("movielens")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                uid = rng.randint(1, movielens.USER_ID_MAX)
+                gender = rng.randint(0, 2)
+                age = rng.randint(0, movielens.AGES)
+                job = rng.randint(0, movielens.JOBS)
+                mid = rng.randint(1, movielens.MOVIE_ID_MAX)
+                # category / title are id SEQUENCES (lod_level=1 feeds)
+                ncat = rng.randint(1, 4)
+                cats = rng.randint(0, movielens.CATEGORIES,
+                                   ncat).astype("int64")
+                title = rng.randint(0, 5000,
+                                    rng.randint(1, 6)).astype("int64")
+                # rating loosely follows (uid+mid) hash — learnable
+                score = np.float32(1 + ((uid + mid) % 5))
+                yield (np.int64(uid), np.int64(gender), np.int64(age),
+                       np.int64(job), np.int64(mid), cats, title, score)
+        return reader
+
+    @staticmethod
+    def train():
+        return movielens._synth_reader(8192, 13)
+
+    @staticmethod
+    def test():
+        return movielens._synth_reader(1024, 14)
+
+    @staticmethod
+    def max_user_id():
+        return movielens.USER_ID_MAX
+
+    @staticmethod
+    def max_movie_id():
+        return movielens.MOVIE_ID_MAX
+
+    @staticmethod
+    def max_job_id():
+        return movielens.JOBS - 1
+
+
+# ---------------------------------------------------------------------------
+# conll05 (dataset/conll05.py: SRL word/predicate/ctx/mark → IOB labels)
+# ---------------------------------------------------------------------------
+
+class conll05:
+    WORD_DICT = 44068
+    LABEL_DICT = 59
+    PRED_DICT = 3162
+
+    @staticmethod
+    def _synth_reader(n, seed, max_len=20):
+        def reader():
+            _warn_synth("conll05")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                ln = rng.randint(5, max_len)
+                words = rng.randint(0, conll05.WORD_DICT, ln).astype("int64")
+                pred = np.full(ln, rng.randint(0, conll05.PRED_DICT), "int64")
+                mark = (rng.rand(ln) > 0.8).astype("int64")
+                # label correlates with word id parity — learnable
+                labels = (words % conll05.LABEL_DICT).astype("int64")
+                yield (words, pred, mark, labels)
+        return reader
+
+    @staticmethod
+    def test():
+        return conll05._synth_reader(1024, 15)
+
+    @staticmethod
+    def get_dict():
+        return ({i: i for i in range(conll05.WORD_DICT)},
+                {i: i for i in range(conll05.PRED_DICT)},
+                {i: i for i in range(conll05.LABEL_DICT)})
+
+
+# ---------------------------------------------------------------------------
+# wmt14 (dataset/wmt14.py: (src ids, trg ids, trg_next ids))
+# ---------------------------------------------------------------------------
+
+class wmt14:
+    DICT_SIZE = 30000
+
+    @staticmethod
+    def _synth_reader(n, seed, dict_size, max_len=16):
+        def reader():
+            _warn_synth("wmt14")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                ln = rng.randint(4, max_len)
+                src = rng.randint(3, dict_size, ln).astype("int64")
+                trg = ((src * 7 + 1) % dict_size).astype("int64")
+                trg_in = np.concatenate([[1], trg[:-1]]).astype("int64")
+                yield (src, trg_in, trg)
+        return reader
+
+    @staticmethod
+    def train(dict_size=30000):
+        return wmt14._synth_reader(8192, 16, dict_size)
+
+    @staticmethod
+    def test(dict_size=30000):
+        return wmt14._synth_reader(1024, 17, dict_size)
+
+
+# ---------------------------------------------------------------------------
+# flowers (dataset/flowers.py: 3x224x224 images, 102 classes)
+# ---------------------------------------------------------------------------
+
+class flowers:
+    CLASSES = 102
+
+    @staticmethod
+    def _synth_reader(n, seed):
+        def reader():
+            _warn_synth("flowers")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                label = rng.randint(0, flowers.CLASSES)
+                img = rng.rand(3 * 224 * 224).astype("float32") * 0.1
+                img[label * 1000:(label + 1) * 1000] += 0.5  # learnable
+                yield img, np.int64(label)
+        return reader
+
+    @staticmethod
+    def train(use_xmap=True):
+        return flowers._synth_reader(2048, 18)
+
+    @staticmethod
+    def test(use_xmap=True):
+        return flowers._synth_reader(256, 19)
+
+
+# ---------------------------------------------------------------------------
+# sentiment (dataset/sentiment.py: NLTK movie reviews, binary)
+# ---------------------------------------------------------------------------
+
+class sentiment:
+    WORD_DIM = 5147
+
+    @staticmethod
+    def _synth_reader(n, seed):
+        def reader():
+            _warn_synth("sentiment")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                ln = rng.randint(5, 40)
+                label = rng.randint(0, 2)
+                lo = 0 if label == 0 else sentiment.WORD_DIM // 2
+                words = rng.randint(lo, lo + sentiment.WORD_DIM // 2,
+                                    ln).astype("int64")
+                yield words, np.int64(label)
+        return reader
+
+    @staticmethod
+    def train():
+        return sentiment._synth_reader(4096, 20)
+
+    @staticmethod
+    def test():
+        return sentiment._synth_reader(512, 21)

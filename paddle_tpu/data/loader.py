@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import pickle
 import threading
+
+import numpy as np
 from typing import Callable, Iterable, Optional, Sequence
 
 import jax
@@ -72,3 +74,50 @@ class DataLoader:
             t.join()
         finally:
             q.close()  # early break: unblock + stop the producer
+
+
+class PyReader:
+    """Program-declared async reader (reference py_reader contract,
+    layers/io.py:477): feed vars are declared in the program; a python
+    generator is attached later; iteration yields prefetched feed dicts
+    keyed by those vars (the create_py_reader_op + blocking-queue path,
+    with jax async dispatch standing in for double_buffer)."""
+
+    def __init__(self, feed_vars, capacity: int = 8):
+        self.feed_vars = list(feed_vars)
+        self.capacity = capacity
+        self._reader = None
+
+    def decorate_paddle_reader(self, reader) -> None:
+        """reader() yields per-example tuples aligned with the feed vars
+        (batched by the caller via data.decorator.batch)."""
+        self._reader = reader
+        self._mode = "sample"
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader) -> None:
+        """reader() yields PRE-BATCHED per-slot arrays [x_batch, y_batch,
+        ...] aligned with the feed vars (reference tensor-provider
+        contract — distinct from the per-sample form above)."""
+        self._reader = reader
+        self._mode = "tensor"
+
+    def start(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._reader is None:
+            raise RuntimeError(
+                "py_reader has no source: call decorate_paddle_reader first")
+        if getattr(self, "_mode", "sample") == "tensor":
+            names = [v.name for v in self.feed_vars]
+
+            def gen():
+                for slots in self._reader():
+                    yield {n: np.asarray(a) for n, a in zip(names, slots)}
+            return gen()
+        loader = DataLoader([v for v in self.feed_vars],
+                            self._reader, capacity=self.capacity,
+                            program=self.feed_vars[0].block.program)
+        return iter(loader)

@@ -28,3 +28,43 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
             name=name + "@LEN", shape=[-1], dtype="int32", stop_gradient=True)
         main.seq_len_map[name] = len_var.name
     return var
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Async python-fed reader (reference layers/io.py:477 py_reader +
+    create_py_reader_op / lod_tensor_blocking_queue.h).
+
+    Returns a reader object whose ``decorate_paddle_reader``/
+    ``decorate_tensor_provider`` hook up a python generator; iterating the
+    attached DataLoader prefetches batches on a background thread (the
+    blocking-queue capacity bound), and ``read_file`` unpacks the declared
+    feed vars.  On TPU the double-buffering H2D overlap is handled by the
+    async dispatch of ``jax.device_put`` — the explicit double_buffer
+    decorator below is a no-op wrapper kept for API parity.
+    """
+    from ..core import unique_name
+    from ..data.loader import PyReader
+
+    lod_levels = lod_levels or [0] * len(shapes)
+    prefix = name or unique_name.generate("py_reader")
+    vars_ = []
+    for i, (shape, dtype, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+        vars_.append(data(f"{prefix}_{i}", list(shape),
+                          dtype=dtype, lod_level=lod,
+                          append_batch_size=False))
+    return PyReader(vars_, capacity)
+
+
+def double_buffer(reader, place=None, name=None):
+    """API-parity wrapper (reference layers/io.py:892): device-side double
+    buffering is inherent to async dispatch + donated-buffer stepping on
+    TPU, so this returns the reader unchanged."""
+    return reader
+
+
+def read_file(reader):
+    """Unpack the feed vars declared by ``py_reader`` (reference
+    layers/io.py read_file)."""
+    vars_ = reader.feed_vars
+    return vars_[0] if len(vars_) == 1 else list(vars_)

@@ -1,0 +1,60 @@
+"""Transpiler namespace (reference ``python/paddle/fluid/transpiler/``).
+
+- ``DistributeTranspiler`` — the pserver-mode program rewrite
+  (distributed/transpiler.py); ``nccl2`` mode maps to the collective
+  world bring-up (``parallel.init_from_env`` + ParallelExecutor), where
+  XLA/GSPMD inserts the collectives the reference's transpiler appended
+  as ops.
+- ``memory_optimize`` / ``release_memory`` — API-parity no-ops: liveness-
+  based var reuse (memory_optimization_transpiler.py) is obsolete under
+  whole-block XLA compilation, where buffer assignment performs the same
+  analysis on the HLO (SURVEY.md §7 "GC/memory transpiler: obsolete").
+- ``InferenceTranspiler`` — program-level inference fusions
+  (inference_transpiler.py) over the passes in ``inference/passes.py``.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .distributed.transpiler import (DistributeTranspiler,
+                                     DistributeTranspilerConfig)
+from .inference import passes as _passes
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "InferenceTranspiler", "memory_optimize", "release_memory",
+           "HashName", "RoundRobin"]
+
+# split-method tags (reference transpiler/ps_dispatcher.py)
+RoundRobin = "RoundRobin"
+HashName = "HashName"
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """No-op under XLA: buffer liveness/reuse is performed by XLA buffer
+    assignment on the compiled block, which sees the true dataflow instead
+    of a conservative program-level approximation."""
+    if print_log:
+        warnings.warn("memory_optimize is a no-op: XLA buffer assignment "
+                      "owns memory reuse under whole-block compilation")
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """No-op (see memory_optimize)."""
+    return input_program
+
+
+class InferenceTranspiler:
+    """Inference-time program fusions (reference
+    transpiler/inference_transpiler.py): conv+bn folding and fc+act
+    fusion, applied in place."""
+
+    def transpile(self, program, place=None, scope=None):
+        from .core.executor import global_scope
+
+        scope = scope or global_scope()
+        _passes.apply_is_test(program)
+        _passes.fuse_conv_bn(program, scope)
+        _passes.fuse_fc_act(program, scope)
+        return program

@@ -185,3 +185,23 @@ def test_dataloader_early_break_no_hang():
     # iterating again works (fresh queue per __iter__)
     n = sum(1 for _ in loader)
     assert n > 10
+
+
+def test_new_dataset_readers_yield_consistent_shapes():
+    from paddle_tpu.data import datasets as D
+
+    for name, reader, checks in [
+        ("imikolov", D.imikolov.train(), lambda s: len(s) == 5),
+        ("movielens", D.movielens.train(), lambda s: len(s) == 8),
+        ("conll05", D.conll05.test(),
+         lambda s: len(s) == 4 and len(s[0]) == len(s[3])),
+        ("wmt14", D.wmt14.train(),
+         lambda s: len(s) == 3 and len(s[1]) == len(s[2])),
+        ("flowers", D.flowers.train(),
+         lambda s: s[0].shape == (3 * 224 * 224,)),
+        ("sentiment", D.sentiment.train(), lambda s: len(s) == 2),
+    ]:
+        it = reader()
+        for _ in range(3):
+            sample = next(it)
+            assert checks(sample), name

@@ -1,0 +1,170 @@
+"""High-level Trainer/Inferencer + py_reader + transpiler shims
+(reference contrib/trainer.py:169,379, contrib/inferencer.py,
+layers/io.py:477 py_reader, memory_optimization_transpiler.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.program import Program, program_guard
+
+L = fluid.layers
+
+
+def _train_func():
+    x = L.data("x", [4])
+    y = L.data("y", [1])
+    pred = L.fc(x, 1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    acc = L.mean(pred)
+    return [loss, acc]
+
+
+def _opt_func():
+    return fluid.optimizer.SGD(0.05)
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype("float32")
+    for _ in range(8):
+        x = rng.randn(16, 4).astype("float32")
+        yield list(zip(x, (x @ w).astype("float32")))
+
+
+def test_trainer_events_checkpoints_and_resume(tmp_path):
+    ckpt = fluid.CheckpointConfig(str(tmp_path / "ck"), max_num_checkpoints=2)
+    events, losses = [], []
+
+    def handler(ev):
+        events.append(type(ev).__name__)
+        if isinstance(ev, fluid.EndStepEvent):
+            losses.append(float(ev.metrics[0]))
+
+    trainer = fluid.Trainer(_train_func, _opt_func, checkpoint_config=ckpt)
+    trainer.train(num_epochs=3, event_handler=handler, reader=_reader,
+                  feed_order=["x", "y"])
+    assert losses[-1] < losses[0]
+    assert events[0] == "BeginEpochEvent" and "EndStepEvent" in events
+    # max_num_checkpoints retention
+    import os
+    kept = sorted(os.listdir(ckpt.checkpoint_dir))
+    assert kept == ["epoch_1", "epoch_2"]
+
+    trainer.save_params(str(tmp_path / "params"))
+    trainer.save_inference_model(str(tmp_path / "inf"), ["x"], [1])
+
+    # a NEW trainer resumes from the latest checkpoint: first-step loss
+    # continues from trained params, far below the fresh-init loss
+    resumed = fluid.Trainer(_train_func, _opt_func, checkpoint_config=ckpt)
+    rlosses = []
+
+    def handler2(ev):
+        if isinstance(ev, fluid.EndStepEvent):
+            rlosses.append(float(ev.metrics[0]))
+
+    resumed.train(num_epochs=1, event_handler=handler2, reader=_reader,
+                  feed_order=["x", "y"])
+    assert rlosses[0] < losses[0] * 0.5
+
+    # Inferencer over the saved params
+    def _infer_func():
+        x = L.data("x", [4])
+        return L.fc(x, 1)
+
+    inf = fluid.Inferencer(_infer_func, str(tmp_path / "params"))
+    (out,) = inf.infer({"x": np.ones((2, 4), "float32")})
+    assert out.shape == (2, 1)
+
+
+def test_trainer_stop_event():
+    trainer = fluid.Trainer(_train_func, _opt_func)
+    seen = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndStepEvent):
+            seen.append(ev.step)
+            if ev.step >= 2:
+                trainer.stop()
+
+    trainer.train(num_epochs=5, event_handler=handler, reader=_reader,
+                  feed_order=["x", "y"])
+    assert max(seen) == 2  # stopped mid-epoch
+
+
+def test_py_reader_round_trip():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        reader = L.io.py_reader(capacity=4, shapes=[(-1, 3), (-1, 1)],
+                                dtypes=["float32", "float32"], name="r")
+        x, y = L.io.read_file(reader)
+        loss = L.mean(L.elementwise_add(x, y))
+
+    def source():
+        for i in range(5):
+            xs = np.full((4, 3), float(i), "float32")
+            ys = np.full((4, 1), 1.0, "float32")
+            yield list(zip(xs, ys))
+
+    reader.decorate_paddle_reader(source)
+    from paddle_tpu.core.executor import Executor, Scope
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    vals = []
+    for feed in reader.start():
+        (lv,) = exe.run(prog, feed=feed, fetch_list=[loss], scope=scope)
+        vals.append(float(lv))
+    assert len(vals) == 5
+    np.testing.assert_allclose(vals, [1, 2, 3, 4, 5])
+
+
+def test_transpiler_shims():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [4])
+        h = L.fc(x, 8, act="relu")
+    n_ops = len(prog.global_block.ops)
+    assert fluid.memory_optimize(prog) is prog      # no-op, same program
+    assert fluid.release_memory(prog) is prog
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    scope = Scope()
+    with scope_guard(scope):
+        Executor().run(startup)
+        fluid.InferenceTranspiler().transpile(prog, scope=scope)
+    types = [op.type for op in prog.global_block.ops]
+    assert "fused_fc" in types and len(types) < n_ops
+
+
+def test_checkpoint_resume_numbering_keeps_freshest(tmp_path):
+    """Regression: a resumed trainer numbers checkpoints AFTER the loaded
+    epoch, so retention never deletes the just-saved resume checkpoint."""
+    import os
+    ckpt = fluid.CheckpointConfig(str(tmp_path / "ck"), max_num_checkpoints=2)
+    t1 = fluid.Trainer(_train_func, _opt_func, checkpoint_config=ckpt)
+    t1.train(3, lambda ev: None, reader=_reader, feed_order=["x", "y"])
+    assert sorted(os.listdir(ckpt.checkpoint_dir)) == ["epoch_1", "epoch_2"]
+    t2 = fluid.Trainer(_train_func, _opt_func, checkpoint_config=ckpt)
+    t2.train(1, lambda ev: None, reader=_reader, feed_order=["x", "y"])
+    assert sorted(os.listdir(ckpt.checkpoint_dir)) == ["epoch_2", "epoch_3"]
+
+
+def test_py_reader_tensor_provider_mode():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        reader = L.io.py_reader(capacity=2, shapes=[(-1, 3)],
+                                dtypes=["float32"])
+        x = L.io.read_file(reader)
+        s = L.mean(x)
+
+    def tensor_source():
+        for i in range(3):
+            yield [np.full((2, 3), float(i), "float32")]
+
+    reader.decorate_tensor_provider(tensor_source)
+    from paddle_tpu.core.executor import Executor, Scope
+    exe = Executor()
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    got = [float(exe.run(prog, feed=fd, fetch_list=[s], scope=scope)[0])
+           for fd in reader.start()]
+    assert got == [0.0, 1.0, 2.0]

@@ -14,6 +14,10 @@ from ..inference.passes import apply_is_test
 class Inferencer:
     def __init__(self, infer_func: Callable, param_path: str, place=None,
                  parallel: bool = False):
+        if parallel:
+            raise NotImplementedError(
+                "Inferencer(parallel=True) is not implemented; batch across "
+                "the mesh with ParallelExecutor directly")
         self.scope = Scope()
         self.place = place
         self.startup_program = Program()

@@ -300,11 +300,11 @@ class movielens:
             _warn_synth("movielens")
             rng = np.random.RandomState(seed)
             for _ in range(n):
-                uid = rng.randint(1, movielens.USER_ID_MAX)
+                uid = rng.randint(1, movielens.USER_ID_MAX + 1)
                 gender = rng.randint(0, 2)
                 age = rng.randint(0, movielens.AGES)
                 job = rng.randint(0, movielens.JOBS)
-                mid = rng.randint(1, movielens.MOVIE_ID_MAX)
+                mid = rng.randint(1, movielens.MOVIE_ID_MAX + 1)
                 # category / title are id SEQUENCES (lod_level=1 feeds)
                 ncat = rng.randint(1, 4)
                 cats = rng.randint(0, movielens.CATEGORIES,
@@ -388,7 +388,7 @@ class wmt14:
             for _ in range(n):
                 ln = rng.randint(4, max_len)
                 src = rng.randint(3, dict_size, ln).astype("int64")
-                trg = ((src * 7 + 1) % dict_size).astype("int64")
+                trg = ((src * 7 + 1) % (dict_size - 3) + 3).astype("int64")
                 trg_in = np.concatenate([[1], trg[:-1]]).astype("int64")
                 yield (src, trg_in, trg)
         return reader

@@ -111,11 +111,20 @@ class PyReader:
             raise RuntimeError(
                 "py_reader has no source: call decorate_paddle_reader first")
         if getattr(self, "_mode", "sample") == "tensor":
-            names = [v.name for v in self.feed_vars]
+            vars_ = self.feed_vars
 
             def gen():
                 for slots in self._reader():
-                    yield {n: np.asarray(a) for n, a in zip(names, slots)}
+                    fd = {}
+                    for v, a in zip(vars_, slots):
+                        arr = np.asarray(a)
+                        fd[v.name] = arr
+                        if v.lod_level >= 1:
+                            # full-length companion: tensor providers feed
+                            # already-padded batches
+                            fd[v.name + "@LEN"] = np.full(
+                                (arr.shape[0],), arr.shape[1], np.int64)
+                    yield fd
             return gen()
         loader = DataLoader([v for v in self.feed_vars],
                             self._reader, capacity=self.capacity,

@@ -1,3 +1,12 @@
 """Model zoo mirroring the reference's benchmark/test model set
 (benchmark/fluid/models/ + dist_transformer.py + dist_ctr.py)."""
-from . import deepfm, mnist, resnet, stacked_lstm, transformer, vgg  # noqa: F401
+from . import (  # noqa: F401
+    deepfm,
+    machine_translation,
+    mnist,
+    resnet,
+    se_resnext,
+    stacked_lstm,
+    transformer,
+    vgg,
+)

@@ -26,6 +26,14 @@
 
 #include <zlib.h>
 
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <cerrno>
+#include <chrono>
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -299,6 +307,168 @@ void ptq_pool_destroy(void* pp) {
   p->cv.notify_all();
   for (auto& t : p->workers) t.join();
   delete p;
+}
+
+
+// ---------------------------------------------------------------------------
+// Framed-TCP transport (the gRPC byte-transport role for pserver mode:
+// reference operators/distributed/grpc_client.h + grpc_server.cc do the
+// wire handling in C++, request handlers live above).  Frames are
+// u32-length-prefixed byte bodies; partial reads/writes handled here so
+// the Python layer above never loops on syscalls.
+// ---------------------------------------------------------------------------
+
+struct Conn { int fd; };
+struct Listener { int fd; };
+
+static int write_all(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return -1;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+static int read_all(int fd, char* p, size_t n) {
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r == 0) return 1;  // eof
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+void* ptq_conn_connect(const char* host, int port, double timeout_s) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                   sizeof(addr)) != 0) {
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Conn{fd};
+  return c;
+}
+
+int ptq_conn_send_frame(void* cp, const char* body, size_t len) {
+  auto* c = static_cast<Conn*>(cp);
+  uint32_t n = static_cast<uint32_t>(len);
+  // one buffer, one write: header+body in a single TCP segment under
+  // TCP_NODELAY (two send() calls would emit two packets per frame)
+  char* buf = static_cast<char*>(malloc(len + 4));
+  if (!buf) return -1;
+  memcpy(buf, &n, 4);  // little-endian hosts (x86/ARM TPU VMs)
+  memcpy(buf + 4, body, len);
+  int rc = write_all(c->fd, buf, len + 4);
+  free(buf);
+  return rc;
+}
+
+char* ptq_conn_recv_frame(void* cp, size_t* len_out) {
+  auto* c = static_cast<Conn*>(cp);
+  char hdr[4];
+  int r = read_all(c->fd, hdr, 4);
+  if (r != 0) return nullptr;
+  uint32_t n;
+  memcpy(&n, hdr, 4);
+  char* buf = static_cast<char*>(malloc(n ? n : 1));
+  if (!buf) return nullptr;
+  if (read_all(c->fd, buf, n) != 0) {
+    free(buf);
+    return nullptr;
+  }
+  *len_out = n;
+  return buf;  // caller frees via ptq_buffer_free
+}
+
+void ptq_conn_shutdown(void* cp) {
+  // wake a blocked reader WITHOUT freeing: the serving thread owns the
+  // handle and closes it when its recv returns EOF
+  auto* c = static_cast<Conn*>(cp);
+  ::shutdown(c->fd, SHUT_RDWR);
+}
+
+void ptq_conn_close(void* cp) {
+  auto* c = static_cast<Conn*>(cp);
+  ::shutdown(c->fd, SHUT_RDWR);
+  ::close(c->fd);
+  delete c;
+}
+
+void* ptq_listener_create(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  return new Listener{fd};
+}
+
+int ptq_listener_port(void* lp) {
+  auto* l = static_cast<Listener*>(lp);
+  sockaddr_in addr{};
+  socklen_t alen = sizeof(addr);
+  if (::getsockname(l->fd, reinterpret_cast<sockaddr*>(&addr), &alen) != 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void ptq_listener_shutdown(void* lp) {
+  // wake a blocked accept WITHOUT freeing; the accept loop owns the
+  // listener and closes it when accept returns failure
+  auto* l = static_cast<Listener*>(lp);
+  ::shutdown(l->fd, SHUT_RDWR);
+}
+
+void* ptq_listener_accept(void* lp) {
+  auto* l = static_cast<Listener*>(lp);
+  int fd;
+  do {
+    fd = ::accept(l->fd, nullptr, nullptr);
+  } while (fd < 0 && (errno == EINTR || errno == ECONNABORTED));
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return new Conn{fd};
+}
+
+void ptq_listener_close(void* lp) {
+  auto* l = static_cast<Listener*>(lp);
+  ::shutdown(l->fd, SHUT_RDWR);
+  ::close(l->fd);
+  delete l;
 }
 
 }  // extern "C"

@@ -86,5 +86,9 @@ define_flag("cpu_deterministic", False,
 define_flag("rpc_deadline", 120.0,
             "pserver transport connect deadline in seconds "
             "(distributed/transport.py)")
+define_flag("rpc_transport", "native",
+            "pserver byte-transport backend: 'native' (C framed-TCP in "
+            "native/paddle_tpu_native.cc, the reference's C++ gRPC layer "
+            "role) or 'python' (stdlib sockets fallback)")
 define_flag("paddle_num_threads", 1,
             "accepted for parity; host threading is owned by XLA")

@@ -50,6 +50,27 @@ def load() -> ctypes.CDLL:
     lib.ptq_queue_closed.argtypes = [ctypes.c_void_p]
     lib.ptq_queue_destroy.argtypes = [ctypes.c_void_p]
     # recordio
+    # transport (framed TCP; see native/paddle_tpu_native.cc)
+    lib.ptq_conn_connect.restype = ctypes.c_void_p
+    lib.ptq_conn_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_double]
+    lib.ptq_conn_send_frame.restype = ctypes.c_int
+    lib.ptq_conn_send_frame.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_size_t]
+    lib.ptq_conn_recv_frame.restype = ctypes.POINTER(ctypes.c_char)
+    lib.ptq_conn_recv_frame.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_size_t)]
+    lib.ptq_conn_close.argtypes = [ctypes.c_void_p]
+    lib.ptq_conn_shutdown.argtypes = [ctypes.c_void_p]
+    lib.ptq_listener_create.restype = ctypes.c_void_p
+    lib.ptq_listener_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ptq_listener_port.restype = ctypes.c_int
+    lib.ptq_listener_port.argtypes = [ctypes.c_void_p]
+    lib.ptq_listener_accept.restype = ctypes.c_void_p
+    lib.ptq_listener_accept.argtypes = [ctypes.c_void_p]
+    lib.ptq_listener_close.argtypes = [ctypes.c_void_p]
+    lib.ptq_listener_shutdown.argtypes = [ctypes.c_void_p]
+
     lib.ptq_recordio_writer_open.restype = ctypes.c_void_p
     lib.ptq_recordio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_size_t]
     lib.ptq_recordio_write.restype = ctypes.c_int

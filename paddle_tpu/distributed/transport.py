@@ -6,20 +6,28 @@ TPU-native replacement for the reference's gRPC transport
 DCN between TPU-VM hosts; intra-pod dense traffic rides XLA collectives
 instead (parallel/), so this path only carries pserver/sparse variables.
 
-Wire format (little-endian), one frame per request and per response:
+The byte transport is pluggable (FLAGS_rpc_transport):
 
-    u32  body_len
-    body = u8 msg_type | i32 trainer_id | u16 name_len | name | payload
+- ``native`` (default): the C transport in ``native/paddle_tpu_native.cc``
+  — connect/accept/framing/partial-IO in C with TCP_NODELAY, mirroring
+  the reference's C++ gRPC byte layer under Python request handlers
+  (``request_handler_impl.cc`` split).
+- ``python``: stdlib sockets (always available fallback).
 
-Connections are persistent; each client socket is a serial
+Wire format (little-endian): one ``u32 body_len``-prefixed frame per
+request and per response, body = ``u8 msg_type | i32 trainer_id |
+u16 name_len | name | payload``.
+
+Connections are persistent; each client connection is a serial
 request/response channel (guarded by a lock), and the client fans out to
 many endpoints concurrently via a shared thread pool — the analogue of the
 reference's async completion queues + ``Wait`` (``grpc_client.h:180-213``).
-Server handlers may block (sync-mode barriers), so the server is
-thread-per-connection like the reference's handler thread pools.
+Server handlers may block (sync-mode barriers), so both server backends
+are thread-per-connection like the reference's handler thread pools.
 """
 from __future__ import annotations
 
+import ctypes
 import socket
 import socketserver
 import struct
@@ -44,38 +52,186 @@ ERR = 255
 
 _HDR = struct.Struct("<BiH")  # msg_type, trainer_id, name_len
 
+_CONNECT_TIMEOUT = 120.0
 
-def _send_frame(sock: socket.socket, msg_type: int, trainer_id: int,
-                name: str, payload: bytes = b"") -> None:
+
+def _backend() -> str:
+    from ..core import flags
+
+    try:
+        want = flags.get_flags("rpc_transport")
+    except KeyError:  # pragma: no cover
+        want = "native"
+    if want == "native" and _native_lib() is None:
+        return "python"
+    return want
+
+
+_native = None
+_native_failed = False
+
+
+def _native_lib():
+    global _native, _native_failed
+    if _native is None and not _native_failed:
+        try:
+            from ..data import native as _n
+            _native = _n.load()
+        except Exception:  # pragma: no cover - build env without g++
+            _native_failed = True
+    return _native
+
+
+def _pack_body(msg_type: int, trainer_id: int, name: str,
+               payload: bytes) -> bytes:
     nm = name.encode("utf-8")
-    body = _HDR.pack(msg_type, trainer_id, len(nm)) + nm + payload
-    sock.sendall(struct.pack("<I", len(body)) + body)
+    return _HDR.pack(msg_type, trainer_id, len(nm)) + nm + payload
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    chunks = []
-    while n:
-        b = sock.recv(min(n, 1 << 20))
-        if not b:
-            return None
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
-
-
-def _recv_frame(sock: socket.socket):
-    raw = _recv_exact(sock, 4)
-    if raw is None:
-        return None
-    (blen,) = struct.unpack("<I", raw)
-    body = _recv_exact(sock, blen)
-    if body is None:
-        return None
+def _unpack_body(body: bytes):
     msg_type, trainer_id, name_len = _HDR.unpack_from(body, 0)
     off = _HDR.size
     name = body[off:off + name_len].decode("utf-8")
-    payload = body[off + name_len:]
-    return msg_type, trainer_id, name, payload
+    return msg_type, trainer_id, name, body[off + name_len:]
+
+
+# ---------------------------------------------------------------------------
+# byte-frame IO backends
+# ---------------------------------------------------------------------------
+
+class _PyIO:
+    """u32-framed stdlib-socket IO."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    @classmethod
+    def connect(cls, host: str, port: int, timeout: float) -> "_PyIO":
+        deadline = time.time() + timeout
+        last = None
+        while True:
+            try:
+                s = socket.create_connection((host, port), timeout=30.0)
+                s.settimeout(None)
+                return cls(s)
+            except OSError as e:  # pserver may not be up yet
+                last = e
+                if time.time() > deadline:
+                    raise ConnectionError(
+                        f"cannot reach pserver at {host}:{port}: {last}")
+                time.sleep(0.1)
+
+    def send_frame(self, body: bytes) -> None:
+        self.sock.sendall(struct.pack("<I", len(body)) + body)
+
+    def recv_frame(self) -> Optional[bytes]:
+        raw = self._recv_exact(4)
+        if raw is None:
+            return None
+        (blen,) = struct.unpack("<I", raw)
+        return self._recv_exact(blen)
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        chunks = []
+        while n:
+            try:
+                b = self.sock.recv(min(n, 1 << 20))
+            except OSError:
+                return None
+            if not b:
+                return None
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class _NativeIO:
+    """C-transport IO (framing + partial reads/writes in native code).
+
+    Handle lifetime: exactly one thread sends/receives on an IO at a time
+    (client conns serialize under _Conn.lock; the server's serving thread
+    is the sole reader).  ``shutdown`` only wakes a blocked reader;
+    ``close`` frees — both serialized by ``_hlock`` so a raced shutdown
+    never touches a freed handle."""
+
+    def __init__(self, handle):
+        self._h = handle
+        self._lib = _native_lib()
+        self._hlock = threading.Lock()
+
+    @classmethod
+    def connect(cls, host: str, port: int, timeout: float) -> "_NativeIO":
+        lib = _native_lib()
+        h = lib.ptq_conn_connect(host.encode(), int(port), float(timeout))
+        if not h:
+            raise ConnectionError(f"cannot reach pserver at {host}:{port}")
+        return cls(h)
+
+    def send_frame(self, body: bytes) -> None:
+        h = self._h
+        if not h:
+            raise ConnectionError("native transport: connection closed")
+        if self._lib.ptq_conn_send_frame(h, body, len(body)) != 0:
+            raise ConnectionError("native transport: send failed")
+
+    def recv_frame(self) -> Optional[bytes]:
+        h = self._h
+        if not h:
+            return None
+        n = ctypes.c_size_t()
+        p = self._lib.ptq_conn_recv_frame(h, ctypes.byref(n))
+        if not p:
+            return None
+        try:
+            return ctypes.string_at(p, n.value)
+        finally:
+            self._lib.ptq_buffer_free(p)
+
+    def shutdown(self) -> None:
+        with self._hlock:
+            if self._h:
+                self._lib.ptq_conn_shutdown(self._h)
+
+    def close(self) -> None:
+        with self._hlock:
+            if self._h:
+                self._lib.ptq_conn_close(self._h)
+                self._h = None
+
+
+def _connect_io(host: str, port: int, timeout: float):
+    if _backend() == "native":
+        return _NativeIO.connect(host, port, timeout)
+    return _PyIO.connect(host, port, timeout)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+def _serve_io(io, service) -> None:
+    """Request loop for one connection (either backend)."""
+    while True:
+        body = io.recv_frame()
+        if body is None:
+            return
+        msg_type, tid, name, payload = _unpack_body(body)
+        try:
+            rtype, rpayload = service.handle(msg_type, tid, name, payload)
+        except Exception as e:
+            rtype, rpayload = ERR, repr(e).encode("utf-8")
+        try:
+            io.send_frame(_pack_body(rtype, tid, name, rpayload))
+        except ConnectionError:
+            return
 
 
 class RPCServer:
@@ -91,36 +247,37 @@ class RPCServer:
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
         self.service = service
-        outer = self
+        self._impl = (_NativeServer(host, int(port), service)
+                      if _backend() == "native"
+                      else _PyServer(host, int(port), service))
+
+    @property
+    def port(self) -> int:
+        return self._impl.port
+
+    def start(self) -> None:
+        self._impl.start()
+
+    def stop(self) -> None:
+        self._impl.stop()
+
+
+class _PyServer:
+    def __init__(self, host: str, port: int, service):
+        outer_service = service
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                while True:
-                    try:
-                        frame = _recv_frame(self.request)
-                    except OSError:
-                        return
-                    if frame is None:
-                        return
-                    msg_type, tid, name, payload = frame
-                    try:
-                        rtype, rpayload = outer.service.handle(
-                            msg_type, tid, name, payload)
-                    except Exception as e:  # propagate as ERR frame
-                        rtype, rpayload = ERR, repr(e).encode("utf-8")
-                    try:
-                        _send_frame(self.request, rtype, tid, name, rpayload)
-                    except OSError:
-                        return
+                _serve_io(_PyIO(self.request), outer_service)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server((host, int(port)), Handler)
+        self._server = Server((host, port), Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
-            daemon=True, name=f"rpc-server-{endpoint}")
+            daemon=True, name=f"rpc-server-{host}:{port}")
 
     @property
     def port(self) -> int:
@@ -134,31 +291,84 @@ class RPCServer:
         self._server.server_close()
 
 
+class _NativeServer:
+    """Accept loop over the native listener; thread per connection."""
+
+    def __init__(self, host: str, port: int, service):
+        self._lib = _native_lib()
+        self._l = self._lib.ptq_listener_create(host.encode(), port)
+        if not self._l:
+            raise OSError(f"cannot bind {host}:{port}")
+        self._service = service
+        self._conns = []
+        self._closing = False
+        self._port = self._lib.ptq_listener_port(self._l)
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                        name=f"rpc-native-{host}:{port}")
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            h = self._lib.ptq_listener_accept(self._l)
+            if not h:
+                # listener shut down (stop()): the accept loop frees it
+                lstn, self._l = self._l, None
+                if lstn:
+                    self._lib.ptq_listener_close(lstn)
+                return
+            io = _NativeIO(h)
+            with self._lock:
+                self._conns.append(io)
+
+            def serve(io=io):
+                try:
+                    _serve_io(io, self._service)
+                finally:
+                    with self._lock:
+                        if io in self._conns:
+                            self._conns.remove(io)
+                    io.close()  # the serving thread OWNS the handle
+
+            threading.Thread(target=serve, daemon=True).start()
+
+    def stop(self) -> None:
+        lstn = self._l
+        self._closing = True
+        if lstn:
+            if self._thread.is_alive():
+                # wake the blocked accept; the accept loop owns the
+                # listener and frees it on the way out
+                self._lib.ptq_listener_shutdown(lstn)
+            else:
+                self._l = None
+                self._lib.ptq_listener_close(lstn)
+        with self._lock:
+            conns = list(self._conns)
+        for io in conns:
+            io.shutdown()  # wake readers; serving threads free handles
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
 class _Conn:
     def __init__(self, endpoint: str, connect_timeout: float):
         host, port = endpoint.rsplit(":", 1)
         self.lock = threading.Lock()
-        deadline = time.time() + connect_timeout
-        last = None
-        while True:
-            try:
-                self.sock = socket.create_connection((host, int(port)), timeout=30.0)
-                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self.sock.settimeout(None)
-                return
-            except OSError as e:  # pserver may not be up yet (_wait_ps_ready)
-                last = e
-                if time.time() > deadline:
-                    raise ConnectionError(
-                        f"cannot reach pserver at {endpoint}: {last}")
-                time.sleep(0.1)
+        self.io = _connect_io(host, int(port), connect_timeout)
 
 
 class RPCClient:
     """Trainer-side client: one persistent connection per endpoint +
     a shared pool for concurrent fan-out (``GRPCClient`` analogue)."""
-
-    _CONNECT_TIMEOUT = 120.0
 
     def __init__(self, trainer_id: int = 0):
         self.trainer_id = trainer_id
@@ -167,23 +377,56 @@ class RPCClient:
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="rpc-client")
 
-    def _conn(self, endpoint: str) -> _Conn:
+    def _conn(self, endpoint: str, timeout: float = _CONNECT_TIMEOUT) -> _Conn:
         with self._conns_lock:
             c = self._conns.get(endpoint)
             if c is None:
-                c = _Conn(endpoint, self._CONNECT_TIMEOUT)
+                c = _Conn(endpoint, timeout)
                 self._conns[endpoint] = c
             return c
 
+    def _drop_conn(self, endpoint: str, c: "_Conn") -> None:
+        with self._conns_lock:
+            if self._conns.get(endpoint) is c:
+                self._conns.pop(endpoint)
+        try:
+            with c.lock:  # never free under a peer thread's send/recv
+                c.io.close()
+        except Exception:
+            pass
+
+    # messages safe to auto-retry after a connection error: read-only or
+    # idempotent on the server.  SEND_VAR (async mode applies grads on
+    # arrival) and BATCH_BARRIER (closes a round) could have been applied
+    # before the response was lost — retrying would double-count, so they
+    # surface the error instead (the reference's at-most-once discipline
+    # for mutating RPCs).
+    _RETRYABLE = frozenset((GET_VAR, PREFETCH, FETCH_BARRIER,
+                            CHECKPOINT_NOTIFY))
+
     def _request(self, endpoint: str, msg_type: int, name: str = "",
                  payload: bytes = b""):
-        c = self._conn(endpoint)
-        with c.lock:
-            _send_frame(c.sock, msg_type, self.trainer_id, name, payload)
-            frame = _recv_frame(c.sock)
-        if frame is None:
-            raise ConnectionError(f"pserver {endpoint} closed the connection")
-        rtype, _, _, rpayload = frame
+        body = None
+        for attempt in (0, 1):
+            # retry connects get a short deadline: the long one is only for
+            # initial bring-up (pservers may start after trainers)
+            c = self._conn(endpoint, _CONNECT_TIMEOUT if attempt == 0 else 5.0)
+            try:
+                with c.lock:
+                    c.io.send_frame(_pack_body(msg_type, self.trainer_id,
+                                               name, payload))
+                    body = c.io.recv_frame()
+                if body is None:
+                    raise ConnectionError(
+                        f"pserver {endpoint} closed the connection")
+                break
+            except ConnectionError:
+                # stale cached connection (pserver restarted, or the port
+                # was reassigned): reconnect once for idempotent requests
+                self._drop_conn(endpoint, c)
+                if attempt or msg_type not in self._RETRYABLE:
+                    raise
+        rtype, _, _, rpayload = _unpack_body(body)
         if rtype == ERR:
             raise RuntimeError(
                 f"pserver {endpoint} error for {name!r}: "
@@ -211,7 +454,20 @@ class RPCClient:
         self._request(endpoint, CHECKPOINT_NOTIFY, dirname)
 
     def complete(self, endpoint: str) -> None:
-        self._request(endpoint, COMPLETE)
+        """Best-effort: the last trainer's COMPLETE makes the pserver shut
+        down, which can race the response/connection teardown — a dropped
+        connection here means the server exited, i.e. success.  Never
+        retried (a duplicate COMPLETE would double-count the trainer)."""
+        c = self._conn(endpoint)
+        try:
+            with c.lock:
+                c.io.send_frame(_pack_body(COMPLETE, self.trainer_id, "",
+                                           b""))
+                c.io.recv_frame()
+        except ConnectionError:
+            pass
+        finally:
+            self._drop_conn(endpoint, c)
 
     def parallel(self, calls):
         """Run [(fn, args...), ...] concurrently; reraise first error."""

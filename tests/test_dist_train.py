@@ -182,3 +182,19 @@ def test_dist_subprocess_matches_local():
                 np.testing.assert_allclose(
                     data[name], want, rtol=2e-4, atol=2e-5,
                     err_msg=f"trainer {tid} param {name}")
+
+
+@pytest.mark.parametrize("backend", ["native", "python"])
+def test_sync_pserver_matches_local_on_both_transports(backend):
+    """The C framed-TCP transport and the stdlib-socket fallback carry the
+    same protocol: sync parity holds on either."""
+    fluid.set_flags({"rpc_transport": backend})
+    try:
+        results = _run_cluster(sync_mode=True, slice_var_up=False)
+    finally:
+        fluid.set_flags({"rpc_transport": "native"})
+    _, local_params = run_local(N_STEPS)
+    _, dist_params = results[0]
+    for name, want in local_params.items():
+        np.testing.assert_allclose(dist_params[name], want, rtol=2e-4,
+                                   atol=2e-5, err_msg=f"{backend} {name}")

@@ -1,0 +1,70 @@
+"""Low-precision inference transpiler (reference
+``paddle/contrib/float16/float16_transpiler.py``): rewrite a trained
+f32 inference program + its weights to run in half precision.
+
+TPU-native difference: the target type is **bfloat16** (the MXU's native
+half type — fp16 on TPU gains nothing and loses exponent range), and no
+cast ops need inserting: variable dtypes drive weight conversion and feed
+casting, and XLA fuses any remaining converts.  Batch-norm / layer-norm
+statistics stay f32 (their kernels normalize in f32 and cast back, so the
+declared dtype is honored).  Network outputs come back bfloat16 — cast on
+the host if a consumer needs f32.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set
+
+import numpy as np
+
+from ..core.executor import global_scope
+from ..core.program import Program
+from ..core.types import np_dtype
+
+# vars feeding these slots keep f32 (running stats / normalization)
+_KEEP_F32_INPUT_SLOTS = {
+    "batch_norm": ("Scale", "Bias", "Mean", "Variance"),
+    "layer_norm": ("Scale", "Bias"),
+}
+
+# attrs that carry a dtype and must follow the conversion
+_DTYPE_ATTRS = ("dtype", "out_dtype", "in_dtype", "w_dtype")
+
+
+class Float16Transpiler:
+    """reference float16_transpiler.py, retargeted to bfloat16."""
+
+    def transpile(self, program: Program, place=None, scope=None,
+                  keep_vars: Optional[Iterable[str]] = None) -> Program:
+        scope = scope or global_scope()
+        bf16 = np_dtype("bfloat16")
+
+        keep: Set[str] = set(keep_vars or ())
+        for block in program.blocks:
+            for op in block.ops:
+                slots = _KEEP_F32_INPUT_SLOTS.get(op.type)
+                if slots:
+                    for slot in slots:
+                        keep.update(op.input(slot))
+
+        for block in program.blocks:
+            for var in block.vars.values():
+                if var.dtype == "float32" and var.name not in keep:
+                    var.dtype = "bfloat16"
+                    val = scope.find_var(var.name)
+                    if val is not None and var.persistable:
+                        scope.set_var(var.name,
+                                      np.asarray(val).astype(bf16))
+            for op in block.ops:
+                if set(op.output_arg_names()) & keep:
+                    continue
+                for attr in _DTYPE_ATTRS:
+                    if op.attr(attr) == "float32":
+                        op.set_attr(attr, "bfloat16")
+        program._version += 1
+        return program
+
+
+def transpile_to_bf16(program: Program, scope=None,
+                      keep_vars: Optional[Iterable[str]] = None) -> Program:
+    return Float16Transpiler().transpile(program, scope=scope,
+                                         keep_vars=keep_vars)

@@ -154,3 +154,42 @@ def test_fusion_preserves_fetched_intermediates_and_act_attrs(tmp_path):
     # h is a fetch target AND feeds the second fc: alpha=0.5 must survive
     np.testing.assert_allclose(a_h, b_h, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(a_y, b_y, rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_transpiler_parity_and_dtypes(tmp_path):
+    """contrib.Float16Transpiler (bfloat16 retarget of the reference
+    float16 inference transpiler): dtype rewrite + weight conversion with
+    output parity within bf16 tolerance; BN stats stay f32."""
+    from paddle_tpu.contrib import transpile_to_bf16
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [3, 16, 16])
+        c = L.conv2d(x, 8, 3, padding=1, bias_attr=False)
+        bn = L.batch_norm(c, is_test=True)
+        h = L.fc(L.reshape(bn, [-1, 8 * 16 * 16]), 10, act="softmax")
+    scope = Scope()
+    exe = Executor()
+    xv = np.random.RandomState(0).randn(2, 3, 16, 16).astype("float32")
+    with scope_guard(scope):
+        exe.run(startup)
+        (want,) = exe.run(prog, feed={"x": xv}, fetch_list=[h])
+        transpile_to_bf16(prog)
+        (got,) = exe.run(prog, feed={"x": xv}, fetch_list=[h])
+
+    assert prog.global_block.var("x").dtype == "bfloat16"
+    # BN stats keep f32
+    bn_op = [op for op in prog.global_block.ops
+             if op.type == "batch_norm"][0]
+    for slot in ("Scale", "Bias", "Mean", "Variance"):
+        assert prog.global_block.var(bn_op.input(slot)[0]).dtype == "float32"
+    # weights actually converted in the scope
+    w_name = [v.name for v in prog.global_block.vars.values()
+              if v.is_parameter and "conv" in v.name][0]
+    assert "bfloat16" in str(np.asarray(scope.find_var(w_name)).dtype)
+    np.testing.assert_allclose(got.astype("float32"), want, rtol=5e-2,
+                               atol=2e-2)
+    np.testing.assert_allclose(got.astype("float32").sum(axis=1), 1.0,
+                               rtol=1e-2)
+    # outputs come back bf16 by design
+    assert "bfloat16" in str(got.dtype)

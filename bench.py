@@ -4,9 +4,10 @@ Primary metric (the BASELINE.json headline): ResNet-50 train images/sec/
 chip (bf16, batch 256) vs an A100 mixed-precision baseline (~2,500
 img/s).  The ``configs`` field carries the other four:
 
-- transformer: Transformer-base at seq 256 (the Pallas flash-attention
-  kernel is the hot path at this length, with in-kernel attention-prob
-  dropout), tokens/sec vs A100 ~50k
+- transformer: Transformer-base at seq 256 with attention-prob dropout
+  (auto attention impl: XLA fused attention at this length — the Pallas
+  flash kernel takes over at seq >= 2048 where O(T^2) scores would
+  dominate HBM), tokens/sec vs A100 ~50k
 - stacked_lstm: 3-layer LSTM sentiment net over padded length-128
   sequences, tokens/sec
 - deepfm: CTR model with a 1M-row sparse (SelectedRows) embedding table,

@@ -75,8 +75,8 @@ def multi_head_attention(q_in, k_in, v_in, attn_bias, d_model, n_head,
         ctx = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
         inputs = {"Q": [q], "K": [k], "V": [v], "KvMask": [kv_mask]}
         if dropout_rate:
-            # per-step int32 seed for the in-kernel attention-prob dropout
-            # (explicit program input → fwd and grad see identical bits).
+            # per-step int32 seed for the attention-prob dropout (explicit
+            # program input → fwd and grad see identical bits on any impl).
             # Drawn in the GLOBAL block: a stateful op inside a While/RNN
             # sub-block would make the sub-block non-differentiable.
             gb = helper.main_program.global_block

@@ -105,27 +105,36 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
+           act=None, name=None, data_layout="NCHW"):
     helper = LayerHelper("conv2d", bias_attr=bias_attr, act=act, name=name)
     dtype = input.dtype
     groups = groups or 1
     fs, st, pd, dl = _pair(filter_size), _pair(stride), _pair(padding), _pair(dilation)
-    C = input.shape[1]
+    nhwc = data_layout == "NHWC"
+    C = input.shape[-1] if nhwc else input.shape[1]
+    # Filter params stay OIHW regardless of activation layout (checkpoint
+    # compatibility); the lowering retargets the conv spec.
     w_shape = [num_filters, C // groups, fs[0], fs[1]]
     std = (2.0 / (fs[0] * fs[1] * C)) ** 0.5
     w = helper.create_parameter(
         param_attr, w_shape, dtype,
         default_initializer=NormalInitializer(0.0, std),
     )
-    H = (input.shape[2] + 2 * pd[0] - (dl[0] * (fs[0] - 1) + 1)) // st[0] + 1
-    W = (input.shape[3] + 2 * pd[1] - (dl[1] * (fs[1] - 1) + 1)) // st[1] + 1
-    out_shape = (input.shape[0], num_filters, H, W)
+    hin, win = (input.shape[1:3] if nhwc else input.shape[2:4])
+    H = (hin + 2 * pd[0] - (dl[0] * (fs[0] - 1) + 1)) // st[0] + 1
+    W = (win + 2 * pd[1] - (dl[1] * (fs[1] - 1) + 1)) // st[1] + 1
+    out_shape = ((input.shape[0], H, W, num_filters) if nhwc
+                 else (input.shape[0], num_filters, H, W))
     pre_bias = helper.create_variable_for_type_inference(dtype, shape=out_shape)
     helper.append_op(
         "conv2d", {"Input": [input], "Filter": [w]}, {"Output": [pre_bias]},
-        {"strides": st, "paddings": pd, "dilations": dl, "groups": groups},
+        {"strides": st, "paddings": pd, "dilations": dl, "groups": groups,
+         "data_layout": data_layout},
     )
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    if nhwc:
+        pre_act = helper.append_bias_op(pre_bias, dim_start=3, dim_end=4)
+    else:
+        pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
 
 
@@ -152,21 +161,24 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, use_cudnn=True, ceil_mode=False,
-           exclusive=True, name=None):
+           exclusive=True, name=None, data_layout="NCHW"):
     helper = LayerHelper("pool2d", name=name)
     ks, st, pd = _pair(pool_size), _pair(pool_stride), _pair(pool_padding)
+    nhwc = data_layout == "NHWC"
+    hin, win = (input.shape[1:3] if nhwc else input.shape[2:4])
     if global_pooling:
         H = W = 1
     else:
-        H = (input.shape[2] + 2 * pd[0] - ks[0]) // st[0] + 1
-        W = (input.shape[3] + 2 * pd[1] - ks[1]) // st[1] + 1
-    out = helper.create_variable_for_type_inference(
-        input.dtype, shape=(input.shape[0], input.shape[1], H, W))
+        H = (hin + 2 * pd[0] - ks[0]) // st[0] + 1
+        W = (win + 2 * pd[1] - ks[1]) // st[1] + 1
+    ch = input.shape[-1] if nhwc else input.shape[1]
+    shape = (input.shape[0], H, W, ch) if nhwc else (input.shape[0], ch, H, W)
+    out = helper.create_variable_for_type_inference(input.dtype, shape=shape)
     helper.append_op(
         "pool2d", {"X": [input]}, {"Out": [out]},
         {"pooling_type": pool_type, "ksize": ks, "strides": st,
          "paddings": pd, "global_pooling": global_pooling,
-         "exclusive": exclusive},
+         "exclusive": exclusive, "data_layout": data_layout},
     )
     return out
 

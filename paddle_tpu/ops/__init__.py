@@ -6,6 +6,7 @@ from . import ctc_crf_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import math_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import quant_ops  # noqa: F401

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.host_ops import register_host_op
 from ..core.registry import register
 
 
@@ -143,3 +144,177 @@ def _edit_distance(ctx, ins, attrs):
         dist = dist / jnp.maximum(ref_len[:, None].astype(jnp.float32), 1.0)
     return {"Out": [dist.astype(jnp.float32)],
             "SequenceNum": [jnp.asarray(b, jnp.int64)]}
+
+
+@register("mean_iou", no_grad_slots=("Predictions", "Labels"))
+def _mean_iou(ctx, ins, attrs):
+    """mean_iou_op.cc: mean intersection-over-union over classes present
+    in either predictions or labels (union > 0)."""
+    num_classes = attrs["num_classes"]
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    one = jnp.ones_like(pred, jnp.float32)
+    inter = jnp.zeros((num_classes,), jnp.float32).at[
+        jnp.where(pred == label, pred, num_classes - 1)
+    ].add(jnp.where(pred == label, one, 0.0))
+    pred_cnt = jnp.zeros((num_classes,), jnp.float32).at[pred].add(one)
+    label_cnt = jnp.zeros((num_classes,), jnp.float32).at[label].add(one)
+    wrong = pred_cnt + label_cnt - 2 * inter
+    # streaming accumulators (mean_iou_op.cc InWrongs/InCorrects lists)
+    for prev in ins.get("InWrongs", []):
+        wrong = wrong + prev.astype(jnp.float32)
+    for prev in ins.get("InCorrects", []):
+        inter = inter + prev.astype(jnp.float32)
+    union = 2 * inter + wrong
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(inter + wrong, 1.0), 0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    for prev in ins.get("InMeanIou", []):
+        mean = mean + prev.reshape(()).astype(jnp.float32)
+    return {"OutMeanIou": [mean],
+            "OutWrong": [wrong.astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# host-side metrics (data-dependent chunk/pair extraction; eval-time only)
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _extract_chunks(tags, scheme, num_chunk_types, excluded):
+    """Segment extraction per chunk_eval_op.h GetSegments (fresh numpy
+    port of the IOB/IOE/IOBES/plain transition rules)."""
+    num_tag, t_begin, t_inside, t_end, t_single = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+
+    def is_end(ptag, ptype, tag, typ):
+        if ptype == other:
+            return False
+        if typ == other or typ != ptype:
+            return True
+        if ptag in (t_begin, t_inside):
+            return tag in (t_begin, t_single)
+        return ptag in (t_end, t_single)
+
+    def is_begin(ptag, ptype, tag, typ):
+        if ptype == other:
+            return typ != other
+        if typ == other:
+            return False
+        if typ != ptype:
+            return True
+        if tag == t_begin or tag == t_single:
+            return True
+        if tag in (t_inside, t_end):
+            return ptag in (t_end, t_single)
+        return False
+
+    chunks = set()
+    start, in_chunk = 0, False
+    ptag, ptype = -1, other
+    for i, lab in enumerate(tags):
+        tag = int(lab) % num_tag
+        typ = int(lab) // num_tag
+        if in_chunk and is_end(ptag, ptype, tag, typ):
+            if ptype not in excluded:
+                chunks.add((start, i - 1, ptype))
+            in_chunk = False
+        if is_begin(ptag, ptype, tag, typ):
+            start, in_chunk = i, True
+        ptag, ptype = tag, typ
+    if in_chunk and ptype not in excluded:
+        chunks.add((start, len(tags) - 1, ptype))
+    return chunks
+
+
+@register_host_op("chunk_eval")
+def _chunk_eval(exe, program, op, scope):
+    """chunk_eval_op.cc: batch chunk precision/recall/F1 from padded
+    [B, T] tag tensors + @LEN lengths."""
+    import numpy as np
+
+    inf = np.asarray(scope.find_var(op.input("Inference")[0]))
+    lab = np.asarray(scope.find_var(op.input("Label")[0]))
+    lens = None
+    if op.input("SeqLen"):
+        lens = np.asarray(scope.find_var(op.input("SeqLen")[0]))
+    scheme = op.attr("chunk_scheme", "IOB")
+    num_chunk_types = op.attr("num_chunk_types")
+    excluded = set(op.attr("excluded_chunk_types", []) or [])
+    if inf.ndim == 1:
+        inf, lab = inf[None, :], lab[None, :]
+    B = inf.shape[0]
+    n_inf = n_lab = n_correct = 0
+    for i in range(B):
+        L = int(lens[i]) if lens is not None else inf.shape[1]
+        ci = _extract_chunks(inf[i, :L].reshape(-1), scheme,
+                             num_chunk_types, excluded)
+        cl = _extract_chunks(lab[i, :L].reshape(-1), scheme,
+                             num_chunk_types, excluded)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_correct += len(ci & cl)
+    p = n_correct / n_inf if n_inf else 0.0
+    r = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    scope.set_var(op.output("Precision")[0], np.asarray([p], np.float32))
+    scope.set_var(op.output("Recall")[0], np.asarray([r], np.float32))
+    scope.set_var(op.output("F1-Score")[0], np.asarray([f1], np.float32))
+    scope.set_var(op.output("NumInferChunks")[0],
+                  np.asarray([n_inf], np.int64))
+    scope.set_var(op.output("NumLabelChunks")[0],
+                  np.asarray([n_lab], np.int64))
+    scope.set_var(op.output("NumCorrectChunks")[0],
+                  np.asarray([n_correct], np.int64))
+
+
+@register_host_op("positive_negative_pair")
+def _positive_negative_pair(exe, program, op, scope):
+    """positive_negative_pair_op.cc: per-query counts of correctly ordered
+    (positive), mis-ordered (negative) and tied (neutral) score pairs,
+    accumulated into the running totals when Accumulate* inputs exist."""
+    import numpy as np
+
+    score = np.asarray(scope.find_var(op.input("Score")[0]))
+    label = np.asarray(scope.find_var(op.input("Label")[0])).reshape(-1)
+    qid = np.asarray(scope.find_var(op.input("QueryID")[0])).reshape(-1)
+    col = op.attr("column", -1)
+    score = score.reshape(len(qid), -1)[:, col]
+    weight = None
+    if op.input("Weight"):
+        weight = np.asarray(scope.find_var(op.input("Weight")[0])).reshape(-1)
+    pos = neg = neu = 0.0
+    for q in np.unique(qid):
+        idx = np.where(qid == q)[0]
+        s, l = score[idx], label[idx]
+        # vectorized upper-triangle pair comparison per query.  Reference
+        # quirks kept: pair weight = mean of the two row weights; a tied
+        # score counts as neutral AND still falls through to pos/neg.
+        a, b = np.triu_indices(len(idx), k=1)
+        diff = l[a] != l[b]
+        a, b = a[diff], b[diff]
+        w = (0.5 * (weight[idx][a] + weight[idx][b]) if weight is not None
+             else np.ones(len(a)))
+        tied = s[a] == s[b]
+        neu += float(w[tied].sum())
+        ordered = (s[a] - s[b]) * (l[a] - l[b]) > 0
+        pos += float(w[ordered].sum())
+        neg += float(w[~ordered].sum())
+    if op.input("AccumulatePositivePair"):
+        pos += float(np.asarray(
+            scope.find_var(op.input("AccumulatePositivePair")[0])))
+        neg += float(np.asarray(
+            scope.find_var(op.input("AccumulateNegativePair")[0])))
+        neu += float(np.asarray(
+            scope.find_var(op.input("AccumulateNeutralPair")[0])))
+    scope.set_var(op.output("PositivePair")[0], np.asarray([pos], np.float32))
+    scope.set_var(op.output("NegativePair")[0], np.asarray([neg], np.float32))
+    scope.set_var(op.output("NeutralPair")[0], np.asarray([neu], np.float32))

@@ -1,0 +1,643 @@
+"""Operator-tail lowerings: pairwise/ranking losses, image ops, RNN unit
+cells, interpolation, channel-affine ops, and batch-size-like random fills.
+
+Reference coverage: ``hinge_loss_op.cc``, ``log_loss_op.cc``,
+``rank_loss_op.cc``, ``margin_rank_loss_op.cc``, ``modified_huber_loss_op.h``,
+``squared_l2_distance_op.cc``, ``squared_l2_norm_op.cc``, ``l1_norm_op.cc``,
+``cos_sim_op.cc``, ``bilinear_tensor_product_op.cc``, ``minus_op.cc``,
+``label_smooth_op.h``, ``flatten_op.cc``, ``reverse_op.cc``, ``unstack_op.cc``,
+``crop_op.cc``, ``pad2d_op.cc``, ``pad_constant_like_op.cc``,
+``multiplex_op.cc``, ``argsort_op.cc``, ``prelu_op.cc``,
+``affine_channel_op.cc``, ``lrn_op.cc``, ``maxout_op.cc``,
+``pool_with_index_op.cc``, ``unpool_op.cc``, ``spp_op.cc``,
+``bilinear_interp_op.h``, ``roi_pool_op.cc``, ``gru_unit_op.h``,
+``lstm_unit_op.cc``, ``conv_shift_op.cc``, ``sampling_id_op.cc``,
+``uniform_random_batch_size_like_op.cc``,
+``gaussian_random_batch_size_like_op.cc``, ``is_empty_op.cc``,
+``random_crop_op.cc``.
+
+TPU mapping notes: everything here is shape-static XLA; data-dependent
+gather/scatter (unpool, roi_pool) uses one-hot matmuls or ``.at[]`` scatter
+(lowered to XLA scatter); random ops consume PRNG keys threaded through the
+block (functional replacement for cuRAND + per-op seed attrs).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register, register_grad
+from ..core.types import np_dtype
+from .tensor_ops import _seed_key
+
+
+# ---------------------------------------------------------------------------
+# pairwise / ranking / regression losses
+# ---------------------------------------------------------------------------
+
+@register("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    """hinge_loss_op.cc: loss = max(0, 1 - (2y-1) * pred), y in {0,1}."""
+    pred, label = ins["Logits"][0], ins["Labels"][0]
+    signs = 2.0 * label.astype(pred.dtype) - 1.0
+    return {"Loss": [jnp.maximum(0.0, 1.0 - signs * pred).astype(pred.dtype)]}
+
+
+@register("log_loss")
+def _log_loss(ctx, ins, attrs):
+    """log_loss_op.cc: -y*log(p+eps) - (1-y)*log(1-p+eps)."""
+    p, y = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)
+    return {"Loss": [loss.astype(p.dtype)]}
+
+
+@register("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    """rank_loss_op.cc (RankNet): C = -P*(o_l-o_r) + log(1+exp(o_l-o_r))."""
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    o = left - right
+    loss = jnp.logaddexp(0.0, o).astype(o.dtype) - label * o
+    return {"Out": [loss]}
+
+
+@register("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    """margin_rank_loss_op.cc: out = max(0, -label*(x1-x2) + margin);
+    Activated saves the >0 mask for the grad."""
+    label, x1, x2 = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    raw = -label * (x1 - x2) + margin
+    act = (raw > 0).astype(x1.dtype)
+    return {"Out": [jnp.maximum(raw, 0.0).astype(x1.dtype)],
+            "Activated": [act]}
+
+
+@register("modified_huber_loss")
+def _modified_huber_loss(ctx, ins, attrs):
+    """modified_huber_loss_op.h: z = x*(2y-1); loss = -4z if z<-1,
+    (1-z)^2 if -1<=z<1, else 0."""
+    x, y = ins["X"][0], ins["Y"][0]
+    z = x * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"IntermediateVal": [z.astype(x.dtype)],
+            "Out": [loss.astype(x.dtype)]}
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    """squared_l2_distance_op.cc: row-wise ||x - y||^2 (Y may broadcast
+    along the batch dim)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    out = jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim)), keepdims=True)
+    return {"sub_result": [sub], "Out": [out.reshape(x.shape[0], 1)]}
+
+
+@register("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(x * x).reshape(1)]}
+
+
+@register("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(jnp.abs(x)).reshape(1)]}
+
+
+@register("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    """cos_sim_op.cc: row-wise cosine similarity; Y may have batch 1
+    (broadcast)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    dot = jnp.sum(x * y, axis=-1, keepdims=True)
+    return {"Out": [dot / (xn * yn + 1e-12)], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """bilinear_tensor_product_op.cc: out_k = x^T W_k y (+ bias_k);
+    Weight [K, Dx, Dy]."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if "Bias" in ins and ins["Bias"]:
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    """label_smooth_op.h: (1-eps)*x + eps*prior (uniform 1/C default)."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    if "PriorDist" in ins and ins["PriorDist"]:
+        prior = ins["PriorDist"][0].reshape(1, -1)
+        out = (1.0 - eps) * x + eps * prior
+    else:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    return {"Out": [out.astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing ops
+# ---------------------------------------------------------------------------
+
+@register("flatten")
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return {"Out": [x.reshape(lead, -1)]}
+
+
+register("flatten2")(_flatten)  # reference flatten2 adds an XShape output
+
+
+@register("reverse")
+def _reverse(ctx, ins, attrs):
+    axes = attrs.get("axis", [0])
+    axes = [axes] if isinstance(axes, int) else list(axes)
+    return {"Out": [jnp.flip(ins["X"][0], axis=tuple(axes))]}
+
+
+@register("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    parts = jnp.split(x, n, axis=axis)
+    return {"Y": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+@register("crop")
+def _crop(ctx, ins, attrs):
+    """crop_op.cc: static offsets/shape crop (offsets attr; Y gives the
+    target shape when present)."""
+    x = ins["X"][0]
+    if "Y" in ins and ins["Y"]:
+        shape = ins["Y"][0].shape
+    else:
+        shape = attrs["shape"]
+    offsets = attrs.get("offsets", [0] * x.ndim)
+    return {"Out": [lax.dynamic_slice(x, [int(o) for o in offsets],
+                                      [int(s) for s in shape])]}
+
+
+@register("pad2d")
+def _pad2d(ctx, ins, attrs):
+    """pad2d_op.cc: constant/reflect/edge padding of the spatial dims."""
+    x = ins["X"][0]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("pad_value", 0.0)
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+    pads = [(0, 0), (0, 0), (0, 0), (0, 0)]
+    h, w = (1, 2) if nhwc else (2, 3)
+    pads[h] = (p[0], p[1])
+    pads[w] = (p[2], p[3])
+    jmode = {"constant": "constant", "reflect": "reflect", "edge": "edge"}[mode]
+    kw = {"constant_values": value} if mode == "constant" else {}
+    return {"Out": [jnp.pad(x, pads, mode=jmode, **kw)]}
+
+
+@register("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    """pad_constant_like_op.cc: pad Y up to X's shape with pad_value."""
+    x, y = ins["X"][0], ins["Y"][0]
+    value = attrs.get("pad_value", 0.0)
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=value)]}
+
+
+@register("multiplex", no_grad_slots=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    """multiplex_op.cc: out[i] = X[ids[i]][i] (row-wise candidate select)."""
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)  # [K, B, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": [stacked[ids, rows]]}
+
+
+@register("argsort", no_grad_slots=("X",))
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("is_empty", no_grad_slots=("X",))
+def _is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.asarray([x.size == 0])]}
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+
+@register("prelu")
+def _prelu(ctx, ins, attrs):
+    """prelu_op.cc: max(0,x) + alpha*min(0,x); alpha shared per mode
+    all/channel/element."""
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:  # element
+        a = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": [jnp.maximum(x, 0) + a * jnp.minimum(x, 0)]}
+
+
+@register("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    """affine_channel_op.cc: x*scale + bias per channel."""
+    x, scale, bias = ins["X"][0], ins["Scale"][0], ins["Bias"][0]
+    nhwc = attrs.get("data_layout", "NCHW") == "NHWC"
+    shape = ((1,) * (x.ndim - 1) + (-1,)) if nhwc else \
+        ((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register("lrn")
+def _lrn(ctx, ins, attrs):
+    """lrn_op.cc: out = x * (k + alpha*sum_{window n} x^2)^(-beta) across
+    channels (NCHW)."""
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = x * x
+    # window sum over channel dim via padded cumulative trick
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    mid = sum(padded[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * mid
+    return {"MidOut": [mid], "Out": [x * mid ** (-beta)]}
+
+
+@register("maxout")
+def _maxout(ctx, ins, attrs):
+    """maxout_op.cc: NCHW channels split into groups, max within group."""
+    x = ins["X"][0]
+    g = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, c // g, g, h, w).max(axis=2)]}
+
+
+@register("max_pool2d_with_index", no_grad_slots=("Mask",))
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """pool_with_index_op.cc: max pool + flat h*W+w argmax index per
+    window (index into the input feature map)."""
+    x = ins["X"][0]
+    ks = tuple(attrs["ksize"])
+    st = tuple(attrs.get("strides", [1, 1]))
+    pd = tuple(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ks, st, pd = x.shape[2:4], (1, 1), (0, 0)
+    n, c, h, w = x.shape
+    flat_idx = jnp.broadcast_to(
+        (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :]), x.shape
+    ).astype(jnp.float32)
+    neg = jnp.finfo(x.dtype).min
+
+    def select(acc, cur):
+        av, ai = acc
+        cv, ci = cur
+        take = cv > av
+        return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+
+    out, idx = lax.reduce_window(
+        (x, flat_idx), (jnp.asarray(neg, x.dtype), jnp.asarray(-1.0)),
+        lambda a, b: select(a, b),
+        (1, 1) + ks, (1, 1) + st,
+        ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+    return {"Out": [out], "Mask": [idx.astype(jnp.int64)]}
+
+
+@register_grad("max_pool2d_with_index")
+def _max_pool2d_with_index_grad(ctx, ins, attrs):
+    """Route dOut back through the saved argmax indices (scatter-add)."""
+    x = ins["X"][0]
+    mask = ins["Mask"][0].astype(jnp.int32)
+    dout = ins["Out@GRAD"][0]
+    n, c, h, w = x.shape
+    flat = jnp.zeros((n, c, h * w), dout.dtype)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        mask.reshape(n, c, -1),
+    ].add(dout.reshape(n, c, -1))
+    return {"X@GRAD": [flat.reshape(x.shape)]}
+
+
+@register("unpool", no_grad_slots=("Indices",))
+def _unpool(ctx, ins, attrs):
+    """unpool_op.cc: scatter pooled values back to the argmax positions."""
+    x, idx = ins["X"][0], ins["Indices"][0].astype(jnp.int32)
+    n, c, h, w = x.shape
+    oh, ow = attrs["unpooled_height"], attrs["unpooled_width"]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1),
+    ].add(x.reshape(n, c, -1))
+    return {"Out": [flat.reshape(n, c, oh, ow)]}
+
+
+@register("spp")
+def _spp(ctx, ins, attrs):
+    """spp_op.cc: spatial pyramid pooling — concat flattened pools at
+    1x1, 2x2, ... 2^(h-1) bins."""
+    x = ins["X"][0]
+    levels = attrs.get("pyramid_height", 1)
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lvl in range(levels):
+        bins = 2 ** lvl
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        if ptype == "max":
+            init = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+            pooled = lax.reduce_window(
+                x, init, lax.max, (1, 1, kh, kw), (1, 1, kh, kw),
+                ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                 (pw, kw * bins - w - pw)))
+        else:
+            pooled = lax.reduce_window(
+                x.astype(jnp.float32), 0.0, lax.add, (1, 1, kh, kw),
+                (1, 1, kh, kw),
+                ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                 (pw, kw * bins - w - pw))) / float(kh * kw)
+            pooled = pooled.astype(x.dtype)
+        outs.append(pooled.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    """bilinear_interp_op.h: NCHW bilinear resize with the reference's
+    (in-1)/(out-1) corner-aligned ratio."""
+    x = ins["X"][0]
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    n, c, h, w = x.shape
+    if (h, w) == (oh, ow):
+        return {"Out": [x]}
+    rh = (h - 1) / (oh - 1) if oh > 1 else 0.0
+    rw = (w - 1) / (ow - 1) if ow > 1 else 0.0
+    ys = jnp.arange(oh) * rh
+    xs = jnp.arange(ow) * rw
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0).astype(x.dtype)[None, None, :, None]
+    wx = (xs - x0).astype(x.dtype)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]
+    out = ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
+           + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register("roi_pool", no_grad_slots=("ROIs",))
+def _roi_pool(ctx, ins, attrs):
+    """roi_pool_op.cc: per-ROI max pooling to a fixed [ph, pw] grid.
+    ROIs [R, 4] (x1, y1, x2, y2) with a batch-id column convention of
+    RoisLod-free 2018 fluid: ROIs carries batch ids via lod; here the
+    padded redesign takes ROIs [R, 5] = (batch_id, x1, y1, x2, y2) or
+    [R, 4] with batch 0."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = attrs["pooled_height"]
+    pw = attrs["pooled_width"]
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    if rois.shape[-1] == 5:
+        batch_ids = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    else:
+        batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
+        boxes = rois
+
+    def pool_one(bid, box):
+        x1 = jnp.round(box[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        img = x[bid]  # [C, H, W]
+        hh = jnp.arange(h)
+        ww = jnp.arange(w)
+        inside_y = (hh >= y1) & (hh <= y2)
+        inside_x = (ww >= x1) & (ww <= x2)
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        masked = jnp.where(inside_y[None, :, None] & inside_x[None, None, :],
+                           img, neg)
+        # reference bin boundaries overlap: bin i spans
+        # [floor(i*r/p), ceil((i+1)*r/p)) relative to the ROI start
+        bins_h = jnp.arange(ph)
+        bins_w = jnp.arange(pw)
+        y_lo = y1 + jnp.floor(bins_h * rh / ph).astype(jnp.int32)
+        y_hi = y1 + jnp.ceil((bins_h + 1) * rh / ph).astype(jnp.int32)
+        x_lo = x1 + jnp.floor(bins_w * rw / pw).astype(jnp.int32)
+        x_hi = x1 + jnp.ceil((bins_w + 1) * rw / pw).astype(jnp.int32)
+        oh_y = ((hh[None, :] >= y_lo[:, None]) & (hh[None, :] < y_hi[:, None])
+                & inside_y[None, :])  # [ph, H]
+        oh_x = ((ww[None, :] >= x_lo[:, None]) & (ww[None, :] < x_hi[:, None])
+                & inside_x[None, :])  # [pw, W]
+        rowred = jnp.where(oh_y[None, :, :, None], masked[:, None, :, :],
+                           neg).max(axis=2)  # [C, ph, W]
+        binred = jnp.where(oh_x[None, None, :, :], rowred[:, :, None, :],
+                           neg).max(axis=3)  # [C, ph, pw]
+        return jnp.where(binred == neg, 0.0, binred).astype(x.dtype)
+
+    out = jax.vmap(pool_one)(batch_ids, boxes)
+    return {"Out": [out]}
+
+
+@register("random_crop", stateful=True, no_grad_slots=("X", "Seed"))
+def _random_crop(ctx, ins, attrs):
+    """random_crop_op.cc: crop `shape` at a uniform random offset (the
+    trailing dims); leading dims pass through."""
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    lead = x.ndim - len(shape)
+    key = _seed_key(ctx, attrs)
+    keys = jax.random.split(key, len(shape))
+    starts = [0] * lead + [
+        jax.random.randint(keys[i], (), 0, x.shape[lead + i] - shape[i] + 1)
+        for i in range(len(shape))]
+    out = lax.dynamic_slice(x, starts, list(x.shape[:lead]) + shape)
+    return {"Out": [out], "SeedOut": [ins.get("Seed", [jnp.zeros(1)])[0]]}
+
+
+# ---------------------------------------------------------------------------
+# RNN unit cells
+# ---------------------------------------------------------------------------
+
+_GRU_ACTS = {0: lambda v: v, 1: jax.nn.sigmoid, 2: jnp.tanh,
+             3: jax.nn.relu}
+
+
+@register("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """gru_unit_op.h: gates = X + h_prev @ W[:, :2D] (u, r);
+    c = act(xc + (r*h_prev) @ W[:, 2D:]); h = u*(c - h_prev) + h_prev."""
+    x, hp, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
+    d = hp.shape[-1]
+    gact = _GRU_ACTS[attrs.get("gate_activation", 1)]
+    cact = _GRU_ACTS[attrs.get("activation", 2)]
+    gates = x
+    if "Bias" in ins and ins["Bias"]:
+        gates = gates + ins["Bias"][0].reshape(1, -1)
+    ur = gates[:, :2 * d] + hp @ w[:, :2 * d]
+    ur = gact(ur)
+    u, r = ur[:, :d], ur[:, d:]
+    rhp = r * hp
+    c = cact(gates[:, 2 * d:] + rhp @ w[:, 2 * d:].reshape(d, d))
+    h = u * (c - hp) + hp
+    return {"Gate": [jnp.concatenate([ur, c], axis=1)],
+            "ResetHiddenPrev": [rhp], "Hidden": [h]}
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """lstm_unit_op.cc: i,f,o,j = split(X); C = C_prev*sig(f+fb) +
+    sig(i)*tanh(j); H = C*sig(o)."""
+    x, cp = ins["X"][0], ins["C_prev"][0]
+    fb = attrs.get("forget_bias", 0.0)
+    i, f, o, j = jnp.split(x, 4, axis=-1)
+    c = cp * jax.nn.sigmoid(f + fb) + jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = c * jax.nn.sigmoid(o)
+    return {"C": [c], "H": [h]}
+
+
+@register("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """conv_shift_op.cc: circular row convolution
+    out[i] = sum_j x[(i+j) mod M] * y[j], j centered on 0 (NTM shift)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    m, n = x.shape[1], y.shape[1]
+    half = (n - 1) // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, n - half)[None, :]) % m
+    # [B, M, N] gather then contract against y
+    gathered = x[:, idx]  # [B, M, N]
+    return {"Out": [jnp.einsum("bmn,bn->bm", gathered, y)]}
+
+
+# ---------------------------------------------------------------------------
+# sampling / random
+# ---------------------------------------------------------------------------
+
+@register("sampling_id", stateful=True, no_grad_slots=("X",))
+def _sampling_id(ctx, ins, attrs):
+    """sampling_id_op.cc: sample one category per row of a probability
+    matrix."""
+    x = ins["X"][0]
+    key = _seed_key(ctx, attrs)
+    ids = jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register("uniform_random_batch_size_like", stateful=True,
+          no_grad_slots=("Input",))
+def _uniform_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    dt = np_dtype(attrs.get("dtype", 5))
+    u = jax.random.uniform(
+        _seed_key(ctx, attrs), tuple(shape),
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0))
+    return {"Out": [u.astype(dt)]}
+
+
+@register("gaussian_random_batch_size_like", stateful=True,
+          no_grad_slots=("Input",))
+def _gaussian_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    dt = np_dtype(attrs.get("dtype", 5))
+    g = jax.random.normal(_seed_key(ctx, attrs), tuple(shape))
+    return {"Out": [(g * attrs.get("std", 1.0)
+                     + attrs.get("mean", 0.0)).astype(dt)]}
+
+
+# ---------------------------------------------------------------------------
+# candidate-sampling classifiers
+# ---------------------------------------------------------------------------
+
+@register("nce", stateful=True, no_grad_slots=("Label", "SampleWeight"))
+def _nce(ctx, ins, attrs):
+    """nce_op.h: noise-contrastive estimation with a uniform noise
+    distribution.  o = sigmoid(logit), b = num_neg/V;
+    cost = -log(o/(o+b)) for true classes, -log(b/(o+b)) for sampled."""
+    x = ins["Input"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    w = ins["Weight"][0]
+    V = attrs["num_total_classes"]
+    k = attrs.get("num_neg_samples", 10)
+    B = x.shape[0]
+    num_true = label.shape[1] if label.ndim > 1 else 1
+    label = label.reshape(B, num_true)
+    neg = jax.random.randint(_seed_key(ctx, attrs), (B, k), 0, V)
+    samples = jnp.concatenate([label, neg], axis=1)  # [B, num_true+k]
+    logits = jnp.einsum("bd,bsd->bs", x, w[samples])
+    if "Bias" in ins and ins["Bias"]:
+        logits = logits + ins["Bias"][0][samples]
+    o = jax.nn.sigmoid(logits)
+    b = k / float(V)
+    cost_true = -jnp.log(o[:, :num_true] / (o[:, :num_true] + b) + 1e-20)
+    cost_neg = -jnp.log(b / (o[:, num_true:] + b) + 1e-20)
+    cost = cost_true.sum(axis=1) + cost_neg.sum(axis=1)
+    if "SampleWeight" in ins and ins["SampleWeight"]:
+        cost = cost * ins["SampleWeight"][0].reshape(-1)
+    return {"Cost": [cost.reshape(B, 1).astype(x.dtype)],
+            "SampleLogits": [o], "SampleLabels": [samples.astype(jnp.int64)]}
+
+
+@register("hierarchical_sigmoid", no_grad_slots=("Label",))
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """hierarchical_sigmoid_op.h + math/matrix_bit_code.h: complete binary
+    tree over classes; per-sample loss sums sigmoid cross-entropies along
+    the leaf's root path.  SimpleCode: c = label + num_classes,
+    index(b) = (c >> (b+1)) - 1, bit(b) = (c >> b) & 1,
+    length = floor(log2(c))."""
+    x = ins["X"][0]
+    w = ins["W"][0]  # [num_classes - 1, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    V = attrs["num_classes"]
+    L = max(int(np.ceil(np.log2(V))) + 1, 1)  # static max code length
+    c = label + V  # [B]
+    bits = jnp.arange(L)
+    lengths = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+    valid = bits[None, :] < lengths[:, None]  # [B, L]
+    idx = jnp.where(valid, (c[:, None] >> (bits[None, :] + 1)) - 1, 0)
+    bit = jnp.where(valid, (c[:, None] >> bits[None, :]) & 1, 0)
+    pre = jnp.einsum("bd,bld->bl", x, w[idx])
+    if "Bias" in ins and ins["Bias"]:
+        pre = pre + ins["Bias"][0].reshape(-1)[idx]
+    # loss_b = softplus(pre) - bit*pre summed over valid path bits
+    per_bit = jnp.logaddexp(0.0, pre) - bit.astype(pre.dtype) * pre
+    loss = jnp.sum(jnp.where(valid, per_bit, 0.0), axis=1)
+    return {"Out": [loss.reshape(-1, 1).astype(x.dtype)],
+            "PreOut": [pre.astype(x.dtype)]}

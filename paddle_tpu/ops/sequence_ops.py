@@ -293,3 +293,75 @@ def _row_conv(ctx, ins, attrs):
     if seq_len is not None:
         out = out * _mask(out, seq_len).astype(out.dtype)
     return {"Out": [out]}
+
+
+@register("sequence_mask", no_grad_slots=("X",))
+def _sequence_mask(ctx, ins, attrs):
+    """sequence_mask_op.cc: X holds lengths; out[..., j] = j < X[...]."""
+    x = ins["X"][0]
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError(
+            "sequence_mask requires a static maxlen on TPU (dynamic "
+            "max-length would make the output shape data-dependent)")
+    from ..core.types import np_dtype
+    dt = np_dtype(attrs.get("out_dtype", 5))
+    mask = jnp.arange(maxlen)[None, :] < x.reshape(-1, 1)
+    return {"Y": [mask.reshape(tuple(x.shape) + (maxlen,)).astype(dt)]}
+
+
+@register("im2sequence", no_grad_slots=("SeqLen",))
+def _im2sequence(ctx, ins, attrs):
+    """im2sequence_op.cc redesigned for the padded contract: NCHW image ->
+    [B, oh*ow, C*kh*kw] patch sequence (+ constant per-sample length)."""
+    x = ins["X"][0]
+    kh, kw = attrs["kernels"]
+    st = attrs.get("strides", [1, 1])
+    pd = attrs.get("paddings", [0, 0, 0, 0])  # up, left, down, right
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(st),
+        [(pd[0], pd[2]), (pd[1], pd[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, oh, ow]
+    oh, ow = patches.shape[2], patches.shape[3]
+    seq = patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)
+    lens = jnp.full((n,), oh * ow, jnp.int64)
+    return {"Out": [seq], "OutLen": [lens]}
+
+
+@register("sequence_scatter", no_grad_slots=("Ids", "SeqLen"))
+def _sequence_scatter(ctx, ins, attrs):
+    """sequence_scatter_op.cc: out = X; out[i, ids[i, j]] += updates[i, j]
+    for valid j (per-sequence scatter-add of updates into row i)."""
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    B, T = ids.shape[0], ids.shape[1]
+    if seq_len is not None:
+        valid = jnp.arange(T)[None, :] < seq_len[:, None]
+    else:
+        valid = jnp.ones((B, T), bool)
+    upd = jnp.where(valid.reshape(valid.shape + (1,) * (upd.ndim - 2)),
+                    upd, 0).astype(x.dtype)
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    return {"Out": [x.at[rows, ids].add(upd)]}
+
+
+@register("lod_reset", no_grad_slots=("Y", "TargetLenTensor"))
+def _lod_reset(ctx, ins, attrs):
+    """lod_reset_op.cc on the padded contract: data passes through; the new
+    length vector comes from Y's lengths (or the target_lod attr via the
+    layer).  The layer wires the returned OutLen as Out@LEN."""
+    x = ins["X"][0]
+    if ins.get("TargetLenTensor"):
+        new_len = ins["TargetLenTensor"][0]
+    elif ins.get("Y"):
+        new_len = ins["Y"][0]
+    else:
+        tl = attrs.get("target_lod", [])
+        # offsets -> lengths (reference target_lod is offset-style)
+        new_len = jnp.asarray(
+            [tl[i + 1] - tl[i] for i in range(len(tl) - 1)], jnp.int64)
+    return {"Out": [x], "OutLen": [new_len.astype(jnp.int64)]}

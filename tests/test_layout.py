@@ -1,0 +1,109 @@
+"""NHWC data-layout path: conv/pool/bn lowerings and the space-to-depth
+stem must match the NCHW reference path exactly (modulo fp reassociation).
+
+The NHWC path is the TPU-preferred layout (channels on the 128-lane minor
+dimension); reference analogue: the ``data_layout``/``data_format`` attr of
+``conv_op.cc`` / ``pool_op.cc`` / ``batch_norm_op.cc``.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+
+def _run_conv(layout, x, k=3, stride=1, pad=1, cin=8, cout=16, seed=3):
+    prog, startup = Program(), Program()
+    prog.random_seed = seed
+    with program_guard(prog, startup), unique_name.guard():
+        shape = list(x.shape[1:])
+        data = fluid.layers.data("x", shape, dtype="float32")
+        out = fluid.layers.conv2d(data, cout, k, stride, pad,
+                                  bias_attr=False, data_layout=layout)
+        pooled = fluid.layers.pool2d(out, 2, "max", 2, data_layout=layout)
+        normed = fluid.layers.batch_norm(pooled, data_layout=layout)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        res, = exe.run(prog, feed={"x": x}, fetch_list=[normed.name])
+    return np.asarray(res)
+
+
+def test_conv_pool_bn_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 8, 12, 12).astype("float32")
+    ref = _run_conv("NCHW", x)
+    got = _run_conv("NHWC", x.transpose(0, 2, 3, 1))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_space_to_depth_stem_exact():
+    # 7x7/s2/p3 on 3 channels, even spatial dims: the s2d rewrite triggers.
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 32, 32).astype("float32")
+    ref = _run_conv("NCHW", x, k=7, stride=2, pad=3, cin=3, cout=16)
+    got = _run_conv("NHWC", x.transpose(0, 2, 3, 1), k=7, stride=2, pad=3,
+                    cin=3, cout=16)
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_space_to_depth_stem_grads_match():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def direct(x, w):
+        return lax.conv_general_dilated(
+            x, w, (2, 2), [(3, 3), (3, 3)],
+            dimension_numbers=("NHWC", "OIHW", "NHWC"))
+
+    from paddle_tpu.ops.nn_ops import _conv2d
+
+    class Ctx:
+        training = True
+
+    def s2d(x, w):
+        return _conv2d(Ctx(), {"Input": [x], "Filter": [w]},
+                       {"strides": [2, 2], "paddings": [3, 3],
+                        "data_layout": "NHWC"})["Output"][0]
+
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (2, 16, 16, 3), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (5, 3, 7, 7), jnp.float32)
+    np.testing.assert_allclose(np.asarray(direct(x, w)),
+                               np.asarray(s2d(x, w)), rtol=1e-4, atol=1e-4)
+    f1 = lambda x, w: (direct(x, w) ** 2).sum()
+    f2 = lambda x, w: (s2d(x, w) ** 2).sum()
+    g1x, g1w = jax.grad(f1, (0, 1))(x, w)
+    g2x, g2w = jax.grad(f2, (0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(g1x), np.asarray(g2x),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(g1w), np.asarray(g2w),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_resnet_nhwc_first_step_parity():
+    from paddle_tpu.models import resnet
+
+    def run(layout):
+        prog, startup = Program(), Program()
+        prog.random_seed = 7
+        with program_guard(prog, startup), unique_name.guard():
+            feeds, loss, acc = resnet.build(
+                class_dim=10, image_shape=(3, 16, 16), depth=18, lr=0.01,
+                layout=layout)
+        rng = np.random.RandomState(0)
+        feed = {"data": rng.randn(4, 3, 16, 16).astype("float32"),
+                "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+        scope, exe = Scope(), Executor()
+        with scope_guard(scope):
+            exe.run(startup)
+            out, = exe.run(prog, feed=feed, fetch_list=[loss.name])
+        return float(out)
+
+    a, b = run("NCHW"), run("NHWC")
+    assert abs(a - b) < 1e-4 * max(1.0, abs(a)), (a, b)

@@ -1,0 +1,733 @@
+"""Layer DSL tail: the remaining reference ``layers/nn.py`` ``__all__``
+surface (reference python/paddle/fluid/layers/nn.py — losses, image ops,
+RNN unit cells, candidate-sampling classifiers, random layers).
+
+Split from ``nn.py`` only for file size; ``layers/__init__`` re-exports
+both, so ``fluid.layers.<fn>`` matches the reference API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..initializer import ConstantInitializer, NormalInitializer
+from ..layer_helper import LayerHelper
+from .nn import _pair, seq_len_var, _alias_len, _seq_op_with_len
+
+__all__ = [
+    "cos_sim", "hinge_loss", "log_loss", "rank_loss", "margin_rank_loss",
+    "modified_huber_loss", "squared_l2_distance", "squared_l2_norm",
+    "l1_norm", "bilinear_tensor_product", "minus", "label_smooth",
+    "smooth_l1", "dice_loss", "flatten", "reverse", "unstack", "crop",
+    "pad", "pad2d", "pad_constant_like", "multiplex", "argsort", "shape",
+    "scatter", "sequence_scatter", "sequence_mask", "lod_reset",
+    "im2sequence", "prelu", "affine_channel", "lrn", "maxout",
+    "bilinear_interp", "image_resize", "image_resize_short",
+    "resize_bilinear", "roi_pool", "random_crop", "mean_iou", "chunk_eval",
+    "gru_unit", "lstm_unit", "dynamic_lstmp", "conv3d", "pool3d",
+    "conv3d_transpose", "nce", "hsigmoid", "sampling_id", "gaussian_random",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+]
+
+
+def _simple(op_type, ins, attrs=None, out_shape=None, out_dtype=None,
+            out_slot="Out", extra_outs=(), name=None, ref=None):
+    """Append one op whose main output mirrors the first input."""
+    helper = LayerHelper(op_type, name=name)
+    ref = ref if ref is not None else next(iter(ins.values()))[0]
+    out = helper.create_variable_for_type_inference(
+        out_dtype or ref.dtype, shape=out_shape or ref.shape)
+    outs = {out_slot: [out]}
+    for slot, shape, dtype in extra_outs:
+        outs[slot] = [helper.create_variable_for_type_inference(
+            dtype or ref.dtype, shape=shape or ref.shape)]
+    helper.append_op(op_type, ins, outs, attrs or {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def cos_sim(X, Y, name=None):
+    return _simple("cos_sim", {"X": [X], "Y": [Y]},
+                   out_shape=(X.shape[0], 1),
+                   extra_outs=[("XNorm", (X.shape[0], 1), None),
+                               ("YNorm", (Y.shape[0], 1), None)], name=name)
+
+
+def hinge_loss(input, label, name=None):
+    return _simple("hinge_loss", {"Logits": [input], "Labels": [label]},
+                   out_slot="Loss", name=name)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _simple("log_loss", {"Predicted": [input], "Labels": [label]},
+                   {"epsilon": epsilon}, out_slot="Loss", name=name)
+
+
+def rank_loss(label, left, right, name=None):
+    return _simple("rank_loss",
+                   {"Label": [label], "Left": [left], "Right": [right]},
+                   ref=left, name=name)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return _simple("margin_rank_loss",
+                   {"Label": [label], "X1": [left], "X2": [right]},
+                   {"margin": margin}, ref=left,
+                   extra_outs=[("Activated", left.shape, None)], name=name)
+
+
+def modified_huber_loss(input, label, name=None):
+    return _simple("modified_huber_loss", {"X": [input], "Y": [label]},
+                   extra_outs=[("IntermediateVal", input.shape, None)],
+                   name=name)
+
+
+def squared_l2_distance(x, y, name=None):
+    return _simple("squared_l2_distance", {"X": [x], "Y": [y]},
+                   out_shape=(x.shape[0], 1),
+                   extra_outs=[("sub_result", x.shape, None)], name=name)
+
+
+def squared_l2_norm(x, name=None):
+    return _simple("squared_l2_norm", {"X": [x]}, out_shape=(1,), name=name)
+
+
+def l1_norm(x, name=None):
+    return _simple("l1_norm", {"X": [x]}, out_shape=(1,), name=name)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", bias_attr=bias_attr,
+                         act=act, name=name)
+    w = helper.create_parameter(
+        param_attr, [size, int(x.shape[1]), int(y.shape[1])], x.dtype)
+    ins = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [1, size], x.dtype,
+                                    is_bias=True)
+        ins["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(
+        x.dtype, shape=(x.shape[0], size))
+    helper.append_op("bilinear_tensor_product", ins, {"Out": [out]}, {})
+    return helper.append_activation(out)
+
+
+def minus(x, y, name=None):
+    return _simple("minus", {"X": [x], "Y": [y]}, name=name)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    ins = {"X": [label]}
+    if prior_dist is not None:
+        ins["PriorDist"] = [prior_dist]
+    return _simple("label_smooth", ins, {"epsilon": float(epsilon)},
+                   name=name)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None,
+              name=None):
+    ins = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        ins["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        ins["OutsideWeight"] = [outside_weight]
+    return _simple("smooth_l1_loss", ins,
+                   {"sigma": sigma if sigma is not None else 1.0},
+                   out_shape=(x.shape[0], 1),
+                   extra_outs=[("Diff", x.shape, None)], name=name)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Reference nn.py dice_loss: pure composition over existing layers."""
+    from . import nn as _nn
+    from .ops import square  # generated activation wrappers
+
+    label = _nn.one_hot(label, depth=input.shape[-1]) \
+        if label.dtype != input.dtype and int(label.shape[-1]) == 1 \
+        else label
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = _nn.reduce_sum(_nn.elementwise_mul(input, label), dim=reduce_dims)
+    dice_denominator = _nn.elementwise_add(
+        _nn.reduce_sum(input, dim=reduce_dims),
+        _nn.reduce_sum(label, dim=reduce_dims))
+    dice_score = _nn.scale(
+        _nn.elementwise_div(
+            _nn.scale(inse, scale=2.0),
+            _nn.scale(dice_denominator, scale=1.0, bias=epsilon)),
+        scale=-1.0, bias=1.0)
+    return _nn.reduce_mean(dice_score)
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing
+# ---------------------------------------------------------------------------
+
+def flatten(x, axis=1, name=None):
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    tail = int(np.prod(x.shape[axis:]))
+    return _simple("flatten", {"X": [x]}, {"axis": axis},
+                   out_shape=(lead, tail), name=name)
+
+
+def reverse(x, axis, name=None):
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return _simple("reverse", {"X": [x]}, {"axis": axis}, name=name)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    helper = LayerHelper("unstack", name=name)
+    num = num if num is not None else x.shape[axis]
+    shape = tuple(s for i, s in enumerate(x.shape) if i != axis % len(x.shape))
+    outs = [helper.create_variable_for_type_inference(x.dtype, shape=shape)
+            for _ in range(num)]
+    helper.append_op("unstack", {"X": [x]}, {"Y": outs}, {"axis": axis,
+                                                          "num": num})
+    return outs
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    if shape is None:
+        raise ValueError("crop requires shape (a list/tuple or a Variable "
+                         "whose shape is the crop target)")
+    ins = {"X": [x]}
+    attrs = {}
+    if shape is not None and not isinstance(shape, (list, tuple)):
+        ins["Y"] = [shape]
+        out_shape = shape.shape
+    else:
+        attrs["shape"] = list(shape)
+        out_shape = tuple(shape)
+    attrs["offsets"] = list(offsets) if offsets is not None \
+        else [0] * len(x.shape)
+    return _simple("crop", ins, attrs, out_shape=out_shape, name=name)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    shape = tuple(s + paddings[2 * i] + paddings[2 * i + 1]
+                  for i, s in enumerate(x.shape))
+    return _simple("pad", {"X": [x]},
+                   {"paddings": list(paddings), "pad_value": pad_value},
+                   out_shape=shape, name=name)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    p = list(paddings)
+    if data_format == "NCHW":
+        shape = (input.shape[0], input.shape[1],
+                 input.shape[2] + p[0] + p[1], input.shape[3] + p[2] + p[3])
+    else:
+        shape = (input.shape[0], input.shape[1] + p[0] + p[1],
+                 input.shape[2] + p[2] + p[3], input.shape[3])
+    return _simple("pad2d", {"X": [input]},
+                   {"paddings": p, "mode": mode, "pad_value": pad_value,
+                    "data_format": data_format}, out_shape=shape, name=name)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _simple("pad_constant_like", {"X": [x], "Y": [y]},
+                   {"pad_value": pad_value}, out_shape=x.shape, ref=y,
+                   name=name)
+
+
+def multiplex(inputs, index, name=None):
+    return _simple("multiplex", {"X": list(inputs), "Ids": [index]},
+                   ref=inputs[0], name=name)
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    shape=input.shape)
+    ids = helper.create_variable_for_type_inference("int64",
+                                                    shape=input.shape,
+                                                    stop_gradient=True)
+    helper.append_op("argsort", {"X": [input]},
+                     {"Out": [out], "Indices": [ids]}, {"axis": axis})
+    return out, ids
+
+
+def shape(input, name=None):
+    return _simple("shape", {"Input": [input]},
+                   out_shape=(len(input.shape),), out_dtype="int64",
+                   name=name)
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    return _simple("scatter",
+                   {"X": [input], "Ids": [index], "Updates": [updates]},
+                   {"overwrite": overwrite}, name=name)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    sl = seq_len_var(index)
+    if sl is not None:
+        ins["SeqLen"] = [sl]
+    return _simple("sequence_scatter", ins, name=name)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        raise ValueError(
+            "sequence_mask on TPU needs a static maxlen (a dynamic "
+            "max-length would make the output shape data-dependent)")
+    return _simple("sequence_mask", {"X": [x]},
+                   {"maxlen": int(maxlen), "out_dtype": dtype},
+                   out_shape=tuple(x.shape) + (int(maxlen),),
+                   out_dtype=dtype, out_slot="Y", name=name)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Reference nn.py lod_reset on the padded contract: data unchanged,
+    the @LEN companion becomes y's lengths / the target lengths."""
+    helper = LayerHelper("lod_reset")
+    ins = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        sl = seq_len_var(y)
+        if sl is not None:
+            ins["TargetLenTensor"] = [sl]
+        else:
+            ins["Y"] = [y]
+    elif target_lod is not None:
+        attrs["target_lod"] = list(target_lod)
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    new_len = helper.create_variable_for_type_inference(
+        "int64", shape=(x.shape[0],), stop_gradient=True)
+    helper.append_op("lod_reset", ins, {"Out": [out], "OutLen": [new_len]},
+                     attrs)
+    _alias_len(out, new_len)
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    fs, st = _pair(filter_size), _pair(stride)
+    pd = list(padding) if isinstance(padding, (list, tuple)) \
+        else [padding] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    n, c, h, w = input.shape
+    oh = (h + pd[0] + pd[2] - fs[0]) // st[0] + 1
+    ow = (w + pd[1] + pd[3] - fs[1]) // st[1] + 1
+    return _seq_op_with_len(
+        "im2sequence", input, {}, {"kernels": list(fs), "strides": list(st),
+                                   "paddings": pd},
+        (n, oh * ow, c * fs[0] * fs[1]), input.dtype)
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [int(x.shape[1])]
+    else:
+        alpha_shape = [int(np.prod(x.shape[1:]))]
+    alpha = helper.create_parameter(
+        param_attr, alpha_shape, x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op("prelu", {"X": [x], "Alpha": [alpha]}, {"Out": [out]},
+                     {"mode": mode})
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    return _simple("affine_channel",
+                   {"X": [x], "Scale": [scale], "Bias": [bias]},
+                   {"data_layout": data_layout}, name=name)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    return _simple("lrn", {"X": [input]},
+                   {"n": n, "k": k, "alpha": alpha, "beta": beta},
+                   extra_outs=[("MidOut", input.shape, None)], name=name)
+
+
+def maxout(x, groups, name=None):
+    n, c, h, w = x.shape
+    return _simple("maxout", {"X": [x]}, {"groups": groups},
+                   out_shape=(n, c // groups, h, w), name=name)
+
+
+def bilinear_interp(input, out_h, out_w, name=None):
+    n, c = input.shape[0], input.shape[1]
+    return _simple("bilinear_interp", {"X": [input]},
+                   {"out_h": int(out_h), "out_w": int(out_w)},
+                   out_shape=(n, c, int(out_h), int(out_w)), name=name)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR"):
+    if resample != "BILINEAR":
+        raise ValueError("image_resize supports BILINEAR (reference parity)")
+    if out_shape is not None:
+        oh, ow = int(out_shape[0]), int(out_shape[1])
+    else:
+        oh = int(input.shape[2] * scale)
+        ow = int(input.shape[3] * scale)
+    return bilinear_interp(input, oh, ow, name=name)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, name, "BILINEAR")
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    oh = int(round(h * out_short_len / short))
+    ow = int(round(w * out_short_len / short))
+    return image_resize(input, [oh, ow], resample=resample)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    n_rois = rois.shape[0]
+    c = input.shape[1]
+    return _simple("roi_pool", {"X": [input], "ROIs": [rois]},
+                   {"pooled_height": pooled_height,
+                    "pooled_width": pooled_width,
+                    "spatial_scale": spatial_scale},
+                   out_shape=(n_rois, c, pooled_height, pooled_width))
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop")
+    lead = len(x.shape) - len(shape)
+    out_shape = tuple(x.shape[:lead]) + tuple(shape)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=out_shape)
+    seed_out = helper.create_variable_for_type_inference(
+        "int64", shape=(1,), stop_gradient=True)
+    helper.append_op("random_crop", {"X": [x]},
+                     {"Out": [out], "SeedOut": [seed_out]},
+                     {"shape": list(shape), "seed": seed or 0})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32", shape=())
+    wrong = helper.create_variable_for_type_inference(
+        "int32", shape=(num_classes,))
+    correct = helper.create_variable_for_type_inference(
+        "int32", shape=(num_classes,))
+    helper.append_op("mean_iou",
+                     {"Predictions": [input], "Labels": [label]},
+                     {"OutMeanIou": [miou], "OutWrong": [wrong],
+                      "OutCorrect": [correct]},
+                     {"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    helper = LayerHelper("chunk_eval")
+    mk = lambda dt, sh: helper.create_variable_for_type_inference(
+        dt, shape=sh, stop_gradient=True)
+    precision, recall, f1 = mk("float32", (1,)), mk("float32", (1,)), \
+        mk("float32", (1,))
+    n_inf, n_lab, n_cor = mk("int64", (1,)), mk("int64", (1,)), \
+        mk("int64", (1,))
+    ins = {"Inference": [input], "Label": [label]}
+    sl = seq_len_var(input) or seq_len_var(label)
+    if sl is not None:
+        ins["SeqLen"] = [sl]
+    helper.append_op(
+        "chunk_eval", ins,
+        {"Precision": [precision], "Recall": [recall], "F1-Score": [f1],
+         "NumInferChunks": [n_inf], "NumLabelChunks": [n_lab],
+         "NumCorrectChunks": [n_cor]},
+        {"chunk_scheme": chunk_scheme, "num_chunk_types": num_chunk_types,
+         "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+# ---------------------------------------------------------------------------
+# RNN unit cells
+# ---------------------------------------------------------------------------
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """Reference nn.py gru_unit: size = 3*hidden_dim; returns
+    (hidden, reset_hidden_prev, gate)."""
+    helper = LayerHelper("gru_unit")
+    act_ids = {"identity": 0, "sigmoid": 1, "tanh": 2, "relu": 3}
+    d = size // 3
+    w = helper.create_parameter(param_attr, [d, 3 * d], input.dtype)
+    ins = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [1, 3 * d], input.dtype,
+                                    is_bias=True)
+        ins["Bias"] = [b]
+    B = input.shape[0]
+    gate = helper.create_variable_for_type_inference(input.dtype,
+                                                     shape=(B, 3 * d))
+    rhp = helper.create_variable_for_type_inference(input.dtype,
+                                                    shape=(B, d))
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    shape=(B, d))
+    helper.append_op(
+        "gru_unit", ins,
+        {"Gate": [gate], "ResetHiddenPrev": [rhp], "Hidden": [out]},
+        {"activation": act_ids[activation],
+         "gate_activation": act_ids[gate_activation]})
+    return out, rhp, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Reference nn.py lstm_unit: fc([x_t, h_prev]) -> 4H gates -> cell
+    step (composition + the lstm_unit op)."""
+    from . import nn as _nn
+
+    helper = LayerHelper("lstm_unit", name=name)
+    size = int(cell_t_prev.shape[1])
+    concat = _nn.concat([x_t, hidden_t_prev], axis=1)
+    fc_out = _nn.fc(concat, 4 * size, param_attr=param_attr,
+                    bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype,
+                                                  shape=cell_t_prev.shape)
+    h = helper.create_variable_for_type_inference(x_t.dtype,
+                                                  shape=cell_t_prev.shape)
+    helper.append_op("lstm_unit",
+                     {"X": [fc_out], "C_prev": [cell_t_prev]},
+                     {"C": [c], "H": [h]}, {"forget_bias": forget_bias})
+    return h, c
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="identity",
+                  dtype="float32", name=None):
+    """Reference nn.py dynamic_lstmp (lstmp_op): LSTM with recurrent
+    projection.  ``input`` is the [B,T,4H] x-projection (as with
+    dynamic_lstm); returns (projection [B,T,P], cell [B,T,H])."""
+    if use_peepholes:
+        raise ValueError(
+            "dynamic_lstmp: peephole connections are not ported (the "
+            "reference book configs use use_peepholes=False)")
+    helper = LayerHelper("lstmp", name=name)
+    H = size // 4
+    w = helper.create_parameter(param_attr, [proj_size, 4 * H], dtype)
+    wproj = helper.create_parameter(param_attr, [H, proj_size], dtype)
+    bias = helper.create_parameter(bias_attr, [1, 4 * H], dtype,
+                                   is_bias=True)
+    from . import nn as _nn
+    gates = _nn.elementwise_add(input, bias)
+    B, T = input.shape[0], input.shape[1]
+    proj = helper.create_variable_for_type_inference(dtype,
+                                                     shape=(B, T, proj_size))
+    cell = helper.create_variable_for_type_inference(dtype, shape=(B, T, H))
+    last_h = helper.create_variable_for_type_inference(dtype,
+                                                       shape=(B, proj_size))
+    last_c = helper.create_variable_for_type_inference(dtype, shape=(B, H))
+    ins = {"Input": [gates], "Weight": [w], "ProjWeight": [wproj]}
+    sl = seq_len_var(input)
+    if sl is not None:
+        ins["SeqLen"] = [sl]
+    helper.append_op(
+        "lstmp", ins,
+        {"Projection": [proj], "Cell": [cell], "LastH": [last_h],
+         "LastC": [last_c]},
+        {"is_reverse": is_reverse, "proj_activation": proj_activation})
+    if sl is not None:
+        _alias_len(proj, sl)
+        _alias_len(cell, sl)
+    return proj, cell
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv family
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", bias_attr=bias_attr, act=act, name=name)
+    groups = groups or 1
+    fs, st = _triple(filter_size), _triple(stride)
+    pd, dl = _triple(padding), _triple(dilation)
+    c = input.shape[1]
+    std = (2.0 / (np.prod(fs) * c)) ** 0.5
+    w = helper.create_parameter(
+        param_attr, [num_filters, c // groups] + list(fs), input.dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    dims = [(input.shape[2 + i] + 2 * pd[i] - (dl[i] * (fs[i] - 1) + 1))
+            // st[i] + 1 for i in range(3)]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(input.shape[0], num_filters) + tuple(dims))
+    helper.append_op("conv3d", {"Input": [input], "Filter": [w]},
+                     {"Output": [out]},
+                     {"strides": list(st), "paddings": list(pd),
+                      "dilations": list(dl), "groups": groups})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    ks, st = _triple(pool_size), _triple(pool_stride)
+    pd = _triple(pool_padding)
+    if global_pooling:
+        dims = (1, 1, 1)
+    else:
+        dims = tuple((input.shape[2 + i] + 2 * pd[i] - ks[i]) // st[i] + 1
+                     for i in range(3))
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(input.shape[0], input.shape[1]) + dims)
+    helper.append_op("pool3d", {"X": [input]}, {"Out": [out]},
+                     {"pooling_type": pool_type, "ksize": list(ks),
+                      "strides": list(st), "paddings": list(pd),
+                      "global_pooling": global_pooling,
+                      "exclusive": exclusive})
+    return out
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=None, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", bias_attr=bias_attr, act=act,
+                         name=name)
+    groups = groups or 1
+    fs, st = _triple(filter_size), _triple(stride)
+    pd, dl = _triple(padding), _triple(dilation)
+    c = input.shape[1]
+    w = helper.create_parameter(
+        param_attr, [c, num_filters // groups] + list(fs), input.dtype)
+    dims = [(input.shape[2 + i] - 1) * st[i] - 2 * pd[i]
+            + dl[i] * (fs[i] - 1) + 1 for i in range(3)]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(input.shape[0], num_filters) + tuple(dims))
+    helper.append_op("conv3d_transpose",
+                     {"Input": [input], "Filter": [w]}, {"Output": [out]},
+                     {"strides": list(st), "paddings": list(pd),
+                      "dilations": list(dl), "groups": groups})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+# ---------------------------------------------------------------------------
+# candidate sampling / random
+# ---------------------------------------------------------------------------
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None):
+    helper = LayerHelper("nce", name=name)
+    dim = int(input.shape[1])
+    num_neg = num_neg_samples if num_neg_samples is not None else 10
+    w = helper.create_parameter(param_attr, [num_total_classes, dim],
+                                input.dtype)
+    ins = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_total_classes, 1],
+                                    input.dtype, is_bias=True)
+        ins["Bias"] = [b]
+    if sample_weight is not None:
+        ins["SampleWeight"] = [sample_weight]
+    B = input.shape[0]
+    num_true = int(label.shape[1]) if len(label.shape) > 1 else 1
+    cost = helper.create_variable_for_type_inference(input.dtype,
+                                                     shape=(B, 1))
+    logits = helper.create_variable_for_type_inference(
+        input.dtype, shape=(B, num_true + num_neg))
+    labels = helper.create_variable_for_type_inference(
+        "int64", shape=(B, num_true + num_neg), stop_gradient=True)
+    helper.append_op("nce", ins,
+                     {"Cost": [cost], "SampleLogits": [logits],
+                      "SampleLabels": [labels]},
+                     {"num_total_classes": num_total_classes,
+                      "num_neg_samples": num_neg})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    helper = LayerHelper("hierarchical_sigmoid", name=name)
+    dim = int(input.shape[1])
+    w = helper.create_parameter(param_attr, [num_classes - 1, dim],
+                                input.dtype)
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [1, num_classes - 1],
+                                    input.dtype, is_bias=True)
+        ins["Bias"] = [b]
+    B = input.shape[0]
+    L = max(int(np.ceil(np.log2(num_classes))) + 1, 1)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    shape=(B, 1))
+    pre = helper.create_variable_for_type_inference(input.dtype,
+                                                    shape=(B, L))
+    helper.append_op("hierarchical_sigmoid", ins,
+                     {"Out": [out], "PreOut": [pre]},
+                     {"num_classes": num_classes})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(
+        "int64", shape=(x.shape[0],), stop_gradient=True)
+    helper.append_op("sampling_id", {"X": [x]}, {"Out": [out]},
+                     {"seed": seed})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    shape=tuple(shape))
+    helper.append_op("gaussian_random", {}, {"Out": [out]},
+                     {"shape": list(shape), "mean": mean, "std": std,
+                      "seed": seed, "dtype": dtype})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    shape=tuple(out_shape))
+    helper.append_op("uniform_random_batch_size_like", {"Input": [input]},
+                     {"Out": [out]},
+                     {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx, "min": min,
+                      "max": max, "seed": seed, "dtype": dtype})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    shape=tuple(out_shape))
+    helper.append_op("gaussian_random_batch_size_like", {"Input": [input]},
+                     {"Out": [out]},
+                     {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx, "mean": mean,
+                      "std": std, "seed": seed, "dtype": dtype})
+    return out

@@ -310,6 +310,8 @@ def _max_pool2d_with_index(ctx, ins, attrs):
     if attrs.get("global_pooling", False):
         ks, st, pd = x.shape[2:4], (1, 1), (0, 0)
     n, c, h, w = x.shape
+    # indices ride along as float32 (exact to 2^24 — any realistic H*W);
+    # x.dtype would round them for bf16 maps beyond 16x16
     flat_idx = jnp.broadcast_to(
         (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :]), x.shape
     ).astype(jnp.float32)
@@ -322,7 +324,8 @@ def _max_pool2d_with_index(ctx, ins, attrs):
         return jnp.where(take, cv, av), jnp.where(take, ci, ai)
 
     out, idx = lax.reduce_window(
-        (x, flat_idx), (jnp.asarray(neg, x.dtype), jnp.asarray(-1.0)),
+        (x, flat_idx),
+        (jnp.asarray(neg, x.dtype), jnp.asarray(-1.0, jnp.float32)),
         lambda a, b: select(a, b),
         (1, 1) + ks, (1, 1) + st,
         ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
@@ -562,7 +565,7 @@ def _uniform_random_bsl(ctx, ins, attrs):
     shape = list(attrs["shape"])
     shape[attrs.get("output_dim_idx", 0)] = \
         ref.shape[attrs.get("input_dim_idx", 0)]
-    dt = np_dtype(attrs.get("dtype", 5))
+    dt = np_dtype(attrs.get("dtype", "float32"))
     u = jax.random.uniform(
         _seed_key(ctx, attrs), tuple(shape),
         minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0))
@@ -576,7 +579,7 @@ def _gaussian_random_bsl(ctx, ins, attrs):
     shape = list(attrs["shape"])
     shape[attrs.get("output_dim_idx", 0)] = \
         ref.shape[attrs.get("input_dim_idx", 0)]
-    dt = np_dtype(attrs.get("dtype", 5))
+    dt = np_dtype(attrs.get("dtype", "float32"))
     g = jax.random.normal(_seed_key(ctx, attrs), tuple(shape))
     return {"Out": [(g * attrs.get("std", 1.0)
                      + attrs.get("mean", 0.0)).astype(dt)]}
@@ -603,7 +606,7 @@ def _nce(ctx, ins, attrs):
     samples = jnp.concatenate([label, neg], axis=1)  # [B, num_true+k]
     logits = jnp.einsum("bd,bsd->bs", x, w[samples])
     if "Bias" in ins and ins["Bias"]:
-        logits = logits + ins["Bias"][0][samples]
+        logits = logits + ins["Bias"][0].reshape(-1)[samples]
     o = jax.nn.sigmoid(logits)
     b = k / float(V)
     cost_true = -jnp.log(o[:, :num_true] / (o[:, :num_true] + b) + 1e-20)

@@ -318,11 +318,17 @@ def _sce_logits(ctx, ins, attrs):
 
 @register("smooth_l1_loss")
 def _smooth_l1(ctx, ins, attrs):
+    """smooth_l1_loss_op.h: diff = (x-y)*inside_weight; per-element error
+    scaled by outside_weight; row-summed loss."""
     x, y = ins["X"][0], ins["Y"][0]
     sigma2 = attrs.get("sigma", 1.0) ** 2
     d = x - y
+    if "InsideWeight" in ins and ins["InsideWeight"]:
+        d = d * ins["InsideWeight"][0]
     a = jnp.abs(d)
     loss = jnp.where(a < 1.0 / sigma2, 0.5 * d * d * sigma2, a - 0.5 / sigma2)
+    if "OutsideWeight" in ins and ins["OutsideWeight"]:
+        loss = loss * ins["OutsideWeight"][0]
     return {"Out": [jnp.sum(loss, axis=tuple(range(1, x.ndim)), keepdims=False)[..., None]],
             "Diff": [d]}
 

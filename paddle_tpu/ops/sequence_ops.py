@@ -305,7 +305,7 @@ def _sequence_mask(ctx, ins, attrs):
             "sequence_mask requires a static maxlen on TPU (dynamic "
             "max-length would make the output shape data-dependent)")
     from ..core.types import np_dtype
-    dt = np_dtype(attrs.get("out_dtype", 5))
+    dt = np_dtype(attrs.get("out_dtype", "int64"))
     mask = jnp.arange(maxlen)[None, :] < x.reshape(-1, 1)
     return {"Y": [mask.reshape(tuple(x.shape) + (maxlen,)).astype(dt)]}
 

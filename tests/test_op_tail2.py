@@ -1,0 +1,459 @@
+"""Op-tail batch 2: ranking/pairwise losses, image ops, RNN unit cells,
+candidate sampling, 3-D convs, host metrics.
+
+Mirrors the reference unittest files (test_hinge_loss_op.py,
+test_rank_loss_op.py, test_lrn_op.py, test_maxout_op.py, test_roi_pool_op.py,
+test_gru_unit_op.py, test_nce.py, test_hsigmoid_op.py, test_chunk_eval_op.py,
+test_mean_iou.py, test_bilinear_interp_op.py, ...): forward values against
+a NumPy model + graph-level numeric gradients via the op harness.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_harness import check_grad, run_forward
+
+
+rng = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------------------
+# losses: forward parity + numeric grads
+# ---------------------------------------------------------------------------
+
+def test_hinge_loss():
+    x = rng.randn(6, 1).astype("float64")
+    y = rng.randint(0, 2, (6, 1)).astype("float64")
+    (out,) = run_forward(
+        lambda v: fluid.layers.hinge_loss(v["x"], v["y"]), {"x": x, "y": y})
+    np.testing.assert_allclose(
+        out, np.maximum(0, 1 - (2 * y - 1) * x), rtol=1e-6)
+    check_grad(lambda v: fluid.layers.hinge_loss(v["x"], v["y"]),
+               {"x": x + 0.3, "y": y}, wrt=["x"])
+
+
+def test_log_loss():
+    p = rng.uniform(0.1, 0.9, (8, 1)).astype("float64")
+    y = rng.randint(0, 2, (8, 1)).astype("float64")
+    (out,) = run_forward(
+        lambda v: fluid.layers.log_loss(v["p"], v["y"]), {"p": p, "y": y})
+    ref = -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    check_grad(lambda v: fluid.layers.log_loss(v["p"], v["y"]),
+               {"p": p, "y": y}, wrt=["p"])
+
+
+def test_rank_loss():
+    left = rng.randn(5, 1).astype("float64")
+    right = rng.randn(5, 1).astype("float64")
+    label = rng.randint(0, 2, (5, 1)).astype("float64")
+    (out,) = run_forward(
+        lambda v: fluid.layers.rank_loss(v["l"], v["a"], v["b"]),
+        {"l": label, "a": left, "b": right})
+    o = left - right
+    np.testing.assert_allclose(out, np.log1p(np.exp(o)) - label * o,
+                               rtol=1e-6)
+    check_grad(lambda v: fluid.layers.rank_loss(v["l"], v["a"], v["b"]),
+               {"l": label, "a": left, "b": right}, wrt=["a", "b"])
+
+
+def test_margin_rank_loss_and_modified_huber():
+    x1 = rng.randn(6, 1).astype("float64")
+    x2 = rng.randn(6, 1).astype("float64")
+    lab = np.where(rng.rand(6, 1) > 0.5, 1.0, -1.0)
+    (out,) = run_forward(
+        lambda v: fluid.layers.margin_rank_loss(v["l"], v["a"], v["b"],
+                                                margin=0.1),
+        {"l": lab, "a": x1, "b": x2})
+    np.testing.assert_allclose(
+        out, np.maximum(0, -lab * (x1 - x2) + 0.1), rtol=1e-6)
+
+    x = rng.randn(8, 1).astype("float64")
+    y = rng.randint(0, 2, (8, 1)).astype("float64")
+    (mh,) = run_forward(
+        lambda v: fluid.layers.modified_huber_loss(v["x"], v["y"]),
+        {"x": x, "y": y})
+    z = x * (2 * y - 1)
+    ref = np.where(z < -1, -4 * z, np.where(z < 1, (1 - z) ** 2, 0.0))
+    np.testing.assert_allclose(mh, ref, rtol=1e-6)
+    check_grad(lambda v: fluid.layers.modified_huber_loss(v["x"], v["y"]),
+               {"x": x, "y": y}, wrt=["x"])
+
+
+def test_l2_losses_and_cos_sim():
+    x = rng.randn(4, 5).astype("float64")
+    y = rng.randn(4, 5).astype("float64")
+    (d,) = run_forward(
+        lambda v: fluid.layers.squared_l2_distance(v["x"], v["y"]),
+        {"x": x, "y": y})
+    np.testing.assert_allclose(
+        d, ((x - y) ** 2).sum(1, keepdims=True), rtol=1e-6)
+    (n,) = run_forward(lambda v: fluid.layers.squared_l2_norm(v["x"]),
+                       {"x": x})
+    np.testing.assert_allclose(n, [(x ** 2).sum()], rtol=1e-6)
+    (l1,) = run_forward(lambda v: fluid.layers.l1_norm(v["x"]), {"x": x})
+    np.testing.assert_allclose(l1, [np.abs(x).sum()], rtol=1e-6)
+    (cs,) = run_forward(lambda v: fluid.layers.cos_sim(v["x"], v["y"]),
+                        {"x": x, "y": y})
+    ref = (x * y).sum(1) / (np.linalg.norm(x, axis=1)
+                            * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(cs.reshape(-1), ref, rtol=1e-5)
+    check_grad(lambda v: fluid.layers.cos_sim(v["x"], v["y"]),
+               {"x": x, "y": y}, wrt=["x", "y"], rtol=5e-3)
+
+
+def test_bilinear_tensor_product_grad():
+    x = rng.randn(3, 4).astype("float64")
+    y = rng.randn(3, 5).astype("float64")
+    check_grad(
+        lambda v: fluid.layers.bilinear_tensor_product(v["x"], v["y"], 6),
+        {"x": x, "y": y}, wrt=["x", "y"], rtol=5e-3)
+
+
+def test_label_smooth_and_smooth_l1():
+    x = np.eye(4, 6).astype("float64")
+    (out,) = run_forward(
+        lambda v: fluid.layers.label_smooth(v["x"], epsilon=0.1), {"x": x})
+    np.testing.assert_allclose(out, 0.9 * x + 0.1 / 6, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+def test_shape_ops():
+    x = rng.randn(2, 3, 4).astype("float32")
+    (f,) = run_forward(lambda v: fluid.layers.flatten(v["x"], axis=2),
+                       {"x": x})
+    assert f.shape == (6, 4)
+    (r,) = run_forward(lambda v: fluid.layers.reverse(v["x"], axis=1),
+                       {"x": x})
+    np.testing.assert_allclose(r, x[:, ::-1])
+    outs = run_forward(lambda v: fluid.layers.unstack(v["x"], axis=0),
+                       {"x": x})
+    assert len(outs) == 2 and np.allclose(outs[1], x[1])
+    (c,) = run_forward(
+        lambda v: fluid.layers.crop(v["x"], shape=[2, 2, 2],
+                                    offsets=[0, 1, 1]), {"x": x})
+    np.testing.assert_allclose(c, x[:, 1:3, 1:3])
+    (p,) = run_forward(
+        lambda v: fluid.layers.pad2d(v["x4"], [1, 1, 2, 2], mode="reflect"),
+        {"x4": rng.randn(1, 2, 4, 4).astype("float32")})
+    assert p.shape == (1, 2, 6, 8)
+    (s,) = run_forward(lambda v: fluid.layers.shape(v["x"]), {"x": x})
+    np.testing.assert_array_equal(s, [2, 3, 4])
+
+
+def test_pad_constant_like_and_multiplex_and_argsort():
+    x = np.zeros((4, 5), "float32")
+    y = rng.randn(2, 3).astype("float32")
+    (p,) = run_forward(
+        lambda v: fluid.layers.pad_constant_like(v["x"], v["y"], 9.0),
+        {"x": x, "y": y})
+    assert p.shape == (4, 5) and p[3, 4] == 9.0 and np.allclose(p[:2, :3], y)
+
+    a = rng.randn(4, 3).astype("float32")
+    b = rng.randn(4, 3).astype("float32")
+    ids = np.array([[0], [1], [0], [1]], "int32")
+    (m,) = run_forward(
+        lambda v: fluid.layers.multiplex([v["a"], v["b"]], v["i"]),
+        {"a": a, "b": b, "i": ids})
+    np.testing.assert_allclose(m, np.stack([a[0], b[1], a[2], b[3]]))
+
+    (so, si) = run_forward(lambda v: fluid.layers.argsort(v["a"], axis=1),
+                           {"a": a})
+    np.testing.assert_allclose(so, np.sort(a, axis=1))
+    np.testing.assert_array_equal(si, np.argsort(a, axis=1))
+
+
+def test_sequence_mask_and_scatter():
+    lens = np.array([3, 1, 4], "int64")
+    (m,) = run_forward(
+        lambda v: fluid.layers.sequence_mask(v["l"], maxlen=5, dtype="int32"),
+        {"l": lens})
+    assert m.shape == (3, 5)
+    np.testing.assert_array_equal(m[0], [1, 1, 1, 0, 0])
+
+    x = np.zeros((2, 6), "float64")
+    ids = np.array([[0, 2], [1, 3]], "int64")
+    upd = rng.randn(2, 2).astype("float64")
+    (out,) = run_forward(
+        lambda v: fluid.layers.sequence_scatter(v["x"], v["i"], v["u"]),
+        {"x": x, "i": ids, "u": upd})
+    assert out[0, 0] == upd[0, 0] and out[1, 3] == upd[1, 1]
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+
+def test_prelu_lrn_maxout_affine_channel():
+    x = rng.randn(2, 4, 5, 5).astype("float64")
+    alpha = np.array([0.25], "float64")
+    (out,) = run_forward(
+        lambda v: fluid.layers.prelu(v["x"], "all"), {"x": x})
+    np.testing.assert_allclose(out, np.maximum(x, 0) + 0.25 * np.minimum(x, 0))
+    check_grad(lambda v: fluid.layers.prelu(v["x"], "channel"),
+               {"x": x}, wrt=["x"])
+
+    (lrn_out,) = run_forward(
+        lambda v: fluid.layers.lrn(v["x"], n=3, k=1.0, alpha=1e-2, beta=0.5),
+        {"x": x})
+    sq = x * x
+    pad = np.pad(sq, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    mid = 1.0 + 1e-2 * (pad[:, :4] + pad[:, 1:5] + pad[:, 2:6])
+    np.testing.assert_allclose(lrn_out, x * mid ** -0.5, rtol=1e-5)
+
+    (mo,) = run_forward(lambda v: fluid.layers.maxout(v["x"], groups=2),
+                        {"x": x})
+    np.testing.assert_allclose(mo, x.reshape(2, 2, 2, 5, 5).max(axis=2))
+
+    s = rng.randn(4).astype("float64")
+    b = rng.randn(4).astype("float64")
+    (ac,) = run_forward(
+        lambda v: fluid.layers.affine_channel(v["x"], v["s"], v["b"]),
+        {"x": x, "s": s, "b": b})
+    np.testing.assert_allclose(
+        ac, x * s.reshape(1, 4, 1, 1) + b.reshape(1, 4, 1, 1), rtol=1e-6)
+
+
+def test_bilinear_interp_matches_numpy():
+    x = rng.randn(2, 3, 4, 4).astype("float64")
+    oh = ow = 7
+    (out,) = run_forward(
+        lambda v: fluid.layers.resize_bilinear(v["x"], out_shape=[oh, ow]),
+        {"x": x})
+    rh, rw = 3 / 6, 3 / 6
+    ref = np.zeros((2, 3, oh, ow))
+    for i in range(oh):
+        for j in range(ow):
+            yy, xx = i * rh, j * rw
+            y0, x0 = int(yy), int(xx)
+            y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+            wy, wx = yy - y0, xx - x0
+            ref[:, :, i, j] = ((1 - wy) * (1 - wx) * x[:, :, y0, x0]
+                               + (1 - wy) * wx * x[:, :, y0, x1]
+                               + wy * (1 - wx) * x[:, :, y1, x0]
+                               + wy * wx * x[:, :, y1, x1])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    check_grad(
+        lambda v: fluid.layers.resize_bilinear(v["x"], out_shape=[oh, ow]),
+        {"x": x}, wrt=["x"])
+
+
+def test_roi_pool_reference_bins():
+    # ROI spanning rows 0..2 pooled to 2 bins: reference overlapping
+    # boundaries put row 1 in BOTH bins
+    x = np.arange(16, dtype="float64").reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 2, 2]], "float64")  # batch 0, x1 y1 x2 y2
+    (out,) = run_forward(
+        lambda v: fluid.layers.roi_pool(v["x"], v["r"], 2, 2, 1.0),
+        {"x": x, "r": rois})
+    # bins: rows [0,2)/[1,3), cols same → maxes 5, 6, 9, 10
+    np.testing.assert_allclose(out.reshape(2, 2), [[5, 6], [9, 10]])
+
+
+def test_max_pool_with_index_grad_routing():
+    x = rng.randn(2, 3, 6, 6).astype("float64")
+
+    def build(v):
+        helper = fluid.layer_helper.LayerHelper("max_pool2d_with_index")
+        out = helper.create_variable_for_type_inference(
+            v["x"].dtype, shape=(2, 3, 3, 3))
+        mask = helper.create_variable_for_type_inference(
+            "int64", shape=(2, 3, 3, 3), stop_gradient=True)
+        helper.append_op("max_pool2d_with_index", {"X": [v["x"]]},
+                         {"Out": [out], "Mask": [mask]},
+                         {"ksize": [2, 2], "strides": [2, 2]})
+        return out
+
+    check_grad(build, {"x": x}, wrt=["x"])
+
+
+def test_im2sequence_shapes():
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+    (out,) = run_forward(
+        lambda v: fluid.layers.im2sequence(v["x"], filter_size=2, stride=2),
+        {"x": x})
+    assert out.shape == (2, 9, 12)
+    # first patch of first image = x[0,:,0:2,0:2] flattened channel-major
+    np.testing.assert_allclose(out[0, 0], x[0, :, 0:2, 0:2].reshape(-1),
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# RNN unit cells
+# ---------------------------------------------------------------------------
+
+def test_gru_unit_matches_numpy():
+    B, D = 3, 4
+    x = rng.randn(B, 3 * D).astype("float64")
+    hp = rng.randn(B, D).astype("float64")
+
+    (h, rhp, gate) = run_forward(
+        lambda v: fluid.layers.gru_unit(v["x"], v["h"], 3 * D,
+                                        bias_attr=False),
+        {"x": x, "h": hp})
+    # pull the initialized weight back out is awkward; check shapes + the
+    # identity h = u*(c-h_prev)+h_prev holds for the returned gate parts
+    u, r, c = gate[:, :D], gate[:, D:2 * D], gate[:, 2 * D:]
+    np.testing.assert_allclose(h, u * (c - hp) + hp, rtol=1e-5)
+    np.testing.assert_allclose(rhp, r * hp, rtol=1e-5)
+
+
+def test_lstm_unit_and_grad():
+    B, D = 3, 4
+    x = rng.randn(B, 5).astype("float64")
+    h = rng.randn(B, D).astype("float64")
+    c = rng.randn(B, D).astype("float64")
+
+    def build(v):
+        hh, cc = fluid.layers.lstm_unit(v["x"], v["h"], v["c"],
+                                        forget_bias=1.0)
+        return hh
+
+    check_grad(build, {"x": x, "h": h, "c": c}, wrt=["x", "c"], rtol=5e-3)
+
+
+def test_dynamic_lstmp_shapes():
+    B, T, H, P = 2, 5, 6, 3
+    x = rng.randn(B, T, 4 * H).astype("float32")
+
+    def build(v):
+        proj, cell = fluid.layers.dynamic_lstmp(v["x"], 4 * H, P)
+        return fluid.layers.reduce_sum(proj)
+
+    (s,) = run_forward(build, {"x": x})
+    assert np.isfinite(s)
+
+
+def test_conv_shift():
+    B, M, N = 2, 7, 3
+    x = rng.randn(B, M).astype("float64")
+    y = rng.randn(B, N).astype("float64")
+
+    def build(v):
+        helper = fluid.layer_helper.LayerHelper("conv_shift")
+        out = helper.create_variable_for_type_inference(v["x"].dtype,
+                                                        shape=(B, M))
+        helper.append_op("conv_shift", {"X": [v["x"]], "Y": [v["y"]]},
+                         {"Out": [out]}, {})
+        return out
+
+    (out,) = run_forward(build, {"x": x, "y": y})
+    ref = np.zeros((B, M))
+    half = (N - 1) // 2
+    for i in range(M):
+        for j in range(-half, N - half):
+            ref[:, i] += x[:, (i + j) % M] * y[:, j + half]
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv family
+# ---------------------------------------------------------------------------
+
+def test_conv3d_pool3d_grads():
+    x = rng.randn(1, 2, 4, 4, 4).astype("float64")
+    check_grad(
+        lambda v: fluid.layers.conv3d(v["x"], 3, 2, bias_attr=False),
+        {"x": x}, wrt=["x"], rtol=5e-3)
+    (p,) = run_forward(
+        lambda v: fluid.layers.pool3d(v["x"], 2, "avg", 2), {"x": x})
+    np.testing.assert_allclose(
+        p, x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7)),
+        rtol=1e-6)
+
+
+def test_conv3d_transpose_shape_roundtrip():
+    x = rng.randn(1, 3, 3, 3, 3).astype("float32")
+    (out,) = run_forward(
+        lambda v: fluid.layers.conv3d_transpose(v["x"], 2, 2, stride=2,
+                                                bias_attr=False), {"x": x})
+    assert out.shape == (1, 2, 6, 6, 6)
+
+
+# ---------------------------------------------------------------------------
+# candidate sampling / random
+# ---------------------------------------------------------------------------
+
+def test_nce_trains_down():
+    B, D, V = 8, 6, 40
+    x = rng.randn(B, D).astype("float32")
+    lab = rng.randint(0, V, (B, 1)).astype("int64")
+
+    def build(v):
+        cost = fluid.layers.nce(v["x"], v["l"], V, num_neg_samples=5)
+        return fluid.layers.mean(cost)
+
+    (c0,) = run_forward(build, {"x": x, "l": lab})
+    assert np.isfinite(c0) and c0 > 0
+
+
+def test_hsigmoid_loss_and_grad():
+    B, D, V = 4, 5, 10
+    x = rng.randn(B, D).astype("float64")
+    lab = rng.randint(0, V, (B, 1)).astype("int64")
+
+    def build(v):
+        return fluid.layers.hsigmoid(v["x"], v["l"], V)
+
+    (loss,) = run_forward(build, {"x": x, "l": lab})
+    assert loss.shape == (B, 1) and (loss > 0).all()
+    check_grad(build, {"x": x, "l": lab}, wrt=["x"], rtol=5e-3)
+
+
+def test_random_layers():
+    x = rng.randn(5, 3).astype("float32")
+    (g,) = run_forward(
+        lambda v: fluid.layers.gaussian_random([4, 6], std=2.0), {"x": x})
+    assert g.shape == (4, 6)
+    (u,) = run_forward(
+        lambda v: fluid.layers.uniform_random_batch_size_like(
+            v["x"], [10, 7]), {"x": x})
+    assert u.shape == (5, 7) and (u >= -1).all() and (u <= 1).all()
+    probs = np.full((6, 4), 0.25, "float32")
+    (ids,) = run_forward(lambda v: fluid.layers.sampling_id(v["p"]),
+                         {"p": probs})
+    assert ids.shape == (6,) and ((ids >= 0) & (ids < 4)).all()
+    (rc,) = run_forward(
+        lambda v: fluid.layers.random_crop(v["x8"], [5, 5]),
+        {"x8": rng.randn(2, 8, 8).astype("float32")})
+    assert rc.shape == (2, 5, 5)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_mean_iou():
+    pred = np.array([[0, 1, 1, 2]], "int64")
+    lab = np.array([[0, 1, 2, 2]], "int64")
+
+    def build(v):
+        miou, wrong, correct = fluid.layers.mean_iou(v["p"], v["l"], 3)
+        return miou
+
+    (miou,) = run_forward(build, {"p": pred, "l": lab})
+    # class ious: 0: 1/1, 1: 1/2, 2: 1/2 → mean 2/3
+    np.testing.assert_allclose(float(miou), 2 / 3, rtol=1e-5)
+
+
+def test_chunk_eval_iob():
+    # tags: type*2 + {0:B, 1:I}; "other" type id = num_chunk_types
+    # seq: B0 I0 O B1 → chunks (0,1,t0), (3,3,t1)
+    O = 4  # 2 chunk types * 2 tags = other
+    inf = np.array([[0, 1, O, 2]], "int64")
+    lab = np.array([[0, 1, O, 0]], "int64")  # second chunk differs in type
+
+    def build(v):
+        p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(
+            v["i"], v["l"], "IOB", 2)
+        return [p, r, f1, ni, nl, nc]
+
+    p, r, f1, ni, nl, nc = run_forward(build, {"i": inf, "l": lab})
+    assert (int(np.asarray(ni).reshape(())) == 2
+            and int(np.asarray(nl).reshape(())) == 2
+            and int(np.asarray(nc).reshape(())) == 1)
+    np.testing.assert_allclose(np.asarray(p).reshape(()), 0.5)
+    np.testing.assert_allclose(np.asarray(r).reshape(()), 0.5)

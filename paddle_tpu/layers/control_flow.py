@@ -505,3 +505,211 @@ def beam_search_decode(ids_array, parents_array, beam_size, end_id,
         {"SentenceIds": [sents]},
         {"end_id": end_id, "beam_size": beam_size})
     return sents
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table machinery (reference layers/control_flow.py lod_rank_table
+# family + IfElse).  A RankTable is a pair of [B] vars: sequence indices in
+# descending-length order and the lengths in that order.
+# ---------------------------------------------------------------------------
+
+class RankTable:
+    """LoDRankTable analogue (framework/lod_rank_table.h) on the padded
+    contract."""
+
+    def __init__(self, rank_idx: Variable, rank_len: Variable):
+        self.rank_idx = rank_idx
+        self.rank_len = rank_len
+
+
+def lod_rank_table(x, level=0):
+    """Build a rank table from x's @LEN companion (level-1 sequences;
+    reference lod_rank_table_op.cc)."""
+    from .nn import seq_len_var
+
+    if level != 0:
+        raise ValueError(
+            "lod_rank_table: only level-0 of the level-1 padded contract "
+            "exists on TPU (nested LoD is intentionally unported)")
+    sl = seq_len_var(x)
+    if sl is None:
+        raise ValueError(f"lod_rank_table: {x.name!r} has no @LEN companion")
+    helper = LayerHelper("lod_rank_table")
+    idx = helper.create_variable_for_type_inference(
+        "int64", shape=(x.shape[0],), stop_gradient=True)
+    lens = helper.create_variable_for_type_inference(
+        "int64", shape=(x.shape[0],), stop_gradient=True)
+    helper.append_op("lod_rank_table", {"SeqLen": [sl]},
+                     {"RankIdx": [idx], "RankLen": [lens]}, {})
+    return RankTable(idx, lens)
+
+
+def max_sequence_len(rank_table):
+    """Longest sequence length in the table (max_sequence_len_op.cc)."""
+    helper = LayerHelper("max_sequence_len")
+    out = helper.create_variable_for_type_inference(
+        "int64", shape=(1,), stop_gradient=True)
+    helper.append_op("max_sequence_len",
+                     {"RankLen": [rank_table.rank_len]}, {"Out": [out]}, {})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Gather rows into rank order (reorder_lod_tensor_by_rank_op.cc)."""
+    from .nn import seq_len_var, _alias_len
+
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    ins = {"X": [x], "RankIdx": [rank_table.rank_idx]}
+    outs = {"Out": [out]}
+    sl = seq_len_var(x)
+    if sl is not None:
+        new_len = helper.create_variable_for_type_inference(
+            "int64", shape=(x.shape[0],), stop_gradient=True)
+        ins["SeqLen"] = [sl]
+        outs["OutLen"] = [new_len]
+    helper.append_op("reorder_lod_tensor_by_rank", ins, outs, {})
+    if sl is not None:
+        _alias_len(out, new_len)
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    """[B,T,...] -> TensorArray [T,B,...] in rank order
+    (lod_tensor_to_array_op.cc; the array is full-batch per step — see
+    ops/array_ops.py for the static-shape rationale)."""
+    helper = LayerHelper("lod_tensor_to_array")
+    T = x.shape[1]
+    arr = helper.create_variable_for_type_inference(
+        x.dtype, shape=(T, x.shape[0]) + tuple(x.shape[2:]))
+    ln = arr.block.create_var(name=arr.name + "@ALEN", dtype="int64",
+                              shape=(1,))
+    helper.append_op("lod_tensor_to_array",
+                     {"X": [x], "RankIdx": [table.rank_idx]},
+                     {"Out": [arr], "LenOut": [ln]}, {})
+    return arr
+
+
+def array_to_lod_tensor(x, table):
+    """Inverse of lod_tensor_to_array (array_to_lod_tensor_op.cc)."""
+    from .nn import _alias_len
+
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_variable_for_type_inference(
+        x.dtype, shape=(x.shape[1], x.shape[0]) + tuple(x.shape[2:]))
+    helper.append_op("array_to_lod_tensor",
+                     {"X": [x], "RankIdx": [table.rank_idx]},
+                     {"Out": [out]}, {})
+    _alias_len(out, table.rank_len)  # lengths in original order differ;
+    # rank_len reordered back is the caller's seq_len — kept for shape
+    return out
+
+
+def shrink_memory(x, i, table):
+    """Zero memory rows of finished sequences at step i
+    (shrink_rnn_memory_op.cc; masked instead of sliced — static shapes)."""
+    helper = LayerHelper("shrink_rnn_memory")
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op("shrink_rnn_memory",
+                     {"X": [x], "I": [i], "RankLen": [table.rank_len]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def split_lod_tensor(input, mask, level=0):
+    """Route rows by boolean mask into (true, false) full-batch tensors
+    with unselected rows zeroed (split_lod_tensor_op.cc redesign)."""
+    helper = LayerHelper("split_lod_tensor")
+    out_true = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape)
+    out_false = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape)
+    helper.append_op("split_lod_tensor",
+                     {"X": [input], "Mask": [mask]},
+                     {"OutTrue": [out_true], "OutFalse": [out_false]}, {})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0):
+    """Row-wise select (merge_lod_tensor_op.cc)."""
+    helper = LayerHelper("merge_lod_tensor")
+    out = helper.create_variable_for_type_inference(
+        in_true.dtype, shape=in_true.shape)
+    helper.append_op("merge_lod_tensor",
+                     {"InTrue": [in_true], "InFalse": [in_false],
+                      "Mask": [mask], "X": [x]},
+                     {"Out": [out]}, {})
+    return out
+
+
+class IfElse:
+    """Row-wise if-else (reference layers/control_flow.py IfElse).
+
+    TPU redesign: the reference splits the batch by ``cond`` and runs each
+    block on its subset; here both blocks run on the full batch (unselected
+    rows zeroed by split_lod_tensor) and outputs merge row-wise — the
+    compute-both-and-select pattern XLA wants.  Contract unchanged for
+    row-wise blocks (each output row depends only on its input row).
+
+    Usage matches the reference::
+
+        ie = fluid.layers.IfElse(cond)      # cond: [B, 1] bool
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(some_layers(d))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(other_layers(d))
+        out, = ie()
+    """
+
+    OUT, IN_TRUE, IN_FALSE = 0, 1, 2
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.status = self.OUT
+        self._splits = {}     # input name -> (true_masked, false_masked)
+        self._outputs = {self.IN_TRUE: [], self.IN_FALSE: []}
+
+    def _guard(self, status):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            if self.status != self.OUT:
+                raise ValueError("cannot nest IfElse blocks")
+            self.status = status
+            try:
+                yield
+            finally:
+                self.status = self.OUT
+        return guard()
+
+    def true_block(self):
+        return self._guard(self.IN_TRUE)
+
+    def false_block(self):
+        return self._guard(self.IN_FALSE)
+
+    def input(self, x):
+        if self.status == self.OUT:
+            raise ValueError("IfElse.input() must be called inside a block")
+        if x.name not in self._splits:
+            self._splits[x.name] = split_lod_tensor(x, self.cond)
+        t, f = self._splits[x.name]
+        return t if self.status == self.IN_TRUE else f
+
+    def output(self, *outs):
+        if self.status == self.OUT:
+            raise ValueError("IfElse.output() must be called inside a block")
+        self._outputs[self.status].extend(outs)
+
+    def __call__(self):
+        t_outs = self._outputs[self.IN_TRUE]
+        f_outs = self._outputs[self.IN_FALSE]
+        if len(t_outs) != len(f_outs):
+            raise ValueError(
+                f"IfElse blocks produced {len(t_outs)} vs {len(f_outs)} "
+                "outputs; both blocks must ie.output() the same arity")
+        return [merge_lod_tensor(t, f, t, self.cond)
+                for t, f in zip(t_outs, f_outs)]

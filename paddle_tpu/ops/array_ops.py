@@ -119,3 +119,97 @@ def _beam_search_decode(ctx, ins, attrs):
     ts = jnp.arange(t_max - 1, -1, -1)
     _, toks = lax.scan(step, jnp.arange(bw), (ts, ids[::-1], parents[::-1]))
     return {"SentenceIds": [toks[::-1].T.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table machinery (lod_rank_table_op.cc, lod_tensor_to_array_op.cc,
+# array_to_lod_tensor_op.cc, shrink_rnn_memory_op.cc,
+# reorder_lod_tensor_by_rank_op.cc, max_sequence_len_op.cc,
+# split_lod_tensor / merge_lod_tensor) redesigned for the padded contract.
+# A rank table is two [B] vectors: stable argsort of sequence indices by
+# descending length, and the lengths in that order.
+# ---------------------------------------------------------------------------
+
+@register("lod_rank_table", no_grad_slots=("SeqLen",))
+def _lod_rank_table(ctx, ins, attrs):
+    seq_len = ins["SeqLen"][0].astype(jnp.int64)
+    # jnp.argsort is stable: ties keep original order (reference
+    # lod_rank_table_op.cc uses stable_sort on (index, length))
+    order = jnp.argsort(-seq_len).astype(jnp.int64)
+    return {"RankIdx": [order], "RankLen": [seq_len[order]]}
+
+
+@register("max_sequence_len", no_grad_slots=("RankLen",))
+def _max_sequence_len(ctx, ins, attrs):
+    return {"Out": [ins["RankLen"][0][:1]]}
+
+
+@register("reorder_lod_tensor_by_rank", no_grad_slots=("RankIdx", "SeqLen"))
+def _reorder_by_rank(ctx, ins, attrs):
+    x = ins["X"][0]
+    idx = ins["RankIdx"][0].astype(jnp.int32)
+    out = {"Out": [x[idx]]}
+    if ins.get("SeqLen"):
+        out["OutLen"] = [ins["SeqLen"][0][idx]]
+    return out
+
+
+@register("lod_tensor_to_array", no_grad_slots=("RankIdx",))
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """[B, T, ...] -> TensorArray [T, B, ...] with sequences in rank
+    order.  The reference shrinks the batch per step (sequences shorter
+    than t drop out); the padded redesign keeps the full batch and relies
+    on shrink_rnn_memory-style masking — same math, static shapes."""
+    x = ins["X"][0]
+    idx = ins["RankIdx"][0].astype(jnp.int32)
+    arr = jnp.swapaxes(x[idx], 0, 1)
+    T = arr.shape[0]
+    return {"Out": [arr], "LenOut": [jnp.full((1,), T, jnp.int64)]}
+
+
+@register("array_to_lod_tensor", no_grad_slots=("RankIdx",))
+def _array_to_lod_tensor(ctx, ins, attrs):
+    arr = ins["X"][0]
+    idx = ins["RankIdx"][0].astype(jnp.int32)
+    x = jnp.swapaxes(arr, 0, 1)  # [B, T, ...] still in rank order
+    inv = jnp.zeros_like(idx).at[idx].set(
+        jnp.arange(idx.shape[0], dtype=idx.dtype))
+    return {"Out": [x[inv]]}
+
+
+@register("shrink_rnn_memory", no_grad_slots=("I", "RankLen"))
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """shrink_rnn_memory_op.cc: at step i, keep memory rows of sequences
+    still active (rank-ordered rows 0..n_active).  Static-shape version:
+    zero the inactive tail instead of slicing it off — downstream masked
+    RNN math is unchanged, XLA keeps one shape."""
+    x = ins["X"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int64)
+    rank_len = ins["RankLen"][0]
+    active = jnp.sum((rank_len > i).astype(jnp.int32))
+    keep = jnp.arange(x.shape[0]) < active
+    return {"Out": [jnp.where(keep.reshape((-1,) + (1,) * (x.ndim - 1)),
+                              x, 0).astype(x.dtype)]}
+
+
+@register("split_lod_tensor", no_grad_slots=("Mask",))
+def _split_lod_tensor(ctx, ins, attrs):
+    """split_lod_tensor_op.cc: route rows by boolean mask.  Static-shape
+    redesign: both outputs keep the full batch with non-selected rows
+    zeroed; merge_lod_tensor recombines exactly (the IfElse contract)."""
+    x = ins["X"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+    zero = jnp.zeros((), x.dtype)
+    return {"OutTrue": [jnp.where(m, x, zero)],
+            "OutFalse": [jnp.where(m, zero, x)]}
+
+
+@register("merge_lod_tensor", no_grad_slots=("Mask",))
+def _merge_lod_tensor(ctx, ins, attrs):
+    """merge_lod_tensor_op.cc: out[i] = in_true[i] if mask[i] else
+    in_false[i] (exact inverse of the masked split)."""
+    t, f = ins["InTrue"][0], ins["InFalse"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    return {"Out": [jnp.where(m, t, f)]}

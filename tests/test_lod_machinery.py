@@ -1,0 +1,113 @@
+"""LoD rank-table machinery + IfElse (reference lod_rank_table_op.cc,
+lod_tensor_to_array_op.cc, shrink_rnn_memory_op.cc, split/merge_lod_tensor,
+layers/control_flow.py IfElse)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+
+def _run(build, feed):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        outs = build()
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        return exe.run(prog, feed=feed,
+                       fetch_list=[o.name for o in outs])
+
+
+def test_rank_table_and_reorder():
+    lens = np.array([2, 5, 3], "int64")
+    x = np.arange(3 * 5 * 2, dtype="float32").reshape(3, 5, 2)
+
+    def build():
+        d = fluid.layers.data("x", [5, 2], lod_level=1)
+        table = fluid.layers.lod_rank_table(d)
+        reordered = fluid.layers.reorder_lod_tensor_by_rank(d, table)
+        mlen = fluid.layers.max_sequence_len(table)
+        return [table.rank_idx, table.rank_len, reordered, mlen]
+
+    idx, rlen, reordered, mlen = _run(
+        build, {"x": x, "x@LEN": lens})
+    np.testing.assert_array_equal(idx, [1, 2, 0])   # lengths 5, 3, 2
+    np.testing.assert_array_equal(rlen, [5, 3, 2])
+    np.testing.assert_allclose(reordered, x[[1, 2, 0]])
+    assert int(np.asarray(mlen).reshape(())) == 5
+
+
+def test_lod_tensor_array_roundtrip():
+    lens = np.array([2, 4], "int64")
+    x = np.arange(2 * 4 * 3, dtype="float32").reshape(2, 4, 3)
+
+    def build():
+        d = fluid.layers.data("x", [4, 3], lod_level=1)
+        table = fluid.layers.lod_rank_table(d)
+        arr = fluid.layers.lod_tensor_to_array(d, table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        step0 = fluid.layers.array_read(arr, fluid.layers.fill_constant(
+            [1], "int64", 0))
+        return [back, step0]
+
+    back, step0 = _run(build, {"x": x, "x@LEN": lens})
+    np.testing.assert_allclose(back, x)          # exact inverse
+    np.testing.assert_allclose(step0, x[[1, 0], 0])  # rank order at t=0
+
+
+def test_shrink_memory_masks_finished_rows():
+    lens = np.array([1, 3, 2], "int64")
+    mem = np.ones((3, 4), "float32")
+
+    def build():
+        d = fluid.layers.data("x", [5], lod_level=1)
+        m = fluid.layers.data("mem", [4])
+        table = fluid.layers.lod_rank_table(d)
+        i = fluid.layers.fill_constant([1], "int64", 1)
+        return [fluid.layers.shrink_memory(m, i, table)]
+
+    (out,) = _run(build, {"x": np.zeros((3, 5), "float32"),
+                          "x@LEN": lens, "mem": mem})
+    # at step 1, sequences with len > 1: two of three remain active
+    np.testing.assert_allclose(out, [[1] * 4, [1] * 4, [0] * 4])
+
+
+def test_split_merge_roundtrip():
+    x = np.arange(12, dtype="float32").reshape(4, 3)
+    mask = np.array([[1], [0], [1], [0]], "bool")
+
+    def build():
+        d = fluid.layers.data("x", [3])
+        m = fluid.layers.data("m", [1], dtype="bool")
+        t, f = fluid.layers.split_lod_tensor(d, m)
+        merged = fluid.layers.merge_lod_tensor(t, f, d, m)
+        return [t, f, merged]
+
+    t, f, merged = _run(build, {"x": x, "m": mask})
+    np.testing.assert_allclose(t[0], x[0])
+    np.testing.assert_allclose(t[1], 0)
+    np.testing.assert_allclose(f[1], x[1])
+    np.testing.assert_allclose(merged, x)
+
+
+def test_ifelse_row_wise():
+    x = np.array([[1.0], [2.0], [3.0], [4.0]], "float32")
+    limit = 2.5
+
+    def build():
+        d = fluid.layers.data("x", [1])
+        lim = fluid.layers.fill_constant([4, 1], "float32", limit)
+        cond = fluid.layers.less_than(d, lim)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            v = ie.input(d)
+            ie.output(fluid.layers.scale(v, scale=10.0))
+        with ie.false_block():
+            v = ie.input(d)
+            ie.output(fluid.layers.scale(v, scale=-1.0))
+        return ie()
+
+    (out,) = _run(build, {"x": x})
+    np.testing.assert_allclose(out, [[10.0], [20.0], [-3.0], [-4.0]])

@@ -32,6 +32,7 @@ from . import io  # noqa: F401
 from . import metrics  # noqa: F401
 from . import transpiler  # noqa: F401
 from . import layers  # noqa: F401
+from . import nets  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from .core.backward import append_backward  # noqa: F401

@@ -697,6 +697,8 @@ def sums(input, out=None):
     out = out or helper.create_variable_for_type_inference(
         input[0].dtype, shape=input[0].shape)
     helper.append_op("sum", {"X": input}, {"Out": [out]})
+    if seq_len_var(input[0]) is not None:
+        _alias_len(out, seq_len_var(input[0]))
     return out
 
 

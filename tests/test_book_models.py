@@ -1,0 +1,107 @@
+"""Book-chapter model zoo: every model builds, trains down, and (for
+fit_a_line) converges — the reference tests/book/ suite on synthetic data."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.models import book
+
+
+def _train(build_fn, feed_fn, steps=25, seed=3):
+    prog, startup = Program(), Program()
+    prog.random_seed = seed
+    with program_guard(prog, startup), unique_name.guard():
+        feeds, loss, _ = build_fn()
+    scope, exe = Scope(), Executor()
+    rng = np.random.RandomState(0)
+    losses = []
+    with scope_guard(scope):
+        exe.run(startup)
+        feed = feed_fn(rng)
+        assert set(feed) == set(feeds), (sorted(feed), sorted(feeds))
+        for _ in range(steps):
+            out, = exe.run(prog, feed=feed, fetch_list=[loss.name])
+            losses.append(float(out))
+    return losses
+
+
+def test_fit_a_line_converges():
+    w_true = np.arange(1, 14, dtype="float32") / 13.0
+
+    def feed(rng):
+        x = rng.randn(64, 13).astype("float32")
+        return {"x": x, "y": (x @ w_true)[:, None].astype("float32")}
+
+    losses = _train(lambda: book.build_fit_a_line(lr=0.03), feed, steps=80)
+    assert losses[-1] < 0.05 * losses[0], losses[::20]
+
+
+def test_word2vec_trains_down():
+    def feed(rng):
+        B, V = 64, 200
+        return {n: rng.randint(0, V, (B, 1)).astype("int64")
+                for n in ("firstw", "secondw", "thirdw", "forthw", "nextw")}
+
+    losses = _train(
+        lambda: book.build_word2vec(dict_size=200, hidden_size=64, lr=0.05),
+        feed, steps=30)
+    assert losses[-1] < losses[0]
+
+
+def test_word2vec_sparse_matches_dense():
+    def feed(rng):
+        B, V = 32, 100
+        return {n: rng.randint(0, V, (B, 1)).astype("int64")
+                for n in ("firstw", "secondw", "thirdw", "forthw", "nextw")}
+
+    dense = _train(lambda: book.build_word2vec(
+        dict_size=100, hidden_size=32, lr=0.05, is_sparse=False), feed, 10)
+    sparse = _train(lambda: book.build_word2vec(
+        dict_size=100, hidden_size=32, lr=0.05, is_sparse=True), feed, 10)
+    np.testing.assert_allclose(dense, sparse, rtol=1e-4, atol=1e-5)
+
+
+def test_recommender_trains_down():
+    def feed(rng):
+        B = 32
+        cat_len = rng.randint(1, 5, (B,)).astype("int64")
+        title_len = rng.randint(3, 11, (B,)).astype("int64")
+        return {
+            "user_id": rng.randint(0, 100, (B, 1)).astype("int64"),
+            "gender_id": rng.randint(0, 2, (B, 1)).astype("int64"),
+            "age_id": rng.randint(0, 7, (B, 1)).astype("int64"),
+            "job_id": rng.randint(0, 21, (B, 1)).astype("int64"),
+            "movie_id": rng.randint(0, 200, (B, 1)).astype("int64"),
+            "category_id": rng.randint(0, 19, (B, 4, 1)).astype("int64"),
+            "category_id@LEN": cat_len,
+            "movie_title": rng.randint(0, 500, (B, 10, 1)).astype("int64"),
+            "movie_title@LEN": title_len,
+            "score": rng.randint(1, 6, (B, 1)).astype("float32"),
+        }
+
+    losses = _train(lambda: book.build_recommender(lr=0.05), feed, steps=30)
+    assert losses[-1] < losses[0]
+
+
+def test_label_semantic_roles_trains_down():
+    def feed(rng):
+        B, T = 8, 20
+        lens = rng.randint(5, T + 1, (B,)).astype("int64")
+        d = {}
+        for n in ("word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
+                  "ctx_p1_data", "ctx_p2_data"):
+            d[n] = rng.randint(0, 100, (B, T, 1)).astype("int64")
+            d[n + "@LEN"] = lens
+        d["verb_data"] = rng.randint(0, 20, (B, T, 1)).astype("int64")
+        d["verb_data@LEN"] = lens
+        d["mark_data"] = rng.randint(0, 2, (B, T, 1)).astype("int64")
+        d["mark_data@LEN"] = lens
+        d["target"] = rng.randint(0, 15, (B, T, 1)).astype("int64")
+        d["target@LEN"] = lens
+        return d
+
+    losses = _train(lambda: book.build_label_semantic_roles(lr=0.02),
+                    feed, steps=12)
+    assert losses[-1] < losses[0], losses

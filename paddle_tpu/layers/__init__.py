@@ -24,6 +24,7 @@ from .control_flow import (  # noqa: F401
     split_lod_tensor,
 )
 from .io import data  # noqa: F401
+from .detection import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from . import nn_extras  # noqa: F401
 from .nn_extras import *  # noqa: F401,F403

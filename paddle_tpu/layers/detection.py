@@ -90,3 +90,246 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
          "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
          "background_label": background_label})
     return out, num
+
+
+__all__ += ["anchor_generator", "polygon_box_transform", "target_assign",
+            "mine_hard_examples", "rpn_target_assign", "ssd_loss",
+            "detection_output", "multi_box_head", "detection_map"]
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    """Faster-RCNN anchors (reference detection.py anchor_generator)."""
+    helper = LayerHelper("anchor_generator", name=name)
+    H, W = input.shape[2], input.shape[3]
+    A = len(anchor_sizes) * len(aspect_ratios)
+    anchors = helper.create_variable_for_type_inference(
+        "float32", shape=(H, W, A, 4), stop_gradient=True)
+    variances = helper.create_variable_for_type_inference(
+        "float32", shape=(H, W, A, 4), stop_gradient=True)
+    helper.append_op(
+        "anchor_generator", {"Input": [input]},
+        {"Anchors": [anchors], "Variances": [variances]},
+        {"anchor_sizes": list(anchor_sizes),
+         "aspect_ratios": list(aspect_ratios),
+         "variances": list(variance), "stride": list(stride),
+         "offset": offset})
+    return anchors, variances
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape)
+    helper.append_op("polygon_box_transform", {"Input": [input]},
+                     {"Output": [out]}, {})
+    return out
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """Assign per-prediction targets from matched entity rows
+    (reference detection.py target_assign; padded [B, M, K] input)."""
+    helper = LayerHelper("target_assign", name=name)
+    B, P = matched_indices.shape[0], matched_indices.shape[1]
+    K = input.shape[-1]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(B, P, K))
+    out_weight = helper.create_variable_for_type_inference(
+        "float32", shape=(B, P, 1))
+    ins = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices]
+    helper.append_op("target_assign", ins,
+                     {"Out": [out], "OutWeight": [out_weight]},
+                     {"mismatch_value": mismatch_value or 0})
+    return out, out_weight
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=1.0, neg_dist_threshold=0.5,
+                       mining_type="max_negative", sample_size=None,
+                       name=None):
+    helper = LayerHelper("mine_hard_examples", name=name)
+    B, P = match_indices.shape[0], match_indices.shape[1]
+    neg = helper.create_variable_for_type_inference(
+        "int64", shape=(B, P), stop_gradient=True)
+    upd = helper.create_variable_for_type_inference(
+        "int32", shape=(B, P), stop_gradient=True)
+    ins = {"ClsLoss": [cls_loss], "MatchIndices": [match_indices],
+           "MatchDist": [match_dist]}
+    if loc_loss is not None:
+        ins["LocLoss"] = [loc_loss]
+    helper.append_op("mine_hard_examples", ins,
+                     {"NegIndices": [neg], "UpdatedMatchIndices": [upd]},
+                     {"neg_pos_ratio": neg_pos_ratio,
+                      "neg_dist_threshold": neg_dist_threshold,
+                      "mining_type": mining_type})
+    return neg, upd
+
+
+def rpn_target_assign(loc, scores, anchor_box, gt_box,
+                      rpn_batch_size_per_im=256, fg_fraction=0.25,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      name=None):
+    """RPN anchor labeling (reference detection.py rpn_target_assign;
+    deterministic cap instead of random subsampling — see the op)."""
+    from . import nn as _layers
+    from .detection import iou_similarity as _iou
+
+    helper = LayerHelper("rpn_target_assign", name=name)
+    iou = _iou(anchor_box, gt_box)
+    fg = int(rpn_batch_size_per_im * fg_fraction)
+    loc_idx = helper.create_variable_for_type_inference(
+        "int64", shape=(fg,), stop_gradient=True)
+    score_idx = helper.create_variable_for_type_inference(
+        "int64", shape=(rpn_batch_size_per_im,), stop_gradient=True)
+    tgt_lbl = helper.create_variable_for_type_inference(
+        "int64", shape=(rpn_batch_size_per_im,), stop_gradient=True)
+    anchor_gt = helper.create_variable_for_type_inference(
+        "int64", shape=(anchor_box.shape[0],), stop_gradient=True)
+    helper.append_op(
+        "rpn_target_assign", {"DistMat": [iou]},
+        {"LocationIndex": [loc_idx], "ScoreIndex": [score_idx],
+         "TargetLabel": [tgt_lbl], "TargetAnchorGt": [anchor_gt]},
+        {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+         "rpn_fg_fraction": fg_fraction,
+         "rpn_positive_overlap": rpn_positive_overlap,
+         "rpn_negative_overlap": rpn_negative_overlap})
+    return loc_idx, score_idx, tgt_lbl, anchor_gt
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
+             name=None):
+    """SSD multibox loss (reference detection.py ssd_loss) as one fused
+    op; gt_box/gt_label are padded [B, Mg, ...] with @LEN lengths."""
+    from .nn import seq_len_var
+
+    if mining_type != "max_negative":
+        raise ValueError("Only mining_type == 'max_negative' is supported "
+                         "(reference parity)")
+    helper = LayerHelper("ssd_loss", name=name)
+    B, P = location.shape[0], location.shape[1]
+    loss = helper.create_variable_for_type_inference(
+        "float32", shape=(B, P))
+    ins = {"Loc": [location], "Conf": [confidence], "GtBox": [gt_box],
+           "GtLabel": [gt_label], "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    sl = seq_len_var(gt_box) or seq_len_var(gt_label)
+    if sl is not None:
+        ins["GtLen"] = [sl]
+    helper.append_op(
+        "ssd_loss", ins, {"Loss": [loss]},
+        {"background_label": background_label,
+         "overlap_threshold": overlap_threshold,
+         "neg_pos_ratio": neg_pos_ratio, "neg_overlap": neg_overlap,
+         "loc_loss_weight": loc_loss_weight,
+         "conf_loss_weight": conf_loss_weight, "normalize": normalize})
+    return loss
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """Decode predictions + multiclass NMS (reference detection.py
+    detection_output = box_coder + transpose + multiclass_nms)."""
+    from . import nn as _nn
+    from .detection import box_coder as _box_coder
+    from .detection import multiclass_nms as _nms
+
+    decoded = _box_coder(prior_box, prior_box_var, loc,
+                         code_type="decode_center_size")
+    scores_t = _nn.transpose(scores, perm=[0, 2, 1])  # [B, C, P]
+    return _nms(decoded, scores_t, score_threshold=score_threshold,
+                nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                nms_threshold=nms_threshold,
+                background_label=background_label)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """SSD prediction head (reference detection.py multi_box_head): per
+    feature map, conv loc/conf predictions + prior boxes, concatenated."""
+    from . import nn as _nn
+    from .detection import prior_box as _prior_box
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_layer - 2))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes, variances = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        step_sz = ([steps[i], steps[i]] if steps
+                   else [step_w[i] if step_w else 0.0,
+                         step_h[i] if step_h else 0.0])
+        box, var = _prior_box(
+            x, image, [mins] if not isinstance(mins, list) else mins,
+            [maxs] if maxs and not isinstance(maxs, list) else maxs,
+            list(ar) if isinstance(ar, (list, tuple)) else [ar],
+            variance=list(variance), flip=flip, clip=clip,
+            steps=step_sz, offset=offset)
+        box = _nn.reshape(box, [-1, 4])
+        var = _nn.reshape(var, [-1, 4])
+        num_boxes = box.shape[0]
+        loc = _nn.conv2d(x, num_boxes // (x.shape[2] * x.shape[3]) * 4,
+                         kernel_size, stride, pad)
+        loc = _nn.transpose(loc, perm=[0, 2, 3, 1])
+        loc = _nn.reshape(loc, [loc.shape[0], -1, 4])
+        conf = _nn.conv2d(
+            x, num_boxes // (x.shape[2] * x.shape[3]) * num_classes,
+            kernel_size, stride, pad)
+        conf = _nn.transpose(conf, perm=[0, 2, 3, 1])
+        conf = _nn.reshape(conf, [conf.shape[0], -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes.append(box)
+        variances.append(var)
+
+    mbox_locs = _nn.concat(locs, axis=1)
+    mbox_confs = _nn.concat(confs, axis=1)
+    boxes_cat = _nn.concat(boxes, axis=0)
+    vars_cat = _nn.concat(variances, axis=0)
+    return mbox_locs, mbox_confs, boxes_cat, vars_cat
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", name=None):
+    """Mean average precision metric (detection_map_op.cc) — host op:
+    per-class AP over NMS outputs [B, K, 6] vs padded gt
+    [B, Mg, 6] = (label, x1, y1, x2, y2, difficult)."""
+    from .nn import seq_len_var
+
+    helper = LayerHelper("detection_map", name=name)
+    m = helper.create_variable_for_type_inference(
+        "float32", shape=(1,), stop_gradient=True)
+    ins = {"DetectRes": [detect_res], "Label": [label]}
+    sl = seq_len_var(label)
+    if sl is not None:
+        ins["GtLen"] = [sl]
+    helper.append_op("detection_map", ins, {"MAP": [m]},
+                     {"class_num": class_num,
+                      "background_label": background_label,
+                      "overlap_threshold": overlap_threshold,
+                      "evaluate_difficult": evaluate_difficult,
+                      "ap_version": ap_version})
+    return m

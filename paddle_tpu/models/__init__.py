@@ -1,6 +1,7 @@
 """Model zoo mirroring the reference's benchmark/test model set
 (benchmark/fluid/models/ + dist_transformer.py + dist_ctr.py)."""
 from . import (  # noqa: F401
+    book,
     deepfm,
     machine_translation,
     mnist,

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.registry import register
@@ -232,3 +233,331 @@ def _multiclass_nms(ctx, ins, attrs):
 
     outs, counts = jax.vmap(per_image)(bboxes, scores)
     return {"Out": [outs], "NmsRoisNum": [counts]}
+
+
+# ---------------------------------------------------------------------------
+# detection tail: anchors, target assignment, hard-example mining, RPN,
+# polygon transform (anchor_generator_op.cc, target_assign_op.cc,
+# mine_hard_examples_op.cc, rpn_target_assign_op.cc,
+# polygon_box_transform_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("anchor_generator", no_grad_slots=("Input",))
+def _anchor_generator(ctx, ins, attrs):
+    """Faster-RCNN anchors (anchor_generator_op.h): per cell, one anchor
+    per (aspect_ratio, anchor_size); boxes centered on the stride grid."""
+    x = ins["Input"][0]  # [N, C, H, W]
+    H, W = x.shape[2], x.shape[3]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ratios = [float(r) for r in attrs["aspect_ratios"]]
+    stride = [float(s) for s in attrs["stride"]]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    sw, sh = stride[0], stride[1]
+
+    x_ctr = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1)
+    y_ctr = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1)
+    dims = []
+    for ar in ratios:
+        area = sw * sh
+        base_w = round(float(np.sqrt(area / ar)))
+        base_h = round(float(base_w * ar))
+        for size in sizes:
+            dims.append((size / sw * base_w, size / sh * base_h))
+    wh = jnp.asarray(dims, jnp.float32)  # [A, 2]
+    A = wh.shape[0]
+    xc = jnp.broadcast_to(x_ctr[None, :, None], (H, W, A))
+    yc = jnp.broadcast_to(y_ctr[:, None, None], (H, W, A))
+    aw = jnp.broadcast_to(wh[None, None, :, 0], (H, W, A))
+    ah = jnp.broadcast_to(wh[None, None, :, 1], (H, W, A))
+    anchors = jnp.stack([xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                         xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, A, 4))
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+@register("polygon_box_transform", no_grad_slots=())
+def _polygon_box_transform(ctx, ins, attrs):
+    """polygon_box_transform_op.cc: even channels x-offsets -> 4*w - in,
+    odd channels y-offsets -> 4*h - in (EAST geometry decode)."""
+    x = ins["Input"][0]
+    n, c, h, w = x.shape
+    wgrid = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4
+    hgrid = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4
+    even = (jnp.arange(c) % 2 == 0).reshape(1, c, 1, 1)
+    return {"Output": [jnp.where(even, wgrid - x, hgrid - x)]}
+
+
+@register("target_assign",
+          no_grad_slots=("MatchIndices", "NegIndices", "XLen", "NegLen"))
+def _target_assign(ctx, ins, attrs):
+    """target_assign_op.cc on the padded contract: X [B, M, K] per-image
+    gt entities, MatchIndices [B, P] (-1 = background).  Out[b, p] =
+    X[b, MatchIndices[b, p]] (weight 1) or mismatch_value (weight 0);
+    rows listed in NegIndices get weight 1 back."""
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0].astype(jnp.int32)  # [B, P]
+    mismatch = attrs.get("mismatch_value", 0)
+    B, P = match.shape
+    K = x.shape[-1]
+    safe = jnp.maximum(match, 0)
+    gathered = jnp.take_along_axis(x, safe[..., None], axis=1)
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch, x.dtype))
+    weight = matched.astype(jnp.float32)
+    if ins.get("NegIndices"):
+        neg = ins["NegIndices"][0].reshape(B, -1).astype(jnp.int32)  # [B, Nn]
+        if ins.get("NegLen"):
+            nl = ins["NegLen"][0].reshape(B, 1)
+            nvalid = jnp.arange(neg.shape[1])[None, :] < nl
+        else:
+            nvalid = neg >= 0
+        wflat = weight[..., 0]
+        wflat = wflat.at[
+            jnp.broadcast_to(jnp.arange(B)[:, None], neg.shape),
+            jnp.maximum(neg, 0),
+        ].max(jnp.where(nvalid, 1.0, 0.0))
+        weight = wflat[..., None]
+    return {"Out": [out], "OutWeight": [weight]}
+
+
+@register("mine_hard_examples",
+          no_grad_slots=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"))
+def _mine_hard_examples(ctx, ins, attrs):
+    """mine_hard_examples_op.cc (max_negative mode): per image, pick the
+    top-loss negative anchors, capped at neg_pos_ratio * #positives.
+    Outputs NegIndices [B, Mn] padded with -1 + UpdatedMatchIndices."""
+    cls_loss = ins["ClsLoss"][0]
+    loc_loss = ins["LocLoss"][0] if ins.get("LocLoss") else None
+    match = ins["MatchIndices"][0].astype(jnp.int32)  # [B, P]
+    dist = ins["MatchDist"][0]
+    ratio = float(attrs.get("neg_pos_ratio", 1.0))
+    thr = float(attrs.get("neg_dist_threshold", 0.5))
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    loss = loss.reshape(match.shape)
+    B, P = match.shape
+
+    eligible = (match == -1) & (dist.reshape(B, P) < thr)
+    masked_loss = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-masked_loss, axis=1)  # desc by loss
+    npos = jnp.sum((match >= 0), axis=1, keepdims=True)
+    quota = jnp.minimum((npos * ratio).astype(jnp.int32),
+                        jnp.sum(eligible, axis=1, keepdims=True))
+    take = jnp.arange(P)[None, :] < quota
+    neg_idx = jnp.where(take, order, -1)
+    # negatives keep match -1; everything is already -1 there
+    return {"NegIndices": [neg_idx.astype(jnp.int64)],
+            "UpdatedMatchIndices": [match.astype(jnp.int32)]}
+
+
+@register("rpn_target_assign",
+          no_grad_slots=("DistMat", "Anchor", "GtBox"))
+def _rpn_target_assign(ctx, ins, attrs):
+    """rpn_target_assign_op.cc (simplified deterministic variant): per
+    image, anchors with IoU > pos_threshold (plus the best anchor per gt)
+    are positives, IoU < neg_threshold negatives; returns padded index
+    lists + target labels.  The reference subsamples randomly to
+    rpn_batch_size_per_im; the TPU redesign keeps the deterministic
+    top-loss ordering (fixed shapes) and caps at the same budget."""
+    dist = ins["DistMat"][0]  # [M anchors, G gt] IoU
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    M, G = dist.shape
+    best_gt = jnp.argmax(dist, axis=1)            # [M]
+    best_iou = jnp.max(dist, axis=1)
+    best_anchor = jnp.argmax(dist, axis=0)        # [G]
+    is_best = jnp.zeros((M,), bool).at[best_anchor].set(True)
+    pos = (best_iou >= pos_thr) | is_best
+    neg = (best_iou < neg_thr) & ~pos
+
+    fg_cap = int(batch * fg_frac)
+    pos_order = jnp.argsort(-jnp.where(pos, best_iou, -jnp.inf))
+    pos_take = jnp.arange(M) < jnp.minimum(jnp.sum(pos), fg_cap)
+    loc_idx = jnp.where(pos_take, pos_order, -1)[:fg_cap]
+    neg_order = jnp.argsort(-jnp.where(neg, 1.0 - best_iou, -jnp.inf))
+    neg_cap = batch - fg_cap
+    neg_take = jnp.arange(M) < jnp.minimum(jnp.sum(neg), neg_cap)
+    neg_idx = jnp.where(neg_take, neg_order, -1)[:neg_cap]
+    score_idx = jnp.concatenate([loc_idx, neg_idx])
+    tgt_lbl = jnp.concatenate([
+        jnp.where(loc_idx >= 0, 1, -1), jnp.where(neg_idx >= 0, 0, -1)])
+    return {"LocationIndex": [loc_idx.astype(jnp.int64)],
+            "ScoreIndex": [score_idx.astype(jnp.int64)],
+            "TargetLabel": [tgt_lbl.astype(jnp.int64)],
+            "TargetAnchorGt": [best_gt.astype(jnp.int64)]}
+
+
+@register("ssd_loss",
+          no_grad_slots=("GtBox", "GtLabel", "GtLen", "PriorBox",
+                         "PriorBoxVar"))
+def _ssd_loss(ctx, ins, attrs):
+    """Fused SSD multibox loss (the 5-step algorithm of the reference's
+    layers/detection.py ssd_loss composition, detection/*_op.cc kernels):
+    match -> confidence loss -> max_negative hard mining -> target
+    assignment -> weighted smooth-L1 + softmax-xent.  One XLA region
+    instead of the reference's 14-op graph — same math on padded
+    [B, Mg, ...] ground truth with a GtLen mask.
+    Output Loss [B, P]."""
+    loc = ins["Loc"][0].astype(jnp.float32)        # [B, P, 4]
+    conf = ins["Conf"][0].astype(jnp.float32)      # [B, P, C]
+    gt_box = ins["GtBox"][0].astype(jnp.float32)   # [B, Mg, 4]
+    gt_label = ins["GtLabel"][0].reshape(gt_box.shape[0], -1)  # [B, Mg]
+    prior = ins["PriorBox"][0].astype(jnp.float32)  # [P, 4]
+    pvar = (ins["PriorBoxVar"][0].astype(jnp.float32)
+            if ins.get("PriorBoxVar") else None)
+    gt_len = (ins["GtLen"][0] if ins.get("GtLen")
+              else jnp.full((gt_box.shape[0],), gt_box.shape[1]))
+    bg = int(attrs.get("background_label", 0))
+    overlap_thr = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_overlap = float(attrs.get("neg_overlap", 0.5))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    normalize = bool(attrs.get("normalize", True))
+    B, P, C = conf.shape
+    Mg = gt_box.shape[1]
+
+    def encode(gt):  # box_coder encode_center_size against priors
+        pw = prior[:, 2] - prior[:, 0] + 1.0
+        ph = prior[:, 3] - prior[:, 1] + 1.0
+        px = prior[:, 0] + pw * 0.5
+        py = prior[:, 1] + ph * 0.5
+        gw = gt[..., 2] - gt[..., 0] + 1.0
+        gh = gt[..., 3] - gt[..., 1] + 1.0
+        gx = gt[..., 0] + gw * 0.5
+        gy = gt[..., 1] + gh * 0.5
+        t = jnp.stack([(gx - px) / pw, (gy - py) / ph,
+                       jnp.log(jnp.maximum(gw / pw, 1e-8)),
+                       jnp.log(jnp.maximum(gh / ph, 1e-8))], axis=-1)
+        if pvar is not None:
+            t = t / pvar
+        return t
+
+    def per_image(loc_i, conf_i, gt_i, lab_i, n_gt):
+        valid_gt = jnp.arange(Mg) < n_gt
+        iou = _iou_matrix(gt_i, prior)             # [Mg, P]
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        # per-prediction match + bipartite guarantee for each gt
+        best_gt = jnp.argmax(iou, axis=0)          # [P]
+        best_iou = jnp.max(iou, axis=0)
+        match = jnp.where(best_iou > overlap_thr, best_gt, -1)
+        best_prior = jnp.argmax(iou, axis=1)       # [Mg]
+        match = match.at[best_prior].set(
+            jnp.where(valid_gt, jnp.arange(Mg), match[best_prior]))
+        pos = match >= 0
+
+        safe = jnp.maximum(match, 0)
+        tgt_label = jnp.where(pos, lab_i[safe].astype(jnp.int32), bg)
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        conf_loss = -jnp.take_along_axis(
+            logp, tgt_label[:, None], axis=1)[:, 0]  # [P]
+
+        # max_negative mining
+        eligible = (~pos) & (best_iou < neg_overlap)
+        npos = jnp.sum(pos)
+        quota = jnp.minimum((npos * neg_ratio).astype(jnp.int32),
+                            jnp.sum(eligible))
+        order = jnp.argsort(-jnp.where(eligible, conf_loss, -jnp.inf))
+        neg_sel = jnp.zeros((P,), bool).at[order].set(
+            jnp.arange(P) < quota)
+        neg_sel = neg_sel & eligible
+
+        tgt_box = encode(gt_i[safe])               # [P, 4]
+        d = loc_i - tgt_box
+        ad = jnp.abs(d)
+        sl1 = jnp.sum(jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5), axis=-1)
+        loss = (conf_w * conf_loss * (pos | neg_sel)
+                + loc_w * sl1 * pos)
+        if normalize:
+            loss = loss / jnp.maximum(npos.astype(jnp.float32), 1.0)
+        return loss
+
+    loss = jax.vmap(per_image)(loc, conf, gt_box, gt_label,
+                               gt_len.astype(jnp.int32))
+    return {"Loss": [loss]}
+
+
+from ..core.host_ops import register_host_op
+
+
+@register_host_op("detection_map")
+def _detection_map(exe, program, op, scope):
+    """detection_map_op.cc (host): mean AP of NMS outputs vs ground truth.
+    DetectRes [B, K, 6] = (label, score, x1, y1, x2, y2), -1 label = pad;
+    Label [B, Mg, 6] = (label, x1, y1, x2, y2, difficult) with GtLen."""
+    det = np.asarray(scope.find_var(op.input("DetectRes")[0]))
+    gt = np.asarray(scope.find_var(op.input("Label")[0]))
+    gt_len = None
+    if op.input("GtLen"):
+        gt_len = np.asarray(scope.find_var(op.input("GtLen")[0]))
+    class_num = op.attr("class_num")
+    bg = op.attr("background_label", 0)
+    thr = op.attr("overlap_threshold", 0.5)
+    eval_diff = op.attr("evaluate_difficult", True)
+    version = op.attr("ap_version", "integral")
+    B = det.shape[0]
+
+    def iou(a, b):
+        ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+        ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+        iw = max(0.0, ix2 - ix1); ih = max(0.0, iy2 - iy1)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    aps = []
+    for c in range(class_num):
+        if c == bg:
+            continue
+        # gather per-image gt and detections of class c
+        scores, tps, n_gt = [], [], 0
+        for b in range(B):
+            m = int(gt_len[b]) if gt_len is not None else gt.shape[1]
+            gts = [g for g in gt[b, :m] if int(g[0]) == c]
+            if not eval_diff:
+                n_gt += sum(1 for g in gts if not g[5])
+            else:
+                n_gt += len(gts)
+            used = [False] * len(gts)
+            dets = [d for d in det[b] if int(d[0]) == c]
+            dets.sort(key=lambda d: -d[1])
+            for d in dets:
+                best, best_iou = -1, thr
+                for gi, g in enumerate(gts):
+                    v = iou(d[2:6], g[1:5])
+                    if v >= best_iou and not used[gi]:
+                        best, best_iou = gi, v
+                scores.append(float(d[1]))
+                if best >= 0:
+                    used[best] = True
+                    tps.append(1)
+                else:
+                    tps.append(0)
+        if n_gt == 0:
+            continue
+        order = np.argsort(-np.asarray(scores)) if scores else []
+        tp_sorted = np.asarray(tps, float)[order] if scores else np.array([])
+        tp_cum = np.cumsum(tp_sorted)
+        fp_cum = np.cumsum(1.0 - tp_sorted)
+        rec = tp_cum / n_gt
+        prec = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+        if version == "11point":
+            ap = 0.0
+            for t in np.arange(0.0, 1.01, 0.1):
+                p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                ap += p / 11.0
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for r, p in zip(rec, prec):
+                ap += p * (r - prev_r)
+                prev_r = r
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    scope.set_var(op.output("MAP")[0], np.asarray([m], np.float32))

@@ -1,0 +1,191 @@
+"""Detection tail: anchors, target assignment, hard mining, RPN labels,
+SSD loss, detection_output, detection_map (reference detection/*_op.cc +
+layers/detection.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_harness import run_forward
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+rng = np.random.RandomState(11)
+
+
+def test_anchor_generator_matches_reference_math():
+    x = np.zeros((1, 8, 2, 2), "float32")
+    (anchors, variances) = run_forward(
+        lambda v: list(fluid.layers.anchor_generator(
+            v["x"], anchor_sizes=[64.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])), {"x": x})
+    assert anchors.shape == (2, 2, 1, 4)
+    # cell (0,0): ctr = 0.5*15 = 7.5; base = round(sqrt(256)) = 16;
+    # anchor = 64/16*16 = 64 wide -> [7.5-31.5, ..., 7.5+31.5]
+    np.testing.assert_allclose(anchors[0, 0, 0], [-24, -24, 39, 39])
+    np.testing.assert_allclose(variances[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_polygon_box_transform():
+    x = rng.randn(1, 4, 3, 3).astype("float32")
+    (out,) = run_forward(
+        lambda v: fluid.layers.polygon_box_transform(v["x"]), {"x": x})
+    w = np.arange(3) * 4
+    np.testing.assert_allclose(out[0, 0], w[None, :] - x[0, 0], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], (np.arange(3) * 4)[:, None] - x[0, 1],
+                               rtol=1e-6)
+
+
+def test_target_assign():
+    x = rng.randn(2, 3, 4).astype("float32")  # [B, M, K]
+    match = np.array([[0, -1, 2, 1], [1, 1, -1, 0]], "int32")
+    (out, w) = run_forward(
+        lambda v: list(fluid.layers.target_assign(v["x"], v["m"],
+                                                  mismatch_value=0)),
+        {"x": x, "m": match})
+    np.testing.assert_allclose(out[0, 0], x[0, 0])
+    np.testing.assert_allclose(out[0, 1], 0)
+    np.testing.assert_allclose(out[1, 3], x[1, 0])
+    np.testing.assert_array_equal(w.reshape(2, 4),
+                                  [[1, 0, 1, 1], [1, 1, 0, 1]])
+
+
+def test_mine_hard_examples_max_negative():
+    # 1 positive, quota = 2 negatives by loss among eligible (dist < thr)
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.7, 0.3]], "float32")
+    match = np.array([[0, -1, -1, -1, -1]], "int32")
+    dist = np.array([[0.8, 0.1, 0.2, 0.9, 0.1]], "float32")
+    (neg, upd) = run_forward(
+        lambda v: list(fluid.layers.mine_hard_examples(
+            v["c"], v["m"], v["d"], neg_pos_ratio=2.0,
+            neg_dist_threshold=0.5)),
+        {"c": cls_loss, "m": match, "d": dist})
+    picked = set(int(i) for i in neg[0] if i >= 0)
+    assert picked == {1, 2}, neg  # idx 3 ineligible (dist .9), top-2 losses
+
+
+def test_rpn_target_assign_shapes():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [0, 0, 9, 9], [100, 100, 110, 110]], "float32")
+    gt = np.array([[0, 0, 10, 10], [21, 21, 29, 29]], "float32")
+    loc = np.zeros((4, 4), "float32")
+    scores = np.zeros((4, 1), "float32")
+    (loc_idx, score_idx, tgt, agt) = run_forward(
+        lambda v: list(fluid.layers.rpn_target_assign(
+            v["l"], v["s"], v["a"], v["g"], rpn_batch_size_per_im=4,
+            fg_fraction=0.5)),
+        {"l": loc, "s": scores, "a": anchors, "g": gt})
+    pos = set(int(i) for i in loc_idx if i >= 0)
+    # anchors 0 (IoU 1.0), 2 (IoU .81) outrank 1 under the fg cap of 2;
+    # far-away anchor 3 must never be positive
+    assert pos <= {0, 1, 2} and 0 in pos and 3 not in pos
+    neg = set(int(i) for i, t in zip(score_idx, tgt) if t == 0)
+    assert 3 in neg
+    assert int(agt[0]) == 0 and int(agt[1]) == 1
+
+
+def test_ssd_loss_runs_and_penalizes_mismatch():
+    B, P, C, Mg = 2, 6, 3, 2
+    prior = np.array([[i * 10, 0, i * 10 + 9, 9] for i in range(P)],
+                     "float32")
+    gt_box = np.zeros((B, Mg, 4), "float32")
+    gt_box[:, 0] = [0, 0, 9, 9]       # matches prior 0
+    gt_box[:, 1] = [30, 0, 39, 9]     # matches prior 3
+    gt_label = np.full((B, Mg, 1), 1, "int64")
+    gt_len = np.full((B,), 2, "int64")
+    loc = np.zeros((B, P, 4), "float32")
+
+    def build(conf_np):
+        def f(v):
+            return fluid.layers.reduce_mean(fluid.layers.ssd_loss(
+                v["loc"], v["conf"], v["gt"], v["lab"], v["pb"]))
+        return f
+
+    good_conf = np.full((B, P, C), -4.0, "float32")
+    good_conf[:, :, 0] = 4.0          # background everywhere...
+    good_conf[:, 0, 0] = -4.0
+    good_conf[:, 0, 1] = 4.0          # ...but class 1 at matched priors
+    good_conf[:, 3, 0] = -4.0
+    good_conf[:, 3, 1] = 4.0
+    bad_conf = -good_conf
+
+    feed = {"loc": loc, "gt": gt_box, "lab": gt_label, "pb": prior,
+            "gt@LEN": gt_len}
+    prog_feed_good = dict(feed, conf=good_conf)
+    prog_feed_bad = dict(feed, conf=bad_conf)
+
+    def run(feed):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup), unique_name.guard():
+            gb = prog.global_block
+            vs = {}
+            for name, arr in feed.items():
+                if name.endswith("@LEN"):
+                    continue
+                v = gb.create_var(name=name, shape=arr.shape,
+                                  dtype=str(arr.dtype), persistable=False,
+                                  stop_gradient=True)
+                vs[name] = v
+            ln = gb.create_var(name="gt@LEN", shape=(B,), dtype="int64",
+                               stop_gradient=True)
+            gb.seq_len_map["gt"] = "gt@LEN"
+            out = fluid.layers.reduce_mean(fluid.layers.ssd_loss(
+                vs["loc"], vs["conf"], vs["gt"], vs["lab"], vs["pb"]))
+        scope, exe = Scope(), Executor()
+        with scope_guard(scope):
+            exe.run(startup)
+            r, = exe.run(prog, feed=feed, fetch_list=[out.name])
+        return float(r)
+
+    good = run(prog_feed_good)
+    bad = run(prog_feed_bad)
+    assert good < bad, (good, bad)
+
+
+def test_detection_output_and_map():
+    B, P, C = 1, 4, 3
+    prior = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                      [40, 40, 50, 50], [60, 60, 70, 70]], "float32")
+    pvar = np.full((P, 4), 0.1, "float32")
+    loc = np.zeros((B, P, 4), "float32")
+    scores = np.zeros((B, P, C), "float32")
+    scores[0, :, 0] = 0.1
+    scores[0, 0, 1] = 0.9   # one confident class-1 det at prior 0
+    scores[0, 1, 2] = 0.8   # one class-2 det at prior 1
+
+    def build(v):
+        out, num = fluid.layers.detection_output(
+            v["loc"], v["s"], v["pb"], v["pv"], score_threshold=0.5,
+            nms_top_k=4, keep_top_k=4)
+        return [out, num]
+
+    (out, num) = run_forward(build, {"loc": loc, "s": scores, "pb": prior,
+                                     "pv": pvar})
+    labels = set(int(l) for l in out[0, :, 0] if l >= 0)
+    assert labels == {1, 2}, out
+
+    # detection_map: perfect detections -> mAP 1.0
+    det = np.full((1, 4, 6), -1.0, "float32")
+    det[0, 0] = [1, 0.9, 0, 0, 10, 10]
+    det[0, 1] = [2, 0.8, 20, 20, 30, 30]
+    gt = np.zeros((1, 2, 6), "float32")
+    gt[0, 0] = [1, 0, 0, 10, 10, 0]
+    gt[0, 1] = [2, 20, 20, 30, 30, 0]
+    gt_len = np.array([2], "int64")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        gb = prog.global_block
+        d = gb.create_var(name="det", shape=det.shape, dtype="float32",
+                          stop_gradient=True)
+        g = gb.create_var(name="gt", shape=gt.shape, dtype="float32",
+                          stop_gradient=True)
+        gb.create_var(name="gt@LEN", shape=(1,), dtype="int64",
+                      stop_gradient=True)
+        gb.seq_len_map["gt"] = "gt@LEN"
+        m = fluid.layers.detection_map(d, g, class_num=3)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        r, = exe.run(prog, feed={"det": det, "gt": gt, "gt@LEN": gt_len},
+                     fetch_list=[m.name])
+    np.testing.assert_allclose(float(np.asarray(r).reshape(())), 1.0)

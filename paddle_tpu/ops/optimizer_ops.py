@@ -240,3 +240,47 @@ def _lars_momentum(ctx, ins, attrs):
     local_lr = lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + 1e-12)
     v_new = mu * v + local_lr * (gf + lars_wd * pf)
     return {"ParamOut": [(pf - v_new).astype(p.dtype)], "VelocityOut": [v_new]}
+
+
+@register("average_accumulates",
+          no_grad_slots=("param", "in_sum_1", "in_sum_2", "in_sum_3",
+                         "in_num_accumulates", "in_old_num_accumulates",
+                         "in_num_updates"))
+def _average_accumulates(ctx, ins, attrs):
+    """average_accumulates_op.h: sliding-window parameter sums for
+    ModelAverage.  sum_1 accumulates every step; every 16384 updates it
+    rolls into sum_2 (precision); when the window closes (num_accumulates
+    >= min(max_window, num_updates*window_rate)) everything rolls into
+    sum_3 and the window restarts."""
+    k_max = 16384
+    param = ins["param"][0]
+    s1, s2, s3 = ins["in_sum_1"][0], ins["in_sum_2"][0], ins["in_sum_3"][0]
+    num_acc = ins["in_num_accumulates"][0].reshape(()).astype(jnp.int64)
+    old_acc = ins["in_old_num_accumulates"][0].reshape(()).astype(jnp.int64)
+    num_upd = ins["in_num_updates"][0].reshape(()).astype(jnp.int64)
+    window = float(attrs.get("average_window", 0.0))
+    max_w = int(attrs.get("max_average_window", 2 ** 62))
+    min_w = int(attrs.get("min_average_window", 10000))
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + param.astype(s1.dtype)
+
+    roll_precision = (num_upd % k_max) == 0
+    s2 = jnp.where(roll_precision, s2 + s1, s2)
+    s1 = jnp.where(roll_precision, 0.0, s1)
+
+    close = (num_acc >= min_w) & (
+        num_acc >= jnp.minimum(
+            jnp.asarray(max_w, jnp.int64),
+            (num_upd.astype(jnp.float32) * window).astype(jnp.int64)))
+    s3 = jnp.where(close, s1 + s2 + s3 * 0, s3)
+    s1 = jnp.where(close, 0.0, s1)
+    s2 = jnp.where(close, 0.0, s2)
+    old_acc = jnp.where(close, num_acc, old_acc)
+    num_acc = jnp.where(close, 0, num_acc)
+
+    return {"out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
+            "out_num_accumulates": [num_acc.reshape(1)],
+            "out_old_num_accumulates": [old_acc.reshape(1)],
+            "out_num_updates": [num_upd.reshape(1)]}

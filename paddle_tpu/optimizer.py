@@ -8,6 +8,7 @@ computations with donated buffers (see ops/optimizer_ops.py).
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from .core import unique_name
@@ -403,3 +404,114 @@ Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 LarsMomentum = LarsMomentumOptimizer
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (reference optimizer.py
+    ModelAverage + average_accumulates_op.h).
+
+    Appends an ``average_accumulates`` op per trainable parameter to the
+    current main program; ``apply()`` swaps the averaged values in (with
+    backup), ``restore()`` swaps them back::
+
+        opt.minimize(loss)
+        model_average = fluid.optimizer.ModelAverage(0.15)
+        ...train...
+        with model_average.apply(exe):
+            evaluate()
+    """
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization=regularization, name=name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        program = default_main_program()
+        block = program.global_block
+        self.params_grads = [
+            (p, p) for p in block.all_parameters() if p.trainable]
+        with program.op_role_guard(OpRole.Optimize):
+            for param, _ in self.params_grads:
+                self._append_average_accumulate_op(block, param)
+        self._build_apply_restore()
+
+    def _append_average_accumulate_op(self, block, param):
+        sum_1 = self._add_accumulator("sum_1", param)
+        sum_2 = self._add_accumulator("sum_2", param)
+        sum_3 = self._add_accumulator("sum_3", param)
+        num_acc = self._add_accumulator("num_accumulates", param,
+                                        dtype="int64", shape=[1])
+        old_acc = self._add_accumulator("old_num_accumulates", param,
+                                        dtype="int64", shape=[1])
+        num_upd = self._add_accumulator("num_updates", param,
+                                        dtype="int64", shape=[1])
+        self._opt_op(
+            block, "average_accumulates",
+            {"param": [param], "in_sum_1": [sum_1], "in_sum_2": [sum_2],
+             "in_sum_3": [sum_3], "in_num_accumulates": [num_acc],
+             "in_old_num_accumulates": [old_acc],
+             "in_num_updates": [num_upd]},
+            {"out_sum_1": [sum_1], "out_sum_2": [sum_2],
+             "out_sum_3": [sum_3], "out_num_accumulates": [num_acc],
+             "out_old_num_accumulates": [old_acc],
+             "out_num_updates": [num_upd]},
+            {"average_window": self.average_window,
+             "min_average_window": self.min_average_window,
+             "max_average_window": self.max_average_window})
+
+    def _build_apply_restore(self):
+        from . import layers
+        from .core.program import Program, program_guard
+
+        def mirror(block, var):
+            return block.create_var(
+                name=var.name, shape=var.shape, dtype=var.dtype,
+                persistable=True)
+
+        self.apply_program = Program()
+        self.restore_program = Program()
+        with program_guard(self.apply_program, Program()):
+            block = self.apply_program.global_block
+            for param, _ in self.params_grads:
+                p = mirror(block, param)
+                backup = block.create_var(
+                    name=param.name + "@BACKUP", shape=param.shape,
+                    dtype=param.dtype, persistable=True)
+                block.append_op("assign", {"X": [p.name]},
+                                {"Out": [backup.name]}, {})
+                accs = [mirror(block, self._get_accumulator(n, param))
+                        for n in ("sum_1", "sum_2", "sum_3")]
+                total = layers.sums([
+                    mirror(block,
+                           self._get_accumulator("num_accumulates", param)),
+                    mirror(block, self._get_accumulator(
+                        "old_num_accumulates", param))])
+                cnt = layers.cast(total, param.dtype)
+                ssum = layers.sums(accs)
+                avg = layers.elementwise_div(
+                    ssum, layers.elementwise_max(
+                        cnt, layers.fill_constant([1], param.dtype, 1.0)))
+                block.append_op("assign", {"X": [avg.name]},
+                                {"Out": [p.name]}, {})
+        with program_guard(self.restore_program, Program()):
+            block = self.restore_program.global_block
+            for param, _ in self.params_grads:
+                p = mirror(block, param)
+                backup = block.create_var(
+                    name=param.name + "@BACKUP", shape=param.shape,
+                    dtype=param.dtype, persistable=True)
+                block.append_op("assign", {"X": [backup.name]},
+                                {"Out": [p.name]}, {})
+
+    @contextmanager
+    def apply(self, executor, need_restore=True):
+        executor.run(self.apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        executor.run(self.restore_program)

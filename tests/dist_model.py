@@ -70,3 +70,24 @@ def free_ports(n):
     for s in socks:
         s.close()
     return ports
+
+
+def retry_flaky(times=2):
+    """Re-run a socket-based test on failure: free_ports() is
+    bind-to-0-then-release, so a parallel process can steal the port
+    between release and the pserver's bind (rare; the window spans jit
+    compiles).  Each retry picks fresh ports."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            last = None
+            for _ in range(times + 1):
+                try:
+                    return fn(*a, **kw)
+                except Exception as e:  # noqa: BLE001 — retry everything
+                    last = e
+            raise last
+        return wrapper
+    return deco

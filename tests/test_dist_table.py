@@ -12,7 +12,7 @@ from paddle_tpu.core.executor import Executor, Scope
 from paddle_tpu.core.program import Program, program_guard
 from paddle_tpu.distributed import notify_complete, transport
 
-from dist_model import free_ports
+from dist_model import retry_flaky, free_ports
 
 VOCAB, DIM = 64, 8
 N_STEPS = 4
@@ -65,6 +65,7 @@ def run_local(optimizer="sgd"):
 
 
 @pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+@retry_flaky()
 def test_dist_table_matches_local_sparse(optimizer):
     """2 trainers × sharded table across 2 pservers == local sparse run."""
     endpoints = [f"127.0.0.1:{p}" for p in free_ports(2)]
@@ -140,6 +141,7 @@ def test_dist_table_matches_local_sparse(optimizer):
     np.testing.assert_allclose(done["table"], want, rtol=3e-4, atol=3e-5)
 
 
+@retry_flaky()
 def test_trainer_program_uses_prefetch():
     endpoints = ["127.0.0.1:7191", "127.0.0.1:7192"]
     prog, startup, loss = build(distributed=True)

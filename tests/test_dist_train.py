@@ -19,7 +19,7 @@ import paddle_tpu as fluid
 from paddle_tpu.core.executor import Executor, Scope
 from paddle_tpu.distributed import notify_complete
 
-from dist_model import batches, build, free_ports, param_values, run_local
+from dist_model import retry_flaky, batches, build, free_ports, param_values, run_local
 
 N_STEPS = 5
 
@@ -105,6 +105,7 @@ def _run_cluster(sync_mode=True, slice_var_up=False, optimizer="sgd",
 
 @pytest.mark.parametrize("slice_var_up", [False, True],
                          ids=["whole-var", "sliced"])
+@retry_flaky()
 def test_sync_pserver_matches_local(slice_var_up):
     """2 trainers × half batches + mean merge == local full batches."""
     results = _run_cluster(sync_mode=True, slice_var_up=slice_var_up)
@@ -117,6 +118,7 @@ def test_sync_pserver_matches_local(slice_var_up):
                 err_msg=f"trainer {tid} param {name}")
 
 
+@retry_flaky()
 def test_sync_pserver_with_lr_decay_matches_local():
     results = _run_cluster(sync_mode=True, decay=True)
     _, local_params = run_local(N_STEPS, decay=True)
@@ -126,6 +128,7 @@ def test_sync_pserver_with_lr_decay_matches_local():
                                    rtol=2e-4, atol=2e-5, err_msg=name)
 
 
+@retry_flaky()
 def test_async_pserver_trains():
     """Async mode: no barriers; losses must still go down."""
     results = _run_cluster(sync_mode=False)
@@ -134,6 +137,7 @@ def test_async_pserver_trains():
 
 
 @pytest.mark.slow
+@retry_flaky()
 def test_dist_subprocess_matches_local():
     """The test_dist_base.py pattern: 2 pservers + 2 trainers as real
     localhost processes; trainer params must match the local run."""
@@ -185,6 +189,7 @@ def test_dist_subprocess_matches_local():
 
 
 @pytest.mark.parametrize("backend", ["native", "python"])
+@retry_flaky()
 def test_sync_pserver_matches_local_on_both_transports(backend):
     """The C framed-TCP transport and the stdlib-socket fallback carry the
     same protocol: sync parity holds on either."""

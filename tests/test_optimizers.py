@@ -124,3 +124,42 @@ def test_global_norm_clip():
         after = np.asarray(scope.find_var(params[0].name))
     # clipped to tiny global norm → parameters barely move
     assert np.allclose(before, after, atol=1e-4)
+
+
+def test_model_average_applies_and_restores():
+    """ModelAverage (reference optimizer.py:1222 + average_accumulates_op):
+    after N identical steps the averaged parameter equals the mean of the
+    parameter trajectory; restore brings the live value back."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(
+            1.0, min_average_window=4, max_average_window=4)
+
+    rng = np.random.RandomState(0)
+    xb = rng.randn(8, 4).astype("float32")
+    yb = xb.sum(1, keepdims=True).astype("float32")
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        traj = []
+        for _ in range(4):
+            exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss.name])
+            traj.append(np.asarray(scope.find_var("w")).copy())
+        live = np.asarray(scope.find_var("w")).copy()
+        with ma.apply(exe):
+            applied = np.asarray(scope.find_var("w")).copy()
+        restored = np.asarray(scope.find_var("w")).copy()
+    expected_avg = np.mean(traj, axis=0)
+    np.testing.assert_allclose(applied, expected_avg, rtol=1e-5)
+    np.testing.assert_allclose(restored, live, rtol=1e-6)

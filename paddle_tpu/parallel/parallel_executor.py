@@ -99,6 +99,8 @@ class ParallelExecutor(Executor):
         self._dp_axis = "dp" if "dp" in self.mesh.axis_names else self.mesh.axis_names[0]
         self._placed: set = set()
         self._scaled_programs: Dict[int, Program] = {}
+        self._padded_batch: Optional[int] = None
+        self._trains_cache: Optional[bool] = None
         # multi-host: the mesh spans every process's devices (nccl2-mode
         # flat world, nccl_helper.h:105-120); each process contributes its
         # local slice of feeds/state via make_array_from_* below
@@ -108,9 +110,55 @@ class ParallelExecutor(Executor):
     def run(self, fetch_list=None, feed=None, feed_dict=None,
             return_numpy: bool = True, **kwargs):
         feed = feed if feed is not None else (feed_dict or {})
-        return super().run(
+        feed, true_batch = self._maybe_pad_partial_batch(feed)
+        outs = super().run(
             program=self._program, feed=feed, fetch_list=fetch_list,
             scope=self._scope, return_numpy=return_numpy)
+        if true_batch is not None:
+            outs = [o[:true_batch]
+                    if getattr(o, "ndim", 0) >= 1
+                    and o.shape[0] == self._padded_batch else o
+                    for o in outs]
+        return outs
+
+    def _maybe_pad_partial_batch(self, feed):
+        """Pad a last partial batch up to the dp multiple so the feeds
+        stay dp-sharded (the reference rebalanced uneven batches across
+        devices — details/data_balance_op_handle.cc; SPMD pads instead).
+
+        Only for fetch-only programs (no optimize-role ops): padding rows
+        through a training step would bias gradients, so those keep the
+        replicated fallback.  Fetch rows belonging to padding are sliced
+        off in run()."""
+        dp = self.mesh.shape[self._dp_axis]
+        batch_feeds = {k: v for k, v in feed.items()
+                       if getattr(np.asarray(v), "ndim", 0) >= 1}
+        sizes = {np.asarray(v).shape[0] for v in batch_feeds.values()}
+        if len(sizes) != 1:
+            return feed, None
+        (b,) = sizes
+        if b % dp == 0 or b == 0:
+            return feed, None
+        if self._program_trains():
+            return feed, None
+        pad_to = ((b + dp - 1) // dp) * dp
+        padded = dict(feed)
+        for k, v in batch_feeds.items():
+            arr = np.asarray(v)
+            reps = [(0, pad_to - b)] + [(0, 0)] * (arr.ndim - 1)
+            # repeat the last row (keeps values in-distribution for ops
+            # like softmax/CRF; padded rows are discarded on fetch)
+            padded[k] = np.concatenate(
+                [arr, np.repeat(arr[-1:], pad_to - b, axis=0)], axis=0)
+        self._padded_batch = pad_to
+        return padded, b
+
+    def _program_trains(self) -> bool:
+        if self._trains_cache is None:
+            self._trains_cache = any(
+                op.attr(OP_ROLE_ATTR, 0) & (OpRole.Optimize | OpRole.Backward)
+                for op in self._program.global_block.ops)
+        return self._trains_cache
 
     # -- placement hooks ---------------------------------------------------
     def _mesh(self):

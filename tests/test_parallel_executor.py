@@ -176,3 +176,34 @@ def test_sharding_rule_spec_longer_than_rank():
         yb = np.zeros((16, 1), "float32")
         (l,) = pe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
         assert np.isfinite(l)
+
+
+def test_partial_batch_inference_pads_to_dp():
+    """A last partial batch on a fetch-only program stays dp-sharded via
+    pad-and-slice (exact row-wise semantics) instead of replicating."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Scope, scope_guard, Executor
+    from paddle_tpu.core.program import Program, program_guard
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 5
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [6])
+        h = fluid.layers.fc(x, 8, act="relu",
+                            param_attr=fluid.ParamAttr(name="w1"))
+        out = fluid.layers.fc(h, 3, act="softmax",
+                              param_attr=fluid.ParamAttr(name="w2"))
+
+    scope = Scope()
+    with scope_guard(scope):
+        Executor().run(startup)
+        pe = fluid.ParallelExecutor(main_program=prog, scope=scope)
+        rng = np.random.RandomState(0)
+        xb = rng.randn(13, 6).astype("float32")  # 13 % 8 devices != 0
+        res, = pe.run(fetch_list=[out.name], feed={"x": xb})
+        ref, = Executor().run(prog, feed={"x": xb},
+                              fetch_list=[out.name])
+    assert res.shape == (13, 3)
+    np.testing.assert_allclose(np.asarray(res), np.asarray(ref),
+                               rtol=2e-5, atol=1e-6)

@@ -216,6 +216,39 @@ def bench_mnist():
     return {"images_per_sec": round(sps * B, 1)}
 
 
+def bench_flash_attention_long():
+    """Long-context attention: Pallas flash fwd+bwd at seq 8192 (XLA's
+    materialized-scores path fails to compile at this length on v5e —
+    flash is the only viable kernel; its O(block) memory is the
+    long-context story)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import flash_attention
+
+    B, H, T, D = 4, 8, 8192, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, None, True, None)
+                .astype(jnp.float32) ** 2).sum()
+
+    step = jax.jit(jax.grad(loss, (0, 1, 2)))
+    g = step(q, k, v)
+    float(np.asarray(g[0][0, 0, 0, 0]))
+    t0 = time.perf_counter()
+    for _ in range(6):
+        g = step(q, k, v)
+    float(np.asarray(g[0][0, 0, 0, 0]))
+    dt = (time.perf_counter() - t0) / 6
+    flops = 3.5 * 2 * B * H * T * T * D / 2  # causal fwd+bwd
+    return {"tokens_per_sec": round(B * T / dt, 1), "seq_len": T,
+            "tflops": round(flops / dt / 1e12, 1)}
+
+
 A100_RESNET50_IMG_S = 2500.0
 A100_TRANSFORMER_TOK_S = 50000.0
 
@@ -226,7 +259,8 @@ def main():
                      ("transformer_seq256", bench_transformer),
                      ("stacked_lstm", bench_stacked_lstm),
                      ("deepfm", bench_deepfm),
-                     ("mnist", bench_mnist)]:
+                     ("mnist", bench_mnist),
+                     ("flash_attention_seq8k", bench_flash_attention_long)]:
         try:
             configs[name] = fn()
         except Exception as e:  # a broken config must not hide the rest

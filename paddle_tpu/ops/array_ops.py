@@ -213,3 +213,9 @@ def _merge_lod_tensor(ctx, ins, attrs):
     mask = ins["Mask"][0].reshape(-1).astype(bool)
     m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
     return {"Out": [jnp.where(m, t, f)]}
+
+
+@register("lod_array_length", no_grad_slots=("ArrayLen",))
+def _lod_array_length(ctx, ins, attrs):
+    """lod_array_length_op.cc: written-slot count of a TensorArray."""
+    return {"Out": [ins["ArrayLen"][0].reshape(1).astype(jnp.int64)]}

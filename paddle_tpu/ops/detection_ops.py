@@ -561,3 +561,82 @@ def _detection_map(exe, program, op, scope):
         aps.append(ap)
     m = float(np.mean(aps)) if aps else 0.0
     scope.set_var(op.output("MAP")[0], np.asarray([m], np.float32))
+
+
+@register("generate_proposals",
+          no_grad_slots=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                         "Variances"))
+def _generate_proposals(ctx, ins, attrs):
+    """generate_proposals_op.cc (RPN): per image, top pre_nms_top_n
+    anchors by objectness, deltas decoded, clipped to the image, tiny
+    boxes masked, greedy NMS to post_nms_top_n.  Fixed-capacity padded
+    outputs: RpnRois [N, post_n, 4], RpnRoiProbs [N, post_n, 1],
+    RpnRoisNum [N]."""
+    scores = ins["Scores"][0].astype(jnp.float32)       # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0].astype(jnp.float32)   # [N, 4A, H, W]
+    im_info = ins["ImInfo"][0].astype(jnp.float32)      # [N, 3]
+    anchors = ins["Anchors"][0].astype(jnp.float32).reshape(-1, 4)
+    variances = ins["Variances"][0].astype(jnp.float32).reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thr = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    N = scores.shape[0]
+    A, H, W = scores.shape[1], scores.shape[2], scores.shape[3]
+    M = A * H * W
+    pre_n = min(pre_n, M)
+    post_n = min(post_n, pre_n)
+
+    def per_image(sc, dl, info):
+        s = sc.transpose(1, 2, 0).reshape(-1)           # [H*W*A]
+        d = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        anc = anchors.reshape(H * W * A, 4) if anchors.shape[0] == M \
+            else anchors
+        var = variances.reshape(H * W * A, 4) if variances.shape[0] == M \
+            else variances
+        top_s, top_i = lax.top_k(s, pre_n)
+        a = anc[top_i]
+        v = var[top_i]
+        t = d[top_i]
+        # decode (box_coder decode_center_size semantics)
+        aw = a[:, 2] - a[:, 0] + 1.0
+        ah = a[:, 3] - a[:, 1] + 1.0
+        ax = a[:, 0] + aw * 0.5
+        ay = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * t[:, 0] * aw + ax
+        cy = v[:, 1] * t[:, 1] * ah + ay
+        w = jnp.exp(jnp.minimum(v[:, 2] * t[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(v[:, 3] * t[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=1)
+        # clip to image
+        hmax, wmax = info[0] - 1.0, info[1] - 1.0
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, wmax), jnp.clip(boxes[:, 1], 0, hmax),
+            jnp.clip(boxes[:, 2], 0, wmax), jnp.clip(boxes[:, 3], 0, hmax),
+        ], axis=1)
+        # filter small
+        ms = min_size * info[2]
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                   & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        cand_s = jnp.where(keep_sz, top_s, -jnp.inf)
+        iou = _iou_matrix(boxes, boxes)
+
+        def body(keep, i):
+            sup = jnp.any(keep & (jnp.arange(pre_n) < i) & (iou[i] > nms_thr))
+            ok = jnp.isfinite(cand_s[i]) & ~sup
+            return keep.at[i].set(ok), None
+
+        keep, _ = lax.scan(body, jnp.zeros((pre_n,), bool),
+                           jnp.arange(pre_n))
+        sel_s = jnp.where(keep, cand_s, -jnp.inf)
+        fin_s, order = lax.top_k(sel_s, post_n)
+        fin_b = boxes[order]
+        valid = jnp.isfinite(fin_s)
+        return (jnp.where(valid[:, None], fin_b, 0.0),
+                jnp.where(valid, fin_s, 0.0)[:, None],
+                jnp.sum(valid).astype(jnp.int64))
+
+    rois, probs, counts = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs],
+            "RpnRoisNum": [counts]}

@@ -606,3 +606,70 @@ def _lstmp(ctx, ins, attrs):
         "LastH": [r_last],
         "LastC": [c_last],
     }
+
+
+@register("fusion_lstm", no_grad_slots=("SeqLen",))
+def _fusion_lstm(ctx, ins, attrs):
+    """fusion_lstm_op.cc: fc(x) + LSTM in one op (the CPU jit_kernel
+    fusion; on TPU one XLA region anyway).  X [B,T,M], WeightX [M,4D],
+    WeightH [D,4D], Bias [1,4D]; reuses the lstm scan lowering."""
+    x = ins["X"][0]
+    wx = ins["WeightX"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    xproj = jnp.einsum("btm,mf->btf", x, wx)
+    if bias is not None:
+        xproj = xproj + bias.reshape(1, 1, -1)
+    sub = {"Input": [xproj], "Weight": [ins["WeightH"][0]]}
+    for slot in ("H0", "C0", "SeqLen"):
+        if ins.get(slot):
+            sub[slot] = ins[slot]
+    out = _lstm(ctx, sub, attrs)
+    return {"Hidden": out["Hidden"], "Cell": out["Cell"],
+            "XX": [xproj]}
+
+
+@register("fusion_gru", no_grad_slots=("SeqLen",))
+def _fusion_gru(ctx, ins, attrs):
+    """fusion_gru_op.cc: fc(x) + GRU in one op; reuses the gru scan."""
+    x = ins["X"][0]
+    wx = ins["WeightX"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    xproj = jnp.einsum("btm,mf->btf", x, wx)
+    if bias is not None:
+        xproj = xproj + bias.reshape(1, 1, -1)
+    sub = {"Input": [xproj], "Weight": [ins["WeightH"][0]]}
+    for slot in ("H0", "SeqLen"):
+        if ins.get(slot):
+            sub[slot] = ins[slot]
+    out = _gru(ctx, sub, attrs)
+    return {"Hidden": out["Hidden"], "XX": [xproj]}
+
+
+@register("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx, ins, attrs):
+    """fused_elemwise_activation_op.cc: functor_list pairs like
+    ["elementwise_add", "relu"] / ["relu", "elementwise_add"] — binary op
+    and unary activation composed in one op (XLA fuses either way; the
+    op exists for graph parity with the reference's fusion passes)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    functors = [f.lower() for f in attrs["functor_list"]]
+    axis = attrs.get("axis", -1)
+
+    def binary(name, a, b):
+        if b.ndim < a.ndim and axis != -1:
+            b = b.reshape(b.shape + (1,) * (a.ndim - b.ndim - axis))
+        return {"elementwise_add": a + b, "elementwise_sub": a - b,
+                "elementwise_mul": a * b}[name]
+
+    def unary(name, a):
+        return {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+                "tanh": jnp.tanh, "scale": lambda v: v * attrs.get(
+                    "scale", 1.0)}[name](a)
+
+    if functors[0].startswith("elementwise"):
+        out = unary(functors[1], binary(functors[0], x, y))
+        inter = binary(functors[0], x, y)
+    else:
+        inter = unary(functors[0], y)
+        out = binary(functors[1], x, inter)
+    return {"Out": [out], "IntermediateOut": [inter]}

@@ -284,3 +284,36 @@ def _average_accumulates(ctx, ins, attrs):
             "out_num_accumulates": [num_acc.reshape(1)],
             "out_old_num_accumulates": [old_acc.reshape(1)],
             "out_num_updates": [num_upd.reshape(1)]}
+
+
+def _prox(prox_param, lr, l1, l2):
+    """Proximal step (proximal_gd_op.cc): soft-threshold by lr*l1 then
+    shrink by 1/(1+lr*l2)."""
+    return (jnp.sign(prox_param)
+            * jnp.maximum(jnp.abs(prox_param) - lr * l1, 0.0)
+            / (1.0 + lr * l2))
+
+
+@register("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins, jnp.float32)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+    return {"ParamOut": [_prox(prox, lr, l1, l2).astype(p.dtype)]}
+
+
+@register("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    mom = ins["Moment"][0]
+    lr = _lr(ins, jnp.float32)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    gf = g.astype(mom.dtype)
+    mom_out = mom + gf * gf
+    eff_lr = lr / jnp.sqrt(mom_out + 1e-12)
+    prox = p.astype(jnp.float32) - eff_lr * gf
+    return {"ParamOut": [_prox(prox, eff_lr, l1, l2).astype(p.dtype)],
+            "MomentOut": [mom_out]}

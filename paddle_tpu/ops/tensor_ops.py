@@ -347,3 +347,25 @@ def _assign_value(ctx, ins, attrs):
     import numpy as _np
     arr = _np.asarray(attrs["values"], dtype=np_dtype(attrs.get("dtype", "float32")))
     return {"Out": [jnp.asarray(arr.reshape(attrs["shape"]))]}
+
+
+register("split_byref")(_split)  # split_byref_op.cc: same math, by-ref out
+
+
+@register("fill")
+def _fill(ctx, ins, attrs):
+    """fill_op.cc: fill Out with the literal data in attrs (row-major)."""
+    import numpy as np
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    data = np.asarray(attrs["value"], dt).reshape(attrs["shape"])
+    return {"Out": [jnp.asarray(data)]}
+
+
+@register("extract_rows", no_grad_slots=("X",))
+def _extract_rows(ctx, ins, attrs):
+    """extract_rows_op.cc: the row-id vector of a SelectedRows value."""
+    from ..core.selected_rows import SelectedRows
+    x = ins["X"][0]
+    if not isinstance(x, SelectedRows):
+        raise TypeError("extract_rows expects a SelectedRows input")
+    return {"Out": [x.rows.reshape(-1, 1)]}

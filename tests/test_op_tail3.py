@@ -1,0 +1,158 @@
+"""Op-tail batch 3: proximal optimizers, fill/extract_rows, fusion
+LSTM/GRU, fused elementwise activation, generate_proposals (reference
+proximal_gd_op.cc, fill_op.cc, fusion_lstm_op.cc, fusion_gru_op.cc,
+fused_elemwise_activation_op.cc, detection/generate_proposals_op.cc)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_harness import run_forward
+from paddle_tpu.layer_helper import LayerHelper
+
+rng = np.random.RandomState(21)
+
+
+def _append(helper_name, ins, outs_spec, attrs, v):
+    helper = LayerHelper(helper_name)
+    outs = {}
+    ret = []
+    for slot, (dtype, shape) in outs_spec.items():
+        var = helper.create_variable_for_type_inference(dtype, shape=shape)
+        outs[slot] = [var]
+        ret.append(var)
+    helper.append_op(helper_name, {k: [v[n] for n in names]
+                                   for k, names in ins.items()}, outs, attrs)
+    return ret
+
+
+def test_proximal_gd_and_adagrad():
+    p = rng.randn(4, 3).astype("float32")
+    g = rng.randn(4, 3).astype("float32")
+    lr = np.asarray([0.1], "float32")
+    mom = np.abs(rng.randn(4, 3)).astype("float32")
+
+    def build(v):
+        return _append("proximal_gd",
+                       {"Param": ["p"], "Grad": ["g"],
+                        "LearningRate": ["lr"]},
+                       {"ParamOut": ("float32", (4, 3))},
+                       {"l1": 0.05, "l2": 0.1}, v)
+
+    (out,) = run_forward(build, {"p": p, "g": g, "lr": lr})
+    prox = p - 0.1 * g
+    ref = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.05, 0) \
+        / (1 + 0.1 * 0.1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def build2(v):
+        return _append("proximal_adagrad",
+                       {"Param": ["p"], "Grad": ["g"], "Moment": ["m"],
+                        "LearningRate": ["lr"]},
+                       {"ParamOut": ("float32", (4, 3)),
+                        "MomentOut": ("float32", (4, 3))},
+                       {"l1": 0.0, "l2": 0.0}, v)
+
+    (out2, mom_out) = run_forward(build2, {"p": p, "g": g, "m": mom,
+                                           "lr": lr})
+    np.testing.assert_allclose(mom_out, mom + g * g, rtol=1e-5)
+    eff = 0.1 / np.sqrt(mom + g * g + 1e-12)
+    np.testing.assert_allclose(out2, p - eff * g, rtol=1e-4)
+
+
+def test_fill_op():
+    def build(v):
+        return _append("fill", {},
+                       {"Out": ("float32", (2, 3))},
+                       {"shape": [2, 3], "dtype": "float32",
+                        "value": [1, 2, 3, 4, 5, 6]}, v)
+
+    (out,) = run_forward(build, {"z": np.zeros(1, "float32")})
+    np.testing.assert_allclose(out, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_fusion_lstm_matches_composed():
+    B, T, M, D = 2, 5, 6, 4
+    x = rng.randn(B, T, M).astype("float64")
+    wx = rng.randn(M, 4 * D).astype("float64") * 0.3
+    wh = rng.randn(D, 4 * D).astype("float64") * 0.3
+    b = rng.randn(1, 4 * D).astype("float64") * 0.1
+
+    def fused(v):
+        return _append("fusion_lstm",
+                       {"X": ["x"], "WeightX": ["wx"], "WeightH": ["wh"],
+                        "Bias": ["b"]},
+                       {"Hidden": ("float64", (B, T, D)),
+                        "Cell": ("float64", (B, T, D)),
+                        "XX": ("float64", (B, T, 4 * D))}, {}, v)
+
+    def composed(v):
+        helper = LayerHelper("lstm")
+        xx = helper.create_variable_for_type_inference("float64",
+                                                       shape=(B, T, 4 * D))
+        helper.append_op("matmul", {"X": [v["x"]], "Y": [v["wx"]]},
+                         {"Out": [xx]}, {})
+        xb = fluid.layers.elementwise_add(xx, v["b"])
+        h = helper.create_variable_for_type_inference("float64",
+                                                      shape=(B, T, D))
+        c = helper.create_variable_for_type_inference("float64",
+                                                      shape=(B, T, D))
+        lh = helper.create_variable_for_type_inference("float64",
+                                                       shape=(B, D))
+        lc = helper.create_variable_for_type_inference("float64",
+                                                       shape=(B, D))
+        helper.append_op("lstm", {"Input": [xb], "Weight": [v["wh"]]},
+                         {"Hidden": [h], "Cell": [c], "LastH": [lh],
+                          "LastC": [lc]}, {})
+        return [h]
+
+    feed = {"x": x, "wx": wx, "wh": wh, "b": b}
+    fh = run_forward(fused, feed)[0]
+    ch = run_forward(composed, feed)[0]
+    np.testing.assert_allclose(fh, ch, rtol=1e-6)
+
+
+def test_fused_elemwise_activation():
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+
+    def build(v):
+        return _append("fused_elemwise_activation",
+                       {"X": ["x"], "Y": ["y"]},
+                       {"Out": ("float32", (3, 4)),
+                        "IntermediateOut": ("float32", (3, 4))},
+                       {"functor_list": ["elementwise_add", "relu"]}, v)
+
+    (out, inter) = run_forward(build, {"x": x, "y": y})
+    np.testing.assert_allclose(out, np.maximum(x + y, 0), rtol=1e-6)
+    np.testing.assert_allclose(inter, x + y, rtol=1e-6)
+
+
+def test_generate_proposals_selects_high_score_boxes():
+    N, A, H, W = 1, 2, 3, 3
+    anchors = np.zeros((H, W, A, 4), "float32")
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                anchors[i, j, a] = [j * 10, i * 10, j * 10 + 8 + a,
+                                    i * 10 + 8 + a]
+    scores = rng.rand(N, A, H, W).astype("float32")
+    scores[0, 0, 0, 0] = 5.0  # dominant anchor
+
+    def build(v):
+        return _append(
+            "generate_proposals",
+            {"Scores": ["s"], "BboxDeltas": ["d"], "ImInfo": ["i"],
+             "Anchors": ["a"], "Variances": ["va"]},
+            {"RpnRois": ("float32", (N, 4, 4)),
+             "RpnRoiProbs": ("float32", (N, 4, 1)),
+             "RpnRoisNum": ("int64", (N,))},
+            {"pre_nms_topN": 10, "post_nms_topN": 4, "nms_thresh": 0.5,
+             "min_size": 1.0}, v)
+
+    rois, probs, num = run_forward(build, {
+        "s": scores, "d": np.zeros((N, 4 * A, H, W), "float32"),
+        "i": np.array([[30, 30, 1.0]], "float32"), "a": anchors,
+        "va": np.full((H, W, A, 4), 1.0, "float32")})
+    assert int(num[0]) >= 1
+    np.testing.assert_allclose(probs[0, 0, 0], 5.0)   # top roi = dominant
+    # +1 width convention of box_coder decode: w = 8-0+1 = 9
+    np.testing.assert_allclose(rois[0, 0], [0, 0, 9, 9])

@@ -587,18 +587,20 @@ def _generate_proposals(ctx, ins, attrs):
     pre_n = min(pre_n, M)
     post_n = min(post_n, pre_n)
 
+    if anchors.shape[0] != M:
+        raise ValueError(
+            f"generate_proposals: anchors hold {anchors.shape[0]} boxes "
+            f"but Scores imply A*H*W = {M}")
+
     def per_image(sc, dl, info):
         s = sc.transpose(1, 2, 0).reshape(-1)           # [H*W*A]
         d = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
-        anc = anchors.reshape(H * W * A, 4) if anchors.shape[0] == M \
-            else anchors
-        var = variances.reshape(H * W * A, 4) if variances.shape[0] == M \
-            else variances
         top_s, top_i = lax.top_k(s, pre_n)
-        a = anc[top_i]
-        v = var[top_i]
+        a = anchors[top_i]
+        v = variances[top_i]
         t = d[top_i]
-        # decode (box_coder decode_center_size semantics)
+        # decode (generate_proposals_op.cc:99-133: +1 widths, corners at
+        # center +/- w/2 with the -1 pixel offset on the far corner)
         aw = a[:, 2] - a[:, 0] + 1.0
         ah = a[:, 3] - a[:, 1] + 1.0
         ax = a[:, 0] + aw * 0.5
@@ -608,7 +610,7 @@ def _generate_proposals(ctx, ins, attrs):
         w = jnp.exp(jnp.minimum(v[:, 2] * t[:, 2], 10.0)) * aw
         h = jnp.exp(jnp.minimum(v[:, 3] * t[:, 3], 10.0)) * ah
         boxes = jnp.stack([cx - w / 2, cy - h / 2,
-                           cx + w / 2, cy + h / 2], axis=1)
+                           cx + w / 2 - 1.0, cy + h / 2 - 1.0], axis=1)
         # clip to image
         hmax, wmax = info[0] - 1.0, info[1] - 1.0
         boxes = jnp.stack([
@@ -620,7 +622,20 @@ def _generate_proposals(ctx, ins, attrs):
         keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
                    & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
         cand_s = jnp.where(keep_sz, top_s, -jnp.inf)
-        iou = _iou_matrix(boxes, boxes)
+        # pixel-convention IoU (JaccardOverlap normalized=false: +1 on
+        # widths) — _iou_matrix is the normalized variant
+        wi = (jnp.maximum(0.0,
+                          jnp.minimum(boxes[:, None, 2], boxes[None, :, 2])
+                          - jnp.maximum(boxes[:, None, 0], boxes[None, :, 0])
+                          + 1.0))
+        hi = (jnp.maximum(0.0,
+                          jnp.minimum(boxes[:, None, 3], boxes[None, :, 3])
+                          - jnp.maximum(boxes[:, None, 1], boxes[None, :, 1])
+                          + 1.0))
+        inter = wi * hi
+        area = ((boxes[:, 2] - boxes[:, 0] + 1.0)
+                * (boxes[:, 3] - boxes[:, 1] + 1.0))
+        iou = inter / (area[:, None] + area[None, :] - inter)
 
         def body(keep, i):
             sup = jnp.any(keep & (jnp.arange(pre_n) < i) & (iou[i] > nms_thr))

@@ -613,6 +613,10 @@ def _fusion_lstm(ctx, ins, attrs):
     """fusion_lstm_op.cc: fc(x) + LSTM in one op (the CPU jit_kernel
     fusion; on TPU one XLA region anyway).  X [B,T,M], WeightX [M,4D],
     WeightH [D,4D], Bias [1,4D]; reuses the lstm scan lowering."""
+    if attrs.get("use_peepholes", False):
+        raise NotImplementedError(
+            "fusion_lstm: use_peepholes=True (the [1, 7D] bias layout) is "
+            "not ported; the in-scope models run peephole-free")
     x = ins["X"][0]
     wx = ins["WeightX"][0]
     bias = ins["Bias"][0] if ins.get("Bias") else None
@@ -667,8 +671,8 @@ def _fused_elemwise_activation(ctx, ins, attrs):
                     "scale", 1.0)}[name](a)
 
     if functors[0].startswith("elementwise"):
-        out = unary(functors[1], binary(functors[0], x, y))
         inter = binary(functors[0], x, y)
+        out = unary(functors[1], inter)
     else:
         inter = unary(functors[0], y)
         out = binary(functors[1], x, inter)

@@ -297,6 +297,7 @@ def _prox(prox_param, lr, l1, l2):
 @register("proximal_gd")
 def _proximal_gd(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
+    _dense_only(g, "proximal_gd")
     lr = _lr(ins, jnp.float32)
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
@@ -307,6 +308,7 @@ def _proximal_gd(ctx, ins, attrs):
 @register("proximal_adagrad")
 def _proximal_adagrad(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
+    _dense_only(g, "proximal_adagrad")
     mom = ins["Moment"][0]
     lr = _lr(ins, jnp.float32)
     l1 = attrs.get("l1", 0.0)

@@ -154,5 +154,5 @@ def test_generate_proposals_selects_high_score_boxes():
         "va": np.full((H, W, A, 4), 1.0, "float32")})
     assert int(num[0]) >= 1
     np.testing.assert_allclose(probs[0, 0, 0], 5.0)   # top roi = dominant
-    # +1 width convention of box_coder decode: w = 8-0+1 = 9
-    np.testing.assert_allclose(rois[0, 0], [0, 0, 9, 9])
+    # zero deltas decode to the anchor itself (reference -1 far-corner)
+    np.testing.assert_allclose(rois[0, 0], [0, 0, 8, 8])

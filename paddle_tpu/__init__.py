@@ -27,6 +27,7 @@ from . import clip  # noqa: F401
 from . import data  # noqa: F401
 from . import initializer  # noqa: F401
 from . import contrib  # noqa: F401
+from . import debugger  # noqa: F401
 from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import metrics  # noqa: F401

@@ -1,0 +1,58 @@
+"""Program visualization (reference python/paddle/fluid/debugger.py:
+``draw_block_graphviz`` + the repr utilities; ir/graph_viz_pass.cc is the
+C++ analogue).  Emits Graphviz .dot text — no graphviz binary needed."""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', r'\"')
+
+
+def draw_block_graphviz(block, highlights: Optional[Set[str]] = None,
+                        path: str = "./temp.dot") -> str:
+    """Write a var/op bipartite graph of ``block`` as Graphviz dot.
+    Ops are boxes, vars are ellipses; ``highlights`` names render red.
+    Returns the dot text (also written to ``path``)."""
+    highlights = highlights or set()
+    lines = ["digraph G {", "  rankdir=TB;"]
+    seen_vars = set()
+
+    def var_node(name):
+        if name in seen_vars:
+            return
+        seen_vars.add(name)
+        color = "red" if name in highlights else "lightblue"
+        shape = "ellipse"
+        v = block.var_or_none(name)
+        label = name
+        if v is not None and getattr(v, "shape", None) is not None:
+            label = f"{name}\\n{tuple(v.shape)} {v.dtype}"
+        lines.append(
+            f'  "var_{_esc(name)}" [label="{_esc(label)}", shape={shape},'
+            f' style=filled, fillcolor={color}];')
+
+    for i, op in enumerate(block.ops):
+        color = "red" if op.type in highlights else "khaki"
+        lines.append(
+            f'  "op_{i}" [label="{_esc(op.type)}", shape=box,'
+            f' style=filled, fillcolor={color}];')
+        for name in op.input_arg_names():
+            var_node(name)
+            lines.append(f'  "var_{_esc(name)}" -> "op_{i}";')
+        for name in op.output_arg_names():
+            var_node(name)
+            lines.append(f'  "op_{i}" -> "var_{_esc(name)}";')
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot
+
+
+def pprint_program_codes(program) -> str:
+    """Readable multi-block program listing (reference debugger.py
+    pprint_program_codes)."""
+    return program.to_string()

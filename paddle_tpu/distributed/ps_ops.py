@@ -397,3 +397,11 @@ def _listen_and_serv(exe, program, op, scope):
         loop.wait_exit()
     finally:
         server.stop()
+
+
+@register_host_op("delete_var")
+def _delete_var(exe, program, op, scope):
+    """delete_var_op.cc: drop variables from the scope (frees device
+    buffers; the reference used it for eager GC of step scopes)."""
+    for name in op.input("X"):
+        scope.erase(name)

@@ -141,3 +141,129 @@ def fuse_conv_bn(program: Program, scope, keep_vars=()) -> int:
         folded += 1
         i += 1
     return folded
+
+
+# ---------------------------------------------------------------------------
+# NHWC layout pass (the reference transpiler family's layout rewrites +
+# the TPU analogue of TF grappler's layout optimizer): convert NCHW
+# conv/bn/pool chains to channels-last, the MXU-preferred layout, with
+# boundary transposes.  Opt-in (AnalysisConfig pass "convert_to_nhwc").
+# ---------------------------------------------------------------------------
+
+_LAYOUT_OPS = {"conv2d", "depthwise_conv2d", "pool2d", "batch_norm"}
+# elementwise/activation ops that pass a layout through untouched when all
+# their 4-D inputs share it
+_LAYOUT_TRANSPARENT = {"relu", "relu6", "sigmoid", "tanh", "leaky_relu",
+                       "elu", "swish", "gelu", "abs", "sqrt", "square",
+                       "scale", "dropout", "elementwise_add",
+                       "elementwise_sub", "elementwise_mul", "prelu"}
+
+
+def _nchw_shape(s):
+    return (s[0], s[3], s[1], s[2])
+
+
+def _nhwc_shape(s):
+    return (s[0], s[2], s[3], s[1])
+
+
+def convert_to_nhwc(program: Program, scope=None, keep_vars=()) -> int:
+    """Rewrite layout-sensitive ops of the global block to
+    data_layout=NHWC (inference programs; conv filters stay OIHW so the
+    Scope is untouched — the conv lowering retargets its spec).
+
+    Walks ops in order keeping the set of vars currently holding NHWC
+    values; inserts boundary transposes for NCHW consumers and for the
+    ``keep_vars`` fetch targets.  Returns the number of ops converted."""
+    from ..core.program import Operator
+
+    block = program.global_block
+    nhwc: set = set()
+    new_ops = []
+    converted = 0
+
+    def transpose(src, axis, dst_name, dst_shape):
+        dst = block.create_var(name=dst_name,
+                               dtype=block.var(src).dtype,
+                               shape=dst_shape)
+        new_ops.append(Operator(block, "transpose", {"X": [src]},
+                                {"Out": [dst.name]}, {"axis": axis}))
+        return dst.name
+
+    def rename_in(op, old, new):
+        op.inputs = {k: [new if n == old else n for n in v]
+                     for k, v in op.inputs.items()}
+
+    for op in block.ops:
+        ins = op.input_arg_names()
+        if (op.type in _LAYOUT_OPS
+                and op.attr("data_layout", "NCHW") == "NCHW"):
+            data_slot = "Input" if "conv" in op.type else "X"
+            xname = op.input(data_slot)[0]
+            xvar = block.var_or_none(xname)
+            if xvar is None or xvar.shape is None or len(xvar.shape) != 4:
+                new_ops.append(op)
+                continue
+            if xname not in nhwc:
+                t = transpose(xname, [0, 2, 3, 1], f"{xname}@NHWC",
+                              _nhwc_shape(xvar.shape))
+                rename_in(op, xname, t)
+                nhwc.add(t)
+            op.set_attr("data_layout", "NHWC")
+            out = op.output("Output" if "conv" in op.type
+                            else ("Y" if op.type == "batch_norm"
+                                  else "Out"))[0]
+            ovar = block.var(out)
+            ovar.shape = _nhwc_shape(ovar.shape)
+            nhwc.add(out)
+            converted += 1
+            new_ops.append(op)
+            continue
+        if op.type in _LAYOUT_TRANSPARENT and ins and ins[0] in nhwc:
+            ok = True
+            for other in ins[1:]:
+                v = block.var_or_none(other)
+                if (v is not None and v.shape is not None
+                        and len(v.shape) == 4 and other not in nhwc):
+                    ok = False
+            if ok and op.type.startswith("elementwise")                     and op.attr("axis", -1) == 1:
+                yv = block.var_or_none(op.input("Y")[0])
+                if yv is not None and yv.shape is not None                         and len(yv.shape) == 1:
+                    op.set_attr("axis", 3)  # channel bias rides last now
+                else:
+                    ok = False
+            if ok:
+                for oname in op.output_arg_names():
+                    ovar = block.var_or_none(oname)
+                    if ovar is not None and ovar.shape is not None                             and len(ovar.shape) == 4:
+                        ovar.shape = _nhwc_shape(ovar.shape)
+                        nhwc.add(oname)
+                new_ops.append(op)
+                continue
+        # NCHW consumer of NHWC vars: transpose back before this op
+        for name in set(ins):
+            if name in nhwc:
+                back = transpose(name, [0, 3, 1, 2], f"{name}@NCHW",
+                                 _nchw_shape(block.var(name).shape))
+                rename_in(op, name, back)
+        new_ops.append(op)
+
+    # fetch targets left in NHWC: rename the producing chain to an inner
+    # var and transpose back into the original name/shape
+    for name in keep_vars:
+        if name in nhwc:
+            v = block.var(name)
+            inner = block.create_var(name=f"{name}@NHWCVAL", dtype=v.dtype,
+                                     shape=v.shape)
+            for op in new_ops:
+                op.outputs = {k: [inner.name if n == name else n
+                                  for n in vs]
+                              for k, vs in op.outputs.items()}
+                rename_in(op, name, inner.name)
+            v.shape = _nchw_shape(v.shape)
+            new_ops.append(Operator(block, "transpose",
+                                    {"X": [inner.name]}, {"Out": [name]},
+                                    {"axis": [0, 3, 1, 2]}))
+    block.ops[:] = new_ops
+    program._version += 1
+    return converted

@@ -36,6 +36,12 @@ class AnalysisConfig:
     def delete_pass(self, name: str) -> None:
         self._passes = [p for p in self._passes if p != name]
 
+    def add_pass(self, name: str) -> None:
+        """Append an optional pass (e.g. "convert_to_nhwc", the
+        channels-last layout rewrite) to the ir_optim pipeline."""
+        if name not in self._passes:
+            self._passes.append(name)
+
 
 NativeConfig = AnalysisConfig
 

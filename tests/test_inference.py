@@ -193,3 +193,40 @@ def test_bf16_transpiler_parity_and_dtypes(tmp_path):
                                rtol=1e-2)
     # outputs come back bf16 by design
     assert "bfloat16" in str(got.dtype)
+
+
+def test_convert_to_nhwc_pass_preserves_outputs():
+    """The NHWC layout pass rewrites conv/bn/pool chains channels-last
+    with boundary transposes; fetch values must match the NCHW program."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.inference import passes as P
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 9
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("img", [3, 16, 16])
+        c1 = fluid.layers.conv2d(x, 8, 3, padding=1, act="relu")
+        b1 = fluid.layers.batch_norm(c1, is_test=True)
+        p1 = fluid.layers.pool2d(b1, 2, "max", 2)
+        c2 = fluid.layers.conv2d(p1, 4, 1)
+        out = fluid.layers.fc(c2, 5, act="softmax")
+
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.randn(2, 3, 16, 16).astype("float32")}
+
+    scope = Scope()
+    exe = Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        ref, = exe.run(prog, feed=feed, fetch_list=[out.name])
+        n = P.convert_to_nhwc(prog, scope, keep_vars=[out.name])
+        assert n >= 4, n  # 2 convs + bn + pool
+        got, = exe.run(prog, feed=feed, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=1e-6)
+    layouts = [op.attr("data_layout") for op in prog.global_block.ops
+               if op.type in ("conv2d", "pool2d", "batch_norm")]
+    assert all(l == "NHWC" for l in layouts), layouts

@@ -103,10 +103,12 @@ def bench_program(prog, startup, feed, fetch_names, steps=STEPS,
             fetches, new_state, rng = jitted(feeds, donated, const, rng)
             return fetches[0], [new_state[i] for i in refeed], rng
 
+        l = None
         for _ in range(warmup):
             l, donated, rng = step(donated, rng)
-        float(np.asarray(l))  # hard sync: block_until_ready is unreliable
-        t0 = time.perf_counter()  # through the remote-compile tunnel
+        if l is not None:
+            float(np.asarray(l))  # hard sync: block_until_ready is
+        t0 = time.perf_counter()  # unreliable through the remote tunnel
         for _ in range(steps):
             l, donated, rng = step(donated, rng)
         float(np.asarray(l))

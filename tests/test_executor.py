@@ -138,3 +138,57 @@ def test_save_load_inference_model(tmp_path):
         assert feeds == ["x"]
         (got,) = exe.run(iprog, feed={"x": xb}, fetch_list=fetches)
     assert np.allclose(ref, got, atol=1e-6)
+
+
+def test_run_steps_matches_eager_loop():
+    """Executor.run_steps: K scanned steps over stacked feeds must match
+    K eager run() calls exactly (params, fetches, RNG-free program)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    def build():
+        x = fluid.layers.data("x", [5])
+        y = fluid.layers.data("y", [1])
+        p = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+        return loss
+
+    rng = np.random.RandomState(0)
+    K = 6
+    xs = rng.randn(K, 8, 5).astype("float32")
+    ys = xs.sum(2, keepdims=True).astype("float32")
+
+    def eager():
+        prog, startup = Program(), Program()
+        prog.random_seed = 11
+        with program_guard(prog, startup), unique_name.guard():
+            loss = build()
+        scope, exe = Scope(), Executor()
+        with scope_guard(scope):
+            exe.run(startup)
+            losses = [float(exe.run(prog, feed={"x": xs[i], "y": ys[i]},
+                                    fetch_list=[loss.name])[0])
+                      for i in range(K)]
+            w = np.asarray(scope.find_var("w")).copy()
+        return losses, w
+
+    def scanned():
+        prog, startup = Program(), Program()
+        prog.random_seed = 11
+        with program_guard(prog, startup), unique_name.guard():
+            loss = build()
+        scope, exe = Scope(), Executor()
+        with scope_guard(scope):
+            exe.run(startup)
+            (stacked_loss,) = exe.run_steps(
+                prog, feed={"x": xs, "y": ys}, fetch_list=[loss.name])
+            w = np.asarray(scope.find_var("w")).copy()
+        return [float(v) for v in stacked_loss], w
+
+    el, ew = eager()
+    sl, sw = scanned()
+    np.testing.assert_allclose(sl, el, rtol=1e-5)
+    np.testing.assert_allclose(sw, ew, rtol=1e-5)

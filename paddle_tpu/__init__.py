@@ -36,7 +36,11 @@ from . import layers  # noqa: F401
 from . import nets  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
-from .core.backward import append_backward  # noqa: F401
+from .core.backward import append_backward, calc_gradient  # noqa: F401
+gradients = calc_gradient  # later-fluid alias
+from . import profiler  # noqa: F401
+from .lod_tensor import (  # noqa: F401
+    LoDTensor, create_lod_tensor, create_random_int_lodtensor)
 from .core.executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .core.program import (  # noqa: F401
     Program,

@@ -66,18 +66,49 @@ def append_backward(
     while_op.cc:101 reverse sub-block machinery) arrives with the sequence
     stack, where RNN recurrence is a scan op whose vjp is the reverse scan.
     """
-    block = loss.block
+    assert loss.shape in ((1,), ()), (
+        f"loss must be a scalar, got shape {loss.shape}"
+    )
+    return _append_backward_impl([loss], None, parameter_list, no_grad_set)
+
+
+def calc_gradient(targets, inputs, target_gradients=None,
+                  no_grad_set=None):
+    """Gradients of ``targets`` w.r.t. ``inputs`` (reference
+    backward.py:685 calc_gradient): seeds are ``target_gradients`` (or
+    ones over each target); returns the grad Variable per input (None
+    when the input does not influence any target — see reference semantics)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None and not isinstance(
+            target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    pairs = _append_backward_impl(list(targets), target_gradients,
+                                  [v.name if isinstance(v, Variable) else v
+                                   for v in inputs],
+                                  no_grad_set, inputs_need_params=False)
+    by_name = {p.name: g for p, g in pairs}
+    return [by_name.get(v.name if isinstance(v, Variable) else v)
+            for v in inputs]
+
+
+def _append_backward_impl(
+    targets: List[Variable],
+    target_gradients: Optional[List[Variable]],
+    parameter_list: Optional[Sequence[str]],
+    no_grad_set: Optional[Set[str]],
+    inputs_need_params: bool = True,
+) -> List[tuple]:
+    block = targets[0].block
     program = block.program
     no_grad = set(no_grad_set or ())
     for v in block.vars.values():
         if v.stop_gradient:
             no_grad.add(v.name)
 
-    assert loss.shape in ((1,), ()), (
-        f"loss must be a scalar, got shape {loss.shape}"
-    )
-
-    relevant = _find_relevant_ops(block, loss.name)
+    relevant = set()
+    for t in targets:
+        relevant |= _find_relevant_ops(block, t.name)
 
     # contributions: var name -> list of grad var names feeding it
     contribs: Dict[str, List[str]] = {}
@@ -105,24 +136,32 @@ def append_backward(
         contribs[var_name] = [g]
         return g
 
-    # seed: d loss / d loss = 1 (reference scale_loss_grad boundary;
-    # parallel lowering divides by device count at the psum instead)
-    loss_grad = grad_var_name(loss.name)
-    block.create_var(name=loss_grad, shape=loss.shape, dtype=loss.dtype)
-    block.append_op(
-        "fill_constant",
-        {},
-        {"Out": [loss_grad]},
-        {
-            "shape": list(loss.shape),
-            "value": 1.0,
-            "dtype": loss.dtype,
-            OP_ROLE_ATTR: OpRole.Backward | OpRole.Loss,
-        },
-    )
-    add_contrib(loss.name, loss_grad)
-
-    n_fwd_ops = len(block.ops) - 1  # excluding the fill op just added
+    # seeds: d target / d target = 1 (reference scale_loss_grad
+    # boundary), or the caller-supplied target_gradients (calc_gradient)
+    n_fwd_ops = len(block.ops)  # before any seed ops are appended
+    for ti, t in enumerate(targets):
+        tg = target_gradients[ti] if target_gradients else None
+        if tg is not None:
+            if tuple(tg.shape) != tuple(t.shape):
+                raise ValueError(
+                    f"target_gradient {tg.name!r} shape {tg.shape} != "
+                    f"target {t.name!r} shape {t.shape}")
+            add_contrib(t.name, tg.name)
+            continue
+        t_grad = grad_var_name(t.name)
+        block.create_var(name=t_grad, shape=t.shape, dtype=t.dtype)
+        block.append_op(
+            "fill_constant",
+            {},
+            {"Out": [t_grad]},
+            {
+                "shape": list(t.shape),
+                "value": 1.0,
+                "dtype": t.dtype,
+                OP_ROLE_ATTR: OpRole.Backward | OpRole.Loss,
+            },
+        )
+        add_contrib(t.name, t_grad)
     for idx in range(n_fwd_ops - 1, -1, -1):
         if idx not in relevant:
             continue
@@ -252,7 +291,7 @@ def append_backward(
     )
     pairs = []
     for p in params:
-        if not p.trainable:
+        if inputs_need_params and not p.trainable:
             continue
         g = resolve_out_grad(p.name)
         if g is None:

@@ -91,6 +91,22 @@ def scope_guard(scope: Scope):
     return guard()
 
 
+def _expand_lod_feeds(feed):
+    """A fed LoDTensor splits into its padded array + the ``@LEN``
+    companion (the reference's LoD travels inside the tensor; the padded
+    contract carries lengths as a separate feed)."""
+    from ..lod_tensor import LoDTensor
+
+    out = {}
+    for name, val in feed.items():
+        if isinstance(val, LoDTensor):
+            out[name] = val.data
+            out.setdefault(name + "@LEN", val.seq_lens)
+        else:
+            out[name] = val
+    return out
+
+
 def _as_device_array(value, var: Optional[Variable]):
     if isinstance(value, (jax.Array,)):
         return value
@@ -126,7 +142,7 @@ class Executor:
         use_program_cache: bool = True,
     ):
         program = program if program is not None else default_main_program()
-        feed = feed or {}
+        feed = _expand_lod_feeds(feed or {})
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])]
         scope = scope or global_scope()
         program = self._prepare_program(program, feed)

@@ -1,0 +1,102 @@
+"""LoDTensor compatibility shim (reference python/paddle/fluid/
+lod_tensor.py:23 create_lod_tensor, core LoDTensor).
+
+The TPU framework stores variable-length batches as padded ``[B, T, ...]``
+arrays + a length vector (see layers/nn.py module docstring).  This shim
+keeps the reference's feed-side API: a ``LoDTensor`` built from ragged
+rows + ``recursive_seq_lens`` feeds straight into ``Executor.run`` —
+the executor expands it to the padded array and the ``@LEN`` companion.
+Level-1 only (nested LoD is intentionally unported)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["LoDTensor", "create_lod_tensor", "create_random_int_lodtensor"]
+
+
+class LoDTensor:
+    """Padded data + per-sequence lengths (level-1)."""
+
+    def __init__(self, data: np.ndarray, seq_lens: Sequence[int]):
+        self._data = np.asarray(data)
+        self._lens = np.asarray(seq_lens, np.int64)
+        if self._data.shape[0] != len(self._lens):
+            raise ValueError(
+                f"padded batch {self._data.shape[0]} != "
+                f"{len(self._lens)} sequences")
+
+    # reference API ------------------------------------------------------
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        return [list(int(v) for v in self._lens)]
+
+    def lod(self) -> List[List[int]]:
+        offsets = [0]
+        for v in self._lens:
+            offsets.append(offsets[-1] + int(v))
+        return [offsets]
+
+    def shape(self):
+        return tuple(self._data.shape)
+
+    # padded-contract accessors ------------------------------------------
+    @property
+    def data(self) -> np.ndarray:
+        return self._data
+
+    @property
+    def seq_lens(self) -> np.ndarray:
+        return self._lens
+
+    def __array__(self, dtype=None):
+        return self._data.astype(dtype) if dtype else self._data
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
+    """Build a LoDTensor from (a) a list of per-sequence row lists, (b) a
+    packed ``[sum(lens), ...]`` array + lens, or (c) an existing
+    LoDTensor (re-lod)."""
+    if isinstance(data, LoDTensor):
+        return create_lod_tensor(_unpad(data), recursive_seq_lens, place)
+    if len(recursive_seq_lens) != 1:
+        raise ValueError(
+            "create_lod_tensor on TPU supports level-1 sequences only "
+            "(nested LoD is intentionally unported; see README)")
+    lens = [int(v) for v in recursive_seq_lens[0]]
+    if isinstance(data, list):
+        rows = [np.asarray(seq) for seq in data]
+        if [len(r) for r in rows] != lens:
+            raise ValueError(
+                f"sequence lengths {[len(r) for r in rows]} do not match "
+                f"recursive_seq_lens {lens}")
+        packed = np.concatenate([r.reshape(len(r), -1) for r in rows]) \
+            if rows else np.zeros((0, 1))
+    else:
+        packed = np.asarray(data)
+        if packed.shape[0] != sum(lens):
+            raise ValueError(
+                f"packed rows {packed.shape[0]} != sum(lens) {sum(lens)}")
+    packed = packed.reshape(packed.shape[0], -1)
+    B, T = len(lens), (max(lens) if lens else 0)
+    padded = np.zeros((B, T) + packed.shape[1:], packed.dtype)
+    off = 0
+    for i, ln in enumerate(lens):
+        padded[i, :ln] = packed[off:off + ln]
+        off += ln
+    return LoDTensor(padded, lens)
+
+
+def _unpad(lt: LoDTensor) -> np.ndarray:
+    return np.concatenate([lt.data[i, :ln]
+                           for i, ln in enumerate(lt.seq_lens)])
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1) -> LoDTensor:
+    """Reference lod_tensor.py create_random_int_lodtensor."""
+    lens = [int(v) for v in recursive_seq_lens[0]]
+    data = np.random.randint(low, high + 1,
+                             (sum(lens),) + tuple(base_shape)).astype(
+                                 np.int64)
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -192,3 +192,28 @@ def test_run_steps_matches_eager_loop():
     sl, sw = scanned()
     np.testing.assert_allclose(sl, el, rtol=1e-5)
     np.testing.assert_allclose(sw, ew, rtol=1e-5)
+
+
+def test_lod_tensor_feed_shim():
+    """create_lod_tensor feeds ragged rows through the reference API; the
+    executor expands it to the padded array + @LEN companion."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        d = fluid.layers.data("seq", [1], dtype="float32", lod_level=1)
+        pooled = fluid.layers.sequence_pool(d, "sum")
+
+    lt = fluid.create_lod_tensor(
+        [[1.0, 2.0], [3.0], [4.0, 5.0, 6.0]], [[2, 1, 3]], None)
+    assert lt.recursive_sequence_lengths() == [[2, 1, 3]]
+    assert lt.lod() == [[0, 2, 3, 6]]
+
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(prog, feed={"seq": lt}, fetch_list=[pooled.name])
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), [3.0, 3.0, 15.0])

@@ -253,3 +253,45 @@ def test_dropout_grad_via_mask():
         return L.dropout(v["x"], dropout_prob=0.4, seed=42,
                          dropout_implementation="upscale_in_train")
     check_grad(build, {"x": x})
+
+
+def test_calc_gradient_matches_numeric():
+    """calc_gradient (reference backward.py:685): non-scalar targets with
+    explicit target_gradients; d(sum(cot*y))/dx vs numeric."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    rng = np.random.RandomState(5)
+    xv = rng.randn(3, 4).astype("float64")
+    cot = rng.uniform(0.5, 1.5, (3, 2)).astype("float64")
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = prog.global_block.create_parameter("x", [3, 4], "float64")
+        sx = startup.global_block.create_parameter("x", [3, 4], "float64")
+        from paddle_tpu.initializer import NumpyArrayInitializer
+        NumpyArrayInitializer(xv)(sx, startup.global_block)
+        y = fluid.layers.fc(x, 2, bias_attr=False, act="tanh",
+                            param_attr=fluid.ParamAttr(name="w"))
+        tg = fluid.layers.assign(cot)
+        (gx,) = fluid.calc_gradient(y, x, target_gradients=tg)
+
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        analytic, = exe.run(prog, fetch_list=[gx.name])
+        w = np.asarray(scope.find_var("w"))
+
+    def f(xnp):
+        return (np.tanh(xnp @ w) * cot).sum()
+
+    eps = 1e-6
+    num = np.zeros_like(xv)
+    for i in range(xv.size):
+        xp = xv.copy().reshape(-1); xp[i] += eps
+        xm = xv.copy().reshape(-1); xm[i] -= eps
+        num.reshape(-1)[i] = (f(xp.reshape(xv.shape))
+                              - f(xm.reshape(xv.shape))) / (2 * eps)
+    np.testing.assert_allclose(np.asarray(analytic), num, rtol=1e-5)

@@ -83,6 +83,11 @@ def calc_gradient(targets, inputs, target_gradients=None,
     if target_gradients is not None and not isinstance(
             target_gradients, (list, tuple)):
         target_gradients = [target_gradients]
+    if target_gradients is not None and \
+            len(target_gradients) != len(targets):
+        raise ValueError(
+            f"calc_gradient: {len(targets)} targets but "
+            f"{len(target_gradients)} target_gradients")
     pairs = _append_backward_impl(list(targets), target_gradients,
                                   [v.name if isinstance(v, Variable) else v
                                    for v in inputs],

@@ -236,6 +236,14 @@ class Executor:
         """
         program = program if program is not None else default_main_program()
         feed = feed or {}
+        from ..lod_tensor import LoDTensor
+        for n, v in feed.items():
+            if isinstance(v, LoDTensor):
+                raise TypeError(
+                    f"run_steps feed {n!r} is a LoDTensor — the leading "
+                    "dim of a run_steps feed is the STEP count, not the "
+                    "batch; stack padded arrays + '@LEN' vectors per "
+                    "step instead")
         if not feed:
             raise ValueError("run_steps needs at least one stacked feed "
                              "to define the step count")

@@ -70,15 +70,19 @@ def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
             raise ValueError(
                 f"sequence lengths {[len(r) for r in rows]} do not match "
                 f"recursive_seq_lens {lens}")
-        packed = np.concatenate([r.reshape(len(r), -1) for r in rows]) \
-            if rows else np.zeros((0, 1))
+        packed = np.concatenate(rows) if rows else np.zeros((0, 1))
     else:
         packed = np.asarray(data)
         if packed.shape[0] != sum(lens):
             raise ValueError(
                 f"packed rows {packed.shape[0]} != sum(lens) {sum(lens)}")
-    packed = packed.reshape(packed.shape[0], -1)
-    B, T = len(lens), (max(lens) if lens else 0)
+    # trailing base dims survive; bucket T like DataFeeder._pad so
+    # per-batch max-length jitter does not recompile per distinct length
+    # (the executor caches per feed-shape signature)
+    from .data_feeder import _bucket
+
+    B = len(lens)
+    T = _bucket(max(lens)) if lens else 0
     padded = np.zeros((B, T) + packed.shape[1:], packed.dtype)
     off = 0
     for i, ln in enumerate(lens):

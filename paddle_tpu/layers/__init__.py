@@ -23,7 +23,7 @@ from .control_flow import (  # noqa: F401
     shrink_memory,
     split_lod_tensor,
 )
-from .io import data  # noqa: F401
+from .io import data, get_places  # noqa: F401
 from .detection import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from . import nn_extras  # noqa: F401

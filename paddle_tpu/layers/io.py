@@ -68,3 +68,20 @@ def read_file(reader):
     layers/io.py read_file)."""
     vars_ = reader.feed_vars
     return vars_[0] if len(vars_) == 1 else list(vars_)
+
+
+def get_places(device_count=0, device_type=None):
+    """Reference layers/device.py get_places (the parallel_do companion,
+    get_places_op.cc).  parallel_do itself is deprecated upstream and
+    unported (ParallelExecutor/GSPMD replaces in-graph data parallelism);
+    this shim returns the visible JAX devices for code that only
+    enumerates places."""
+    import jax
+
+    from .. import platform
+
+    devs = jax.devices()
+    if device_count:
+        devs = devs[:device_count]
+    return [platform.TPUPlace(i) if d.platform == "tpu"
+            else platform.CPUPlace() for i, d in enumerate(devs)]

@@ -41,6 +41,7 @@ gradients = calc_gradient  # later-fluid alias
 from . import profiler  # noqa: F401
 from .lod_tensor import (  # noqa: F401
     LoDTensor, create_lod_tensor, create_random_int_lodtensor)
+Tensor = LoDTensor  # reference __init__.py:51 alias
 from .core.executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .core.program import (  # noqa: F401
     Program,

@@ -1,0 +1,83 @@
+"""Seq2seq convergence: the attention encoder-decoder learns a tiny copy
+task end to end, and beam-search inference with the trained weights
+reproduces the source tokens (the reference book
+test_machine_translation.py pattern on synthetic data)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.models import machine_translation as mt
+
+V = 12          # tokens 2..11 usable; 0 = <s>, 1 = <e>
+T = 6
+B = 32
+EMB = HID = 48
+
+
+def _make_batch(rng):
+    """Copy task: target = source; <s> prefix for teacher forcing."""
+    length = T - 1
+    body = rng.randint(2, V, (B, length)).astype("int64")
+    src = np.concatenate([body, np.full((B, 1), 1, "int64")], 1)  # + <e>
+    tgt_in = np.concatenate([np.zeros((B, 1), "int64"), body], 1)
+    lbl = src.copy()
+    mask = np.ones((B, T), "float32")
+    return {"src_ids": src, "src_mask": mask, "tgt_ids": tgt_in,
+            "lbl_ids": lbl, "tgt_mask": mask}
+
+
+@pytest.mark.slow
+def test_seq2seq_copy_task_converges_and_decodes(tmp_path):
+    train_prog, startup = Program(), Program()
+    train_prog.random_seed = 17
+    with program_guard(train_prog, startup), unique_name.guard():
+        feeds, cost = mt.build(src_vocab=V, tgt_vocab=V, emb_dim=EMB,
+                               hid=HID, max_len=T, mode="train", lr=2e-2)
+
+    rng = np.random.RandomState(0)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        first = last = None
+        for step in range(240):
+            feed = _make_batch(rng)
+            (l,) = exe.run(train_prog, feed=feed, fetch_list=[cost.name])
+            if first is None:
+                first = float(l)
+            last = float(l)
+        assert last < 0.35 * first, (first, last)
+        ckpt = str(tmp_path / "mt")
+        fluid.io.save_params(exe, ckpt, main_program=train_prog)
+
+    # inference: trained weights via checkpoint, beam-search decode
+    beam = 4
+    infer_prog, infer_startup = Program(), Program()
+    with program_guard(infer_prog, infer_startup), unique_name.guard():
+        ifeeds, sents, scores = mt.build(
+            src_vocab=V, tgt_vocab=V, emb_dim=EMB, hid=HID,
+            max_len=T, beam_size=beam, mode="infer",
+            with_optimizer=False)
+    iscope = Scope()
+    exe.run(infer_startup, scope=iscope)
+    with scope_guard(iscope):
+        fluid.io.load_params(exe, ckpt, main_program=infer_prog)
+        batch = _make_batch(np.random.RandomState(99))
+        seed = np.array([[0.0]] + [[-1e9]] * (beam - 1), "float32")
+        iota = np.tile(np.arange(V, dtype="int64"), (beam, 1))
+        matches = 0
+        nb = 4
+        for i in range(nb):
+            out, sc = exe.run(
+                infer_prog,
+                feed={"src_ids": batch["src_ids"][i:i + 1],
+                      "src_mask": batch["src_mask"][i:i + 1],
+                      "cand_ids": iota, "beam_seed": seed},
+                fetch_list=[sents, scores], scope=iscope)
+            hyp = np.asarray(out)[0]          # top beam
+            ref = batch["src_ids"][i]
+            body_len = T - 1
+            matches += int(np.array_equal(hyp[:body_len], ref[:body_len]))
+        assert matches >= nb - 1, matches

@@ -677,3 +677,49 @@ def _fused_elemwise_activation(ctx, ins, attrs):
         inter = unary(functors[0], y)
         out = binary(functors[1], x, inter)
     return {"Out": [out], "IntermediateOut": [inter]}
+
+
+@register("fused_embedding_fc_lstm", no_grad_slots=("Ids", "SeqLen"))
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """fused_embedding_fc_lstm_op.cc: the embedding table IS the
+    pre-multiplied x-projection (Embeddings [V, 4D] = emb @ Wx fused
+    offline), so a lookup replaces the fc; then the LSTM scan."""
+    ids = ins["Ids"][0]
+    table = ins["Embeddings"][0]
+    if ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    xproj = table[ids.astype(jnp.int32)]          # [B, T, 4D]
+    if ins.get("Bias"):
+        xproj = xproj + ins["Bias"][0].reshape(1, 1, -1)
+    sub = {"Input": [xproj], "Weight": [ins["WeightH"][0]]}
+    for slot in ("H0", "C0", "SeqLen"):
+        if ins.get(slot):
+            sub[slot] = ins[slot]
+    out = _lstm(ctx, sub, attrs)
+    return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": [xproj]}
+
+
+@register("fusion_seqexpand_concat_fc", no_grad_slots=("SeqLen",))
+def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """fusion_seqexpand_concat_fc_op.cc: X[0] is a [B,T,D0] sequence, the
+    rest are per-batch [B,Di] rows broadcast over T; concat features,
+    fc + activation in one op."""
+    xs = ins["X"]
+    seq = xs[0]
+    B, T = seq.shape[0], seq.shape[1]
+    parts = [seq]
+    for x in xs[1:]:
+        parts.append(jnp.broadcast_to(x[:, None, :], (B, T, x.shape[-1])))
+    cat = jnp.concatenate(parts, axis=-1)
+    w = ins["FCWeight"][0]
+    out = jnp.einsum("btm,mf->btf", cat, w)
+    if ins.get("FCBias"):
+        out = out + ins["FCBias"][0].reshape(1, 1, -1)
+    act = attrs.get("fc_activation", "identity")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    elif act == "sigmoid":
+        out = jax.nn.sigmoid(out)
+    return {"Out": [out]}

@@ -156,3 +156,60 @@ def test_generate_proposals_selects_high_score_boxes():
     np.testing.assert_allclose(probs[0, 0, 0], 5.0)   # top roi = dominant
     # zero deltas decode to the anchor itself (reference -1 far-corner)
     np.testing.assert_allclose(rois[0, 0], [0, 0, 8, 8])
+
+
+def test_fused_embedding_fc_lstm_matches_lookup_plus_lstm():
+    B, T, V, D = 2, 4, 9, 3
+    ids = rng.randint(0, V, (B, T, 1)).astype("int64")
+    table = (rng.randn(V, 4 * D) * 0.3).astype("float64")
+    wh = (rng.randn(D, 4 * D) * 0.3).astype("float64")
+    b = (rng.randn(1, 4 * D) * 0.1).astype("float64")
+
+    def fused(v):
+        return _append("fused_embedding_fc_lstm",
+                       {"Ids": ["i"], "Embeddings": ["e"],
+                        "WeightH": ["wh"], "Bias": ["b"]},
+                       {"Hidden": ("float64", (B, T, D)),
+                        "Cell": ("float64", (B, T, D)),
+                        "XX": ("float64", (B, T, 4 * D))}, {}, v)
+
+    def composed(v):
+        emb = fluid.layers.gather(v["e"],
+                                  fluid.layers.reshape(v["i"], [B * T]))
+        xx = fluid.layers.reshape(emb, [B, T, 4 * D])
+        xb = fluid.layers.elementwise_add(xx, v["b"])
+        helper = LayerHelper("lstm")
+        h = helper.create_variable_for_type_inference("float64",
+                                                      shape=(B, T, D))
+        c = helper.create_variable_for_type_inference("float64",
+                                                      shape=(B, T, D))
+        lh = helper.create_variable_for_type_inference("float64",
+                                                       shape=(B, D))
+        lc = helper.create_variable_for_type_inference("float64",
+                                                       shape=(B, D))
+        helper.append_op("lstm", {"Input": [xb], "Weight": [v["wh"]]},
+                         {"Hidden": [h], "Cell": [c], "LastH": [lh],
+                          "LastC": [lc]}, {})
+        return [h]
+
+    feed = {"i": ids, "e": table, "wh": wh, "b": b}
+    fh = run_forward(fused, feed)[0]
+    ch = run_forward(composed, feed)[0]
+    np.testing.assert_allclose(fh, ch, rtol=1e-6)
+
+
+def test_fusion_seqexpand_concat_fc():
+    B, T = 2, 3
+    seq = rng.randn(B, T, 4).astype("float64")
+    row = rng.randn(B, 2).astype("float64")
+    w = rng.randn(6, 5).astype("float64")
+
+    def build(v):
+        return _append("fusion_seqexpand_concat_fc",
+                       {"X": ["s", "r"], "FCWeight": ["w"]},
+                       {"Out": ("float64", (B, T, 5))},
+                       {"fc_activation": "relu"}, v)
+
+    (out,) = run_forward(build, {"s": seq, "r": row, "w": w})
+    cat = np.concatenate([seq, np.repeat(row[:, None], T, 1)], -1)
+    np.testing.assert_allclose(out, np.maximum(cat @ w, 0), rtol=1e-6)

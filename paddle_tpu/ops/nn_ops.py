@@ -608,28 +608,30 @@ def _lstmp(ctx, ins, attrs):
     }
 
 
-@register("fusion_lstm", no_grad_slots=("SeqLen",))
-def _fusion_lstm(ctx, ins, attrs):
-    """fusion_lstm_op.cc: fc(x) + LSTM in one op (the CPU jit_kernel
-    fusion; on TPU one XLA region anyway).  X [B,T,M], WeightX [M,4D],
-    WeightH [D,4D], Bias [1,4D]; reuses the lstm scan lowering."""
+def _fused_lstm_tail(ctx, op_name, xproj, ins, attrs):
+    """Shared tail of the fused-LSTM family: bias add on the x-projection,
+    carry slots forwarded, the lstm scan, {Hidden, Cell, XX} packaging."""
     if attrs.get("use_peepholes", False):
         raise NotImplementedError(
-            "fusion_lstm: use_peepholes=True (the [1, 7D] bias layout) is "
+            f"{op_name}: use_peepholes=True (the [1, 7D] bias layout) is "
             "not ported; the in-scope models run peephole-free")
-    x = ins["X"][0]
-    wx = ins["WeightX"][0]
-    bias = ins["Bias"][0] if ins.get("Bias") else None
-    xproj = jnp.einsum("btm,mf->btf", x, wx)
-    if bias is not None:
-        xproj = xproj + bias.reshape(1, 1, -1)
+    if ins.get("Bias"):
+        xproj = xproj + ins["Bias"][0].reshape(1, 1, -1)
     sub = {"Input": [xproj], "Weight": [ins["WeightH"][0]]}
     for slot in ("H0", "C0", "SeqLen"):
         if ins.get(slot):
             sub[slot] = ins[slot]
     out = _lstm(ctx, sub, attrs)
-    return {"Hidden": out["Hidden"], "Cell": out["Cell"],
-            "XX": [xproj]}
+    return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": [xproj]}
+
+
+@register("fusion_lstm", no_grad_slots=("SeqLen",))
+def _fusion_lstm(ctx, ins, attrs):
+    """fusion_lstm_op.cc: fc(x) + LSTM in one op (the CPU jit_kernel
+    fusion; on TPU one XLA region anyway).  X [B,T,M], WeightX [M,4D],
+    WeightH [D,4D], Bias [1,4D]; reuses the lstm scan lowering."""
+    xproj = jnp.einsum("btm,mf->btf", ins["X"][0], ins["WeightX"][0])
+    return _fused_lstm_tail(ctx, "fusion_lstm", xproj, ins, attrs)
 
 
 @register("fusion_gru", no_grad_slots=("SeqLen",))
@@ -686,17 +688,11 @@ def _fused_embedding_fc_lstm(ctx, ins, attrs):
     offline), so a lookup replaces the fc; then the LSTM scan."""
     ids = ins["Ids"][0]
     table = ins["Embeddings"][0]
-    if ids.shape[-1] == 1:
+    if ids.ndim == 3 and ids.shape[-1] == 1:
         ids = ids.reshape(ids.shape[:-1])
     xproj = table[ids.astype(jnp.int32)]          # [B, T, 4D]
-    if ins.get("Bias"):
-        xproj = xproj + ins["Bias"][0].reshape(1, 1, -1)
-    sub = {"Input": [xproj], "Weight": [ins["WeightH"][0]]}
-    for slot in ("H0", "C0", "SeqLen"):
-        if ins.get(slot):
-            sub[slot] = ins[slot]
-    out = _lstm(ctx, sub, attrs)
-    return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": [xproj]}
+    return _fused_lstm_tail(ctx, "fused_embedding_fc_lstm", xproj, ins,
+                            attrs)
 
 
 @register("fusion_seqexpand_concat_fc", no_grad_slots=("SeqLen",))
@@ -716,10 +712,10 @@ def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
     if ins.get("FCBias"):
         out = out + ins["FCBias"][0].reshape(1, 1, -1)
     act = attrs.get("fc_activation", "identity")
-    if act == "relu":
-        out = jax.nn.relu(out)
-    elif act == "tanh":
-        out = jnp.tanh(out)
-    elif act == "sigmoid":
-        out = jax.nn.sigmoid(out)
-    return {"Out": [out]}
+    acts = {"identity": lambda v: v, "relu": jax.nn.relu,
+            "tanh": jnp.tanh, "sigmoid": jax.nn.sigmoid}
+    if act not in acts:
+        raise ValueError(
+            f"fusion_seqexpand_concat_fc: unknown fc_activation {act!r} "
+            f"(supported: {sorted(acts)})")
+    return {"Out": [acts[act](out)]}

@@ -136,8 +136,8 @@ def bench_resnet50():
     rng = np.random.RandomState(0)
     feed = {"data": rng.randn(B, 3, 224, 224).astype("float32"),
             "label": rng.randint(0, 1000, (B, 1)).astype("int64")}
-    sps = bench_program(prog, startup, feed, [loss.name], steps=48,
-                        scan_steps=48)
+    sps = bench_program(prog, startup, feed, [loss.name], steps=96,
+                        scan_steps=96)
     img_s = sps * B
     flops_per_img = 3 * 3.8e9  # fwd 3.8 GF @224 x ~3 for fwd+bwd
     return {"images_per_sec": round(img_s, 1),

@@ -35,6 +35,24 @@ WARMUP = 3
 STEPS = 12
 
 
+def two_point_fit(timed):
+    """Per-dispatch device time from a two-point RTT-cancelling fit.
+
+    The tunnel's per-readback round trip is ~1.4 s (r3 measurement: K=8
+    and K=192 matmul scans take the same wall time), so a single timed
+    call measures mostly RTT.  Back-to-back dispatches pipeline on
+    device; only the final readback pays the RTT, so
+    t(n calls) = RTT + n*t_dispatch and the n=3 minus n=1 difference is
+    2 dispatches of pure device time.  ``timed(n)`` runs n back-to-back
+    dispatches and returns wall seconds."""
+    t1 = min(timed(1) for _ in range(3))
+    t3 = min(timed(3) for _ in range(2))
+    dt = t3 - t1
+    if dt <= 0:  # noise swamped the fit; conservative fallback
+        return t3 / 3
+    return dt / 2
+
+
 def bench_program(prog, startup, feed, fetch_names, steps=STEPS,
                   warmup=WARMUP, scan_steps=None):
     """Steady-state steps/sec for one program (donated device state).
@@ -42,7 +60,9 @@ def bench_program(prog, startup, feed, fetch_names, steps=STEPS,
     ``scan_steps=K`` runs K optimizer steps per dispatch via ``lax.scan``
     (the device-side training loop — amortizes host dispatch the way a
     production TPU loop double-buffers it away); per-step RNG still
-    advances so dropout differs step to step.
+    advances so dropout differs step to step.  When ``scan_steps`` is
+    set, ``steps``/``warmup`` are ignored — timing is a fixed
+    1 warmup + 9 fitted dispatches (see two_point_fit).
     """
     import jax
     from jax import lax
@@ -87,15 +107,19 @@ def bench_program(prog, startup, feed, fetch_names, steps=STEPS,
             def step(donated, rng):
                 return jitted(feeds, donated, const, rng)
 
-            n_calls = max(1, steps // K)
             l, donated, rng = step(donated, rng)  # warmup: compile + K steps
             float(np.asarray(l))
-            t0 = time.perf_counter()
-            for _ in range(n_calls):
-                l, donated, rng = step(donated, rng)
-            float(np.asarray(l))
-            dt = time.perf_counter() - t0
-            return n_calls * K / dt
+
+            def timed(n):
+                nonlocal donated, rng
+                t0 = time.perf_counter()
+                l = None
+                for _ in range(n):
+                    l, donated, rng = step(donated, rng)
+                float(np.asarray(l))
+                return time.perf_counter() - t0
+
+            return K / two_point_fit(timed)
 
         jitted = jax.jit(fn, donate_argnums=(1,))
 
@@ -222,33 +246,87 @@ def bench_flash_attention_long():
     """Long-context attention: Pallas flash fwd+bwd at seq 8192 (XLA's
     materialized-scores path fails to compile at this length on v5e —
     flash is the only viable kernel; its O(block) memory is the
-    long-context story)."""
+    long-context story).
+
+    Two shapes at equal FLOPs / model width: H=8,D=64 and the TPU-native
+    H=4,D=128 (head_dim = MXU lane width halves the per-score VPU
+    softmax work).  Timing: K-step in-jit scan, n=3 minus n=1 dispatch
+    fit (see bench_program) — single-dispatch timings here are ~95%
+    tunnel RTT."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from paddle_tpu.kernels.attention import flash_attention
 
-    B, H, T, D = 4, 8, 8192, 64
-    rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
-    k = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
-    v = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+    T, K = 8192, 12
+    out = {"seq_len": T}
+    best = 0.0
+    for tag, (B, H, D) in {"h8_d64": (4, 8, 64),
+                           "h4_d128": (4, 4, 128)}.items():
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
 
-    def loss(q, k, v):
-        return (flash_attention(q, k, v, None, True, None)
-                .astype(jnp.float32) ** 2).sum()
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, None, True, None)
+                    .astype(jnp.float32) ** 2).sum()
 
-    step = jax.jit(jax.grad(loss, (0, 1, 2)))
-    g = step(q, k, v)
-    float(np.asarray(g[0][0, 0, 0, 0]))
-    t0 = time.perf_counter()
-    for _ in range(6):
-        g = step(q, k, v)
-    float(np.asarray(g[0][0, 0, 0, 0]))
-    dt = (time.perf_counter() - t0) / 6
-    flops = 3.5 * 2 * B * H * T * T * D / 2  # causal fwd+bwd
-    return {"tokens_per_sec": round(B * T / dt, 1), "seq_len": T,
-            "tflops": round(flops / dt / 1e12, 1)}
+        grad = jax.grad(loss, (0, 1, 2))
+
+        def multi(q, k, v):
+            def body(carry, _):
+                q, k, v = carry
+                dq, dk, dv = grad(q, k, v)
+                eps = jnp.bfloat16(1e-8)
+                return (q + dq * eps, k + dk * eps, v + dv * eps), None
+            (q, k, v), _ = lax.scan(body, (q, k, v), None, length=K)
+            return q
+        step = jax.jit(multi)
+        r = step(q, k, v)
+        float(np.asarray(r[0, 0, 0, 0]))
+
+        def timed(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = step(q, k, v)
+            float(np.asarray(r[0, 0, 0, 0]))
+            return time.perf_counter() - t0
+
+        dt = two_point_fit(timed) / K
+        flops = 3.5 * 2 * B * H * T * T * D / 2  # causal fwd+bwd
+        tf = flops / dt / 1e12
+        out[tag] = {"tokens_per_sec": round(B * T / dt, 1),
+                    "tflops": round(tf, 1)}
+        best = max(best, tf)
+
+    # numerics cross-check at the full 8k length: chunked-jnp reference
+    # (XLA's one-shot attention fails to compile at this T) on one
+    # batch-head, bf16 tolerance
+    @jax.jit
+    def ref_slice(q, k, v):
+        sm = 1.0 / np.sqrt(q.shape[-1])
+
+        def chunk(i):
+            c = lax.dynamic_slice_in_dim(q, i * 1024, 1024, 0)
+            s = (c @ k.T).astype(jnp.float32) * sm
+            qi = jnp.arange(1024)[:, None] + i * 1024
+            s = jnp.where(qi >= jnp.arange(T)[None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return p.astype(v.dtype) @ v
+        return jnp.concatenate([chunk(i) for i in range(T // 1024)], 0)
+
+    fl = jax.jit(lambda q, k, v: flash_attention(q, k, v, None, True, None))
+    o_flash = fl(q, k, v)[0, 0]
+    o_ref = ref_slice(q[0, 0], k[0, 0], v[0, 0])
+    maxdiff = float(jnp.max(jnp.abs(o_flash.astype(jnp.float32)
+                                    - o_ref.astype(jnp.float32))))
+    assert maxdiff < 0.05, f"flash vs chunked-jnp at 8k: {maxdiff}"
+    out["crosscheck_maxdiff_8k"] = round(maxdiff, 5)
+    out["tflops"] = round(best, 1)
+    out["tokens_per_sec"] = out["h4_d128"]["tokens_per_sec"]
+    return out
 
 
 A100_RESNET50_IMG_S = 2500.0

@@ -281,18 +281,38 @@ class Executor:
             fn = build_block_fn(program, plan, mesh=self._mesh())
             refeed = plan.donated_write_indices
 
+            n_writes = len(plan.persist_writes)
+            extra_idx = [i for i in range(n_writes)
+                         if i not in set(refeed)]
+
             def multi(stacked, donated, const, rng):
+                # All persistable writes ride the scan CARRY; only
+                # fetches are stacked as ys.  Stacking state would
+                # allocate O(K x full model state) HBM per dispatch.
+                # Write-only slots (not refed) are seeded with zeros —
+                # the block never reads them, each step overwrites.
+                if extra_idx:
+                    _, ns, _ = jax.eval_shape(
+                        fn, [s[0] for s in stacked], donated, const, rng)
+                    extra0 = [jnp.zeros(ns[i].shape, ns[i].dtype)
+                              for i in extra_idx]
+                else:
+                    extra0 = []
+
                 def one(carry, xs):
-                    donated, rng = carry
+                    donated, _, rng = carry
                     fetches, new_state, rng = fn(list(xs), donated, const,
                                                  rng)
-                    return ([new_state[i] for i in refeed], rng), \
-                        (fetches, new_state)
-                (donated, rng), (fetches, states) = jax.lax.scan(
-                    one, (donated, rng), tuple(stacked))
-                # persistable writes: the carried slots hold the final
-                # value; non-carried writes take the last step's slice
-                final_state = [s[-1] for s in states]
+                    return ([new_state[i] for i in refeed],
+                            [new_state[i] for i in extra_idx],
+                            rng), fetches
+                (donated, extra, rng), fetches = jax.lax.scan(
+                    one, (donated, extra0, rng), tuple(stacked))
+                final_state = [None] * n_writes
+                for slot, i in enumerate(refeed):
+                    final_state[i] = donated[slot]
+                for slot, i in enumerate(extra_idx):
+                    final_state[i] = extra[slot]
                 return fetches, final_state, rng
 
             jitted = jax.jit(multi, donate_argnums=(1,))

@@ -156,7 +156,10 @@ _LAYOUT_OPS = {"conv2d", "depthwise_conv2d", "pool2d", "batch_norm"}
 _LAYOUT_TRANSPARENT = {"relu", "relu6", "sigmoid", "tanh", "leaky_relu",
                        "elu", "swish", "gelu", "abs", "sqrt", "square",
                        "scale", "dropout", "elementwise_add",
-                       "elementwise_sub", "elementwise_mul", "prelu"}
+                       "elementwise_sub", "elementwise_mul"}
+# NOTE: prelu is NOT layout-transparent — its lowering reshapes Alpha
+# assuming channel dim 1 (mode='channel'/'element'), so passing NHWC
+# through it would broadcast Alpha against W instead of C.
 
 
 def _nchw_shape(s):

@@ -76,18 +76,58 @@ def mha_xla(q, k, v, kv_mask=None, causal=False, sm_scale=None,
 # ---------------------------------------------------------------------------
 
 def _tile_scores(q_ref, k_ref, mask_ref, qi, kb, *, sm_scale, causal,
-                 block_q, block_k):
-    """Masked scaled scores for one (q-block, k-block) tile."""
-    q = q_ref[:].astype(jnp.float32) * sm_scale
-    k_blk = k_ref[:].astype(jnp.float32)
-    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-    mask = mask_ref[0, :]
-    s = jnp.where(mask[None, :] > 0, s, NEG_INF)
+                 block_q, block_k, has_mask=True):
+    """Masked scaled scores for one (q-block, k-block) tile.
+
+    The dot runs in the INPUT dtype (bf16 on TPU) with an f32
+    accumulator — upcasting q/k first would push the MXU into f32 mode
+    at ~1/8 the bf16 rate; sm_scale applies to the f32 scores after."""
+    s = jnp.dot(q_ref[:], k_ref[:].T,
+                preferred_element_type=jnp.float32) * sm_scale
+    if has_mask:
+        mask = mask_ref[0, :]
+        s = jnp.where(mask[None, :] > 0, s, NEG_INF)
     if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        # unconditional masking measured FASTER than branching per tile
+        # (lax.cond on the diagonal predicate cost ~15% at T=8192 — the
+        # branch breaks Mosaic's straight-line VPU pipelining).  With
+        # square tiles the diagonal pattern is a CONSTANT triangular mask
+        # (hoisted out of the grid loop by Mosaic) OR'd with the scalar
+        # below-diagonal predicate — no per-tile iota arithmetic.
+        if block_q == block_k:
+            tri = (jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                   >= jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+            below = qi * block_q > kb * block_k  # strictly past the diagonal
+            above = qi * block_q < kb * block_k  # fully masked (reachable
+            # only as the degenerate clamped tile when Tk > Tq)
+            keep = jnp.logical_and(jnp.logical_or(below, tri),
+                                   jnp.logical_not(above))
+            s = jnp.where(keep, s, NEG_INF)
+        else:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     return s
+
+
+def _last_kb(qi, *, causal, block_q, block_k, num_kb):
+    """Last k-block index intersecting the causal frontier of q-block qi
+    (the whole k range when not causal)."""
+    if not causal:
+        return num_kb - 1
+    return jnp.minimum(((qi + 1) * block_q - 1) // block_k, num_kb - 1)
+
+
+def _first_qb(kb, *, causal, block_q, block_k, num_qb):
+    """First q-block index at/below the causal frontier of k-block kb,
+    clamped into range: a k-block entirely above the frontier (possible
+    when Tk > Tq) degenerates to the last q-block, whose fully-masked
+    tile contributes exact zeros — so dk/dv come out zero, not stale."""
+    if not causal:
+        return 0
+    return jnp.minimum((kb * block_k) // block_q, num_qb - 1)
 
 
 def _tile_dropout(seed_ref, bh, qi, kb, shape, rate: float):
@@ -120,14 +160,21 @@ def _tile_dropout(seed_ref, bh, qi, kb, shape, rate: float):
 def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                       m_scr, l_scr, acc_scr, *,
                       sm_scale: float, causal: bool, dropout_rate: float,
-                      block_q: int, block_k: int, num_kb: int):
+                      block_q: int, block_k: int, num_kb: int,
+                      has_mask: bool):
     """Grid (B*H, nq, nk); K/V stream through VMEM one block_k tile at a
     time (nk is the sequential minor grid axis on TPU, so the online-softmax
     state lives in VMEM scratch across k iterations — O(block) memory at any
-    sequence length).  Emits the per-row logsumexp for the backward pass."""
+    sequence length).  Emits the per-row logsumexp for the backward pass.
+
+    Causal tiles entirely above the diagonal are SKIPPED: no compute, and
+    the K/V index maps clamp to the causal frontier so the pipeline issues
+    no copies for them either — ~2x on long causal sequences."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     kb = pl.program_id(2)
+    last = _last_kb(qi, causal=causal, block_q=block_q, block_k=block_k,
+                    num_kb=num_kb)
 
     @pl.when(kb == 0)
     def _init():
@@ -135,22 +182,26 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
-                     causal=causal, block_q=block_q, block_k=block_k)
-    v_blk = v_ref[:].astype(jnp.float32)
+    @pl.when(kb <= last)
+    def _compute():
+        s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         has_mask=has_mask)
+        v_blk = v_ref[:]
 
-    m, l, acc = m_scr[:], l_scr[:], acc_scr[:]
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m - m_new)
-    m_scr[:] = m_new
-    l_scr[:] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    if dropout_rate > 0.0:
-        # dropout applies to normalized probs; l accumulates undropped
-        p = p * _tile_dropout(seed_ref, bh, qi, kb, p.shape, dropout_rate)
-    acc_scr[:] = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        m, l, acc = m_scr[:], l_scr[:], acc_scr[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            # dropout applies to normalized probs; l accumulates undropped
+            p = p * _tile_dropout(seed_ref, bh, qi, kb, p.shape, dropout_rate)
+        acc_scr[:] = acc * alpha + jnp.dot(
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32)
 
-    @pl.when(kb == num_kb - 1)
+    @pl.when(kb == last)
     def _finish():
         l_fin = l_scr[:]
         o_ref[:] = (acc_scr[:] / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
@@ -170,6 +221,11 @@ try:  # pallas import kept lazy-safe for exotic builds
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
+# NOTE(perf A/B, r3): CompilerParams(dimension_semantics=("parallel",
+# "parallel", "arbitrary")) measured ~20% SLOWER at T=8192 than the
+# default on this chip, as did per-tile lax.cond causal-mask branching —
+# both left out deliberately.
+
 
 def _pad_to(x, multiple, axis):
     rem = x.shape[axis] % multiple
@@ -182,37 +238,67 @@ def _pad_to(x, multiple, axis):
 
 
 def _resolve_blocks(block_q, block_k, Tq, Tk):
-    """Measured-best tile sizes on v5e (bench: 128x128 -> 31.9ms,
-    512x1024 -> 16.8ms fwd at T=4096): bigger K/V tiles amortize the
-    VMEM streaming against more MXU work per pass."""
+    """Measured-best tile sizes on v5e (r3 K-sweep at T=8192 causal
+    fwd+bwd: 512x1024 -> 46 ms, 1024x1024 -> 23 ms): big q-blocks cut
+    K/V restreaming (streamed bytes scale with Tq/block_q), big k-blocks
+    amortize VMEM pipelining; 2048-wide blocks fail to compile."""
     if block_q is None:
-        block_q = 512 if Tq >= 512 else 128
+        block_q = 1024 if Tq >= 1024 else (512 if Tq >= 512 else 128)
     if block_k is None:
         block_k = 1024 if Tk >= 1024 else (512 if Tk >= 512 else 128)
     return block_q, block_k
 
 
 def _prep_padded(q, k, v, kv_mask, block_q, block_k):
+    """Pad to block multiples and flatten (B,H).  When ``kv_mask`` is None
+    and no length padding was added, no mask array is materialized at all
+    (``has_mask=False`` compiles the mask load + where out of the kernels)."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    if kv_mask is None:
-        kv_mask = jnp.ones((B, Tk), jnp.float32)
     q4, _ = _pad_to(q, block_q, 2)
-    k4, _ = _pad_to(k, block_k, 2)
+    k4, pad_k = _pad_to(k, block_k, 2)
     v4, _ = _pad_to(v, block_k, 2)
-    mask2, _ = _pad_to(kv_mask.astype(jnp.float32), block_k, 1)
     Tq_p, Tk_p = q4.shape[2], k4.shape[2]
     qf = q4.reshape(B * H, Tq_p, D)
     kf = k4.reshape(B * H, Tk_p, D)
     vf = v4.reshape(B * H, Tk_p, D)
+    if kv_mask is None and pad_k == 0:
+        # never read (has_mask=False); one block wide — the mask index
+        # map pins block (b, 0, 0), so no larger buffer is ever touched
+        maskf = jnp.zeros((B * H, 1, block_k), jnp.float32)
+        return qf, kf, vf, maskf, Tq_p, Tk_p, False
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Tk), jnp.float32)
+    mask2, _ = _pad_to(kv_mask.astype(jnp.float32), block_k, 1)
     maskf = jnp.repeat(mask2[:, None, :], H, axis=1).reshape(B * H, 1, Tk_p)
-    return qf, kf, vf, maskf, Tq_p, Tk_p
+    return qf, kf, vf, maskf, Tq_p, Tk_p, True
 
 
 def _seed_arr(dropout_seed):
     if dropout_seed is None:
         return jnp.zeros((1,), jnp.int32)
     return jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+
+
+def _fwd_maps(causal, has_mask, block_q, block_k, num_kb):
+    """Index maps for K/V/mask blocks in q-major grids (fwd, dq): clamp
+    skipped causal tiles to the frontier block (_last_kb), so the pipeline
+    re-references the previous block and issues no copy for them."""
+    def kv_map(b, i, j):
+        j = _last_kb_clamp(j, i, causal, block_q, block_k)
+        return (b, j, 0)
+
+    def mask_map(b, i, j):
+        if not has_mask:
+            return (b, 0, 0)
+        return (b, 0, _last_kb_clamp(j, i, causal, block_q, block_k))
+    return kv_map, mask_map
+
+
+def _last_kb_clamp(j, i, causal, block_q, block_k):
+    if causal:
+        j = jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
+    return j
 
 
 def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
@@ -226,13 +312,15 @@ def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, H, Tq, D = q.shape
-    qf, kf, vf, maskf, Tq_p, Tk_p = _prep_padded(q, k, v, kv_mask,
-                                                 block_q, block_k)
+    qf, kf, vf, maskf, Tq_p, Tk_p, has_mask = _prep_padded(
+        q, k, v, kv_mask, block_q, block_k)
     num_kb = Tk_p // block_k
+
+    kv_map, mask_map = _fwd_maps(causal, has_mask, block_q, block_k, num_kb)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, sm_scale=sm_scale,
         causal=causal, dropout_rate=float(dropout_rate),
-        block_q=block_q, num_kb=num_kb)
+        block_q=block_q, num_kb=num_kb, has_mask=has_mask)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
@@ -243,9 +331,9 @@ def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # seed
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((None, block_k, D), kv_map),
+            pl.BlockSpec((None, block_k, D), kv_map),
+            pl.BlockSpec((None, 1, block_k), mask_map),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -279,29 +367,36 @@ def mha_pallas(q, k, v, kv_mask=None, causal=False, sm_scale=None,
 def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
                          lse_ref, delta_ref, dq_ref, dq_scr, *,
                          sm_scale, causal, dropout_rate,
-                         block_q, block_k, num_kb):
-    """Grid (B*H, nq, nk): dq accumulates across k-blocks in VMEM."""
+                         block_q, block_k, num_kb, has_mask):
+    """Grid (B*H, nq, nk): dq accumulates across k-blocks in VMEM.
+    Causal tiles above the diagonal skipped (no compute, no copies)."""
     bh, qi, kb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    last = _last_kb(qi, causal=causal, block_q=block_q, block_k=block_k,
+                    num_kb=num_kb)
 
     @pl.when(kb == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
-                     causal=causal, block_q=block_q, block_k=block_k)
-    lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
-    delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
-    p = jnp.exp(s - lse[:, None])                           # [bq, bk]
-    do = do_ref[:].astype(jnp.float32)
-    v_blk = v_ref[:].astype(jnp.float32)
-    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-    if dropout_rate > 0.0:
-        dp = dp * _tile_dropout(seed_ref, bh, qi, kb, dp.shape, dropout_rate)
-    ds = p * (dp - delta[:, None])
-    k_raw = k_ref[:].astype(jnp.float32)
-    dq_scr[:] += jnp.dot(ds, k_raw, preferred_element_type=jnp.float32) * sm_scale
+    @pl.when(kb <= last)
+    def _compute():
+        s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         has_mask=has_mask)
+        lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
+        p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        do = do_ref[:]
+        v_blk = v_ref[:]
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = dp * _tile_dropout(seed_ref, bh, qi, kb, dp.shape,
+                                    dropout_rate)
+        ds = (p * (dp - delta[:, None])).astype(k_ref.dtype)
+        dq_scr[:] += jnp.dot(ds, k_ref[:],
+                             preferred_element_type=jnp.float32) * sm_scale
 
-    @pl.when(kb == num_kb - 1)
+    @pl.when(kb == last)
     def _finish():
         dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
@@ -310,34 +405,41 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
                           lse_ref, delta_ref, dk_ref, dv_ref,
                           dk_scr, dv_scr, *,
                           sm_scale, causal, dropout_rate,
-                          block_q, block_k, num_qb):
-    """Grid (B*H, nk, nq): dk/dv accumulate across q-blocks in VMEM."""
+                          block_q, block_k, num_qb, has_mask):
+    """Grid (B*H, nk, nq): dk/dv accumulate across q-blocks in VMEM.
+    Causal q-blocks entirely above this k-block's diagonal are skipped."""
     bh, kb, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    first = _first_qb(kb, causal=causal, block_q=block_q, block_k=block_k,
+                      num_qb=num_qb)
 
-    @pl.when(qi == 0)
+    @pl.when(qi == first)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
-                     causal=causal, block_q=block_q, block_k=block_k)
-    lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
-    delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
-    p = jnp.exp(s - lse[:, None])                           # [bq, bk]
-    do = do_ref[:].astype(jnp.float32)
-    v_blk = v_ref[:].astype(jnp.float32)
-    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-    if dropout_rate > 0.0:
-        # same (bh, qi, kb) seeding as forward/dq → identical bits
-        drop = _tile_dropout(seed_ref, bh, qi, kb, p.shape, dropout_rate)
-        dv_scr[:] += jnp.dot((p * drop).T, do,
-                             preferred_element_type=jnp.float32)
-        dp = dp * drop
-    else:
-        dv_scr[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None])
-    q_raw = q_ref[:].astype(jnp.float32)
-    dk_scr[:] += jnp.dot(ds.T, q_raw, preferred_element_type=jnp.float32) * sm_scale
+    @pl.when(qi >= first)
+    def _compute():
+        s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         has_mask=has_mask)
+        lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
+        p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        do = do_ref[:]
+        v_blk = v_ref[:]
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # same (bh, qi, kb) seeding as forward/dq → identical bits
+            drop = _tile_dropout(seed_ref, bh, qi, kb, p.shape, dropout_rate)
+            dv_scr[:] += jnp.dot((p * drop).astype(do.dtype).T, do,
+                                 preferred_element_type=jnp.float32)
+            dp = dp * drop
+        else:
+            dv_scr[:] += jnp.dot(p.astype(do.dtype).T, do,
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None])).astype(q_ref.dtype)
+        dk_scr[:] += jnp.dot(ds.T, q_ref[:],
+                             preferred_element_type=jnp.float32) * sm_scale
 
     @pl.when(qi == num_qb - 1)
     def _finish():
@@ -355,8 +457,8 @@ def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, H, Tq, D = q.shape
-    qf, kf, vf, maskf, Tq_p, Tk_p = _prep_padded(q, k, v, kv_mask,
-                                                 block_q, block_k)
+    qf, kf, vf, maskf, Tq_p, Tk_p, has_mask = _prep_padded(
+        q, k, v, kv_mask, block_q, block_k)
     gof, _ = _pad_to(g.reshape(B * H, Tq, D), block_q, 1)
     outf, _ = _pad_to(out.reshape(B * H, Tq, D), block_q, 1)
     delta = jnp.sum(gof.astype(jnp.float32) * outf.astype(jnp.float32),
@@ -364,10 +466,11 @@ def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
     num_qb, num_kb = Tq_p // block_q, Tk_p // block_k
     seed = _seed_arr(dropout_seed)
 
+    kv_map, mask_map = _fwd_maps(causal, has_mask, block_q, block_k, num_kb)
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
         dropout_rate=float(dropout_rate), block_q=block_q, block_k=block_k,
-        num_kb=num_kb)
+        num_kb=num_kb, has_mask=has_mask)
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
@@ -375,9 +478,9 @@ def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((None, block_k, D), kv_map),
+            pl.BlockSpec((None, block_k, D), kv_map),
+            pl.BlockSpec((None, 1, block_k), mask_map),
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, 1, Tq_p), lambda b, i, j: (b, 0, 0)),
             pl.BlockSpec((None, 1, Tq_p), lambda b, i, j: (b, 0, 0)),
@@ -387,10 +490,21 @@ def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
         interpret=interpret,
     )(seed, qf, kf, vf, maskf, gof, lse, delta)
 
+    def q_map(b, j, i):
+        # clamp skipped above-diagonal q-blocks to this k-block's frontier
+        # (same clamp as _first_qb, incl. the num_qb bound for Tk > Tq)
+        if causal:
+            i = jnp.maximum(i, _first_qb(j, causal=causal, block_q=block_q,
+                                         block_k=block_k, num_qb=num_qb))
+        return (b, i, 0)
+
+    def qmask_map(b, j, i):
+        return (b, 0, 0) if not has_mask else (b, 0, j)
+
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
         dropout_rate=float(dropout_rate), block_q=block_q, block_k=block_k,
-        num_qb=num_qb)
+        num_qb=num_qb, has_mask=has_mask)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=[
@@ -400,11 +514,11 @@ def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
         grid=(B * H, num_kb, num_qb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, D), q_map),
             pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, 1, block_k), lambda b, j, i: (b, 0, j)),
-            pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, 1, block_k), qmask_map),
+            pl.BlockSpec((None, block_q, D), q_map),
             pl.BlockSpec((None, 1, Tq_p), lambda b, j, i: (b, 0, 0)),
             pl.BlockSpec((None, 1, Tq_p), lambda b, j, i: (b, 0, 0)),
         ],

@@ -597,11 +597,13 @@ def array_to_lod_tensor(x, table):
     helper = LayerHelper("array_to_lod_tensor")
     out = helper.create_variable_for_type_inference(
         x.dtype, shape=(x.shape[1], x.shape[0]) + tuple(x.shape[2:]))
+    out_len = helper.create_variable_for_type_inference(
+        "int64", shape=(x.shape[1],), stop_gradient=True)
     helper.append_op("array_to_lod_tensor",
-                     {"X": [x], "RankIdx": [table.rank_idx]},
-                     {"Out": [out]}, {})
-    _alias_len(out, table.rank_len)  # lengths in original order differ;
-    # rank_len reordered back is the caller's seq_len — kept for shape
+                     {"X": [x], "RankIdx": [table.rank_idx],
+                      "RankLen": [table.rank_len]},
+                     {"Out": [out], "OutLen": [out_len]}, {})
+    _alias_len(out, out_len)  # lengths restored to original row order
     return out
 
 

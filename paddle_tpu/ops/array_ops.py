@@ -167,14 +167,20 @@ def _lod_tensor_to_array(ctx, ins, attrs):
     return {"Out": [arr], "LenOut": [jnp.full((1,), T, jnp.int64)]}
 
 
-@register("array_to_lod_tensor", no_grad_slots=("RankIdx",))
+@register("array_to_lod_tensor", no_grad_slots=("RankIdx", "RankLen"))
 def _array_to_lod_tensor(ctx, ins, attrs):
     arr = ins["X"][0]
     idx = ins["RankIdx"][0].astype(jnp.int32)
     x = jnp.swapaxes(arr, 0, 1)  # [B, T, ...] still in rank order
     inv = jnp.zeros_like(idx).at[idx].set(
         jnp.arange(idx.shape[0], dtype=idx.dtype))
-    return {"Out": [x[inv]]}
+    out = {"Out": [x[inv]]}
+    if ins.get("RankLen"):
+        # Restore lengths to original sequence order so downstream ops
+        # mask with the right per-row length (reference restores the
+        # original LoD exactly, array_to_lod_tensor_op.cc).
+        out["OutLen"] = [ins["RankLen"][0][inv]]
+    return out
 
 
 @register("shrink_rnn_memory", no_grad_slots=("I", "RankLen"))

@@ -25,8 +25,10 @@ from ..kernels import attention as A
 @register("fused_attention", no_grad_slots=("KvMask", "Seed"))
 def _fused_attention(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
-    kv_mask = ins["KvMask"][0] if ins.get("KvMask") else jnp.ones(
-        (q.shape[0], k.shape[2]), jnp.float32)
+    # None flows through every impl and lets the pallas kernels compile
+    # out the mask load + per-tile where entirely (ring materializes ones
+    # below because shard_map must shard a real array)
+    kv_mask = ins["KvMask"][0] if ins.get("KvMask") else None
     causal = attrs.get("causal", False)
     scale = attrs.get("scale", None)
     impl = attrs.get("impl", "auto")
@@ -60,6 +62,8 @@ def _fused_attention(ctx, ins, attrs):
             out = A.mha_xla(q, k, v, kv_mask, causal, scale,
                             dropout_rate=rate, dropout_seed=seed)
         else:
+            if kv_mask is None:
+                kv_mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
             dp = "dp" if "dp" in mesh.axis_names else None
             qspec = P(dp, None, sp, None)
             mspec = P(dp, sp)
@@ -85,10 +89,8 @@ def _fused_attention_grad(ctx, ins, attrs):
     """Backward: differentiate the forward lowering (flash recompute /
     ring ppermute-transpose handled by jax)."""
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
-    kv_mask = ins["KvMask"][0] if ins.get("KvMask") else jnp.ones(
-        (q.shape[0], k.shape[2]), jnp.float32)
     g = ins["Out@GRAD"][0]
-    extra = {"KvMask": [kv_mask]}
+    extra = {"KvMask": ins["KvMask"]} if ins.get("KvMask") else {}
     if ins.get("Seed"):
         extra["Seed"] = ins["Seed"]  # same seed → identical dropout bits
 

@@ -115,10 +115,23 @@ class ParallelExecutor(Executor):
             program=self._program, feed=feed, fetch_list=fetch_list,
             scope=self._scope, return_numpy=return_numpy)
         if true_batch is not None:
+            # Slice off padding rows only from batch-aligned fetches: a
+            # var whose program-declared leading dim is symbolic (-1 =
+            # batch).  A weight/table coincidentally sized [pad_to, ...]
+            # has a concrete declared leading dim and must not be cut.
+            names = [v.name if hasattr(v, "name") else str(v)
+                     for v in (fetch_list or [])]
+            blk = self._program.global_block
+            def _batch_aligned(name):
+                var = blk.var_or_none(name)
+                return var is not None and len(var.shape) >= 1 \
+                    and var.shape[0] == -1
             outs = [o[:true_batch]
                     if getattr(o, "ndim", 0) >= 1
-                    and o.shape[0] == self._padded_batch else o
-                    for o in outs]
+                    and o.shape[0] == self._padded_batch
+                    and (i >= len(names) or _batch_aligned(names[i]))
+                    else o
+                    for i, o in enumerate(outs)]
         return outs
 
     def _maybe_pad_partial_batch(self, feed):

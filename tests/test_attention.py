@@ -188,6 +188,31 @@ def test_pallas_bwd_kernels_match_xla(causal):
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_pallas_bwd_cross_length_causal():
+    """Tq < Tk causal (chunked-prefill shape): k-blocks entirely above the
+    causal frontier must produce ZERO dk/dv, not a stale copy of the
+    previous k-block's accumulator (regression: _first_qb clamping)."""
+    B, H, D = 1, 2, 16
+    q = rng.randn(B, H, 128, D).astype("float32")
+    k = rng.randn(B, H, 256, D).astype("float32")
+    v = rng.randn(B, H, 256, D).astype("float32")
+
+    def loss_flash(q, k, v):
+        return jnp.sum(A.flash_attention(q, k, v, None, True, None) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(A.mha_xla(q, k, v, None, True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+    # keys past the causal frontier get exactly zero gradient
+    np.testing.assert_array_equal(np.asarray(g1[1][:, :, 128:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(g1[2][:, :, 128:]), 0.0)
+
+
 def test_flash_dropout_deterministic_and_scaled():
     q, k, v, mask = qkv(T=64)
     seed = jnp.asarray([42], jnp.int32)

@@ -57,6 +57,27 @@ def test_lod_tensor_array_roundtrip():
     np.testing.assert_allclose(step0, x[[1, 0], 0])  # rank order at t=0
 
 
+def test_array_to_lod_tensor_restores_lengths():
+    # ADVICE r2: the restored tensor's @LEN companion must be in the
+    # ORIGINAL row order (not rank order), or sequence ops downstream
+    # mask with permuted lengths.
+    lens = np.array([2, 4, 1], "int64")
+    x = np.arange(3 * 4 * 1, dtype="float32").reshape(3, 4, 1)
+
+    def build():
+        d = fluid.layers.data("x", [4, 1], lod_level=1)
+        table = fluid.layers.lod_rank_table(d)
+        arr = fluid.layers.lod_tensor_to_array(d, table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        pooled = fluid.layers.sequence_pool(back, "sum")
+        return [back, pooled]
+
+    back, pooled = _run(build, {"x": x, "x@LEN": lens})
+    np.testing.assert_allclose(back, x)
+    want = np.stack([x[i, :lens[i]].sum(0) for i in range(3)])
+    np.testing.assert_allclose(pooled, want)
+
+
 def test_shrink_memory_masks_finished_rows():
     lens = np.array([1, 3, 2], "int64")
     mem = np.ones((3, 4), "float32")

@@ -333,6 +333,33 @@ A100_RESNET50_IMG_S = 2500.0
 A100_TRANSFORMER_TOK_S = 50000.0
 
 
+def bench_scaling():
+    """Weak-scaling efficiency on the virtual 8-device CPU mesh (see
+    paddle_tpu/parallel/scaling.py — per-device compiled cost, the only
+    honest scaling instrument on a 1-core host).  Subprocess because the
+    axon TPU plugin, once registered, pins this process to 1 device."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import json; from paddle_tpu.parallel.scaling import "
+            "scaling_report; print('SCALING=' + "
+            "json.dumps(scaling_report(per_device_batch=4, big_dp=8)))")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd=os.path.dirname(os.path.abspath(__file__)),
+                         capture_output=True, text=True, timeout=900)
+    for line in out.stdout.splitlines():
+        if line.startswith("SCALING="):
+            rep = json.loads(line[len("SCALING="):])
+            assert rep["eff_flops"] >= 0.85, rep
+            return rep
+    raise RuntimeError(f"scaling child failed: {out.stderr[-500:]}")
+
+
 def main():
     configs = {}
     for name, fn in [("resnet50", bench_resnet50),
@@ -340,7 +367,8 @@ def main():
                      ("stacked_lstm", bench_stacked_lstm),
                      ("deepfm", bench_deepfm),
                      ("mnist", bench_mnist),
-                     ("flash_attention_seq8k", bench_flash_attention_long)]:
+                     ("flash_attention_seq8k", bench_flash_attention_long),
+                     ("scaling_dp8", bench_scaling)]:
         try:
             configs[name] = fn()
         except Exception as e:  # a broken config must not hide the rest

@@ -1,0 +1,246 @@
+"""Fused LSTM cell — Pallas TPU kernels.
+
+Reference precedent: the hand-fused CPU JIT RNN kernels
+(``paddle/fluid/operators/math/jit_kernel_rnn.cc``, ``lstm_compute.h``) —
+the reference fuses the cell's elementwise tail into the gate GEMM because
+a naive per-step op chain is bandwidth-bound.  Same argument on TPU, so
+the whole time loop IS the kernel here:
+
+- grid = (T,): one sequential grid step per time step; the recurrent
+  weights ride VMEM for the entire scan (constant index map — copied in
+  once), h/c state lives in f32 VMEM scratch, never round-tripping HBM.
+- forward stores ONLY hs/cs (the op's outputs); the backward kernel
+  recomputes the gates from hs[t-1]/xproj[t] — one extra [B,4H] GEMM per
+  step in exchange for not writing four [T,B,H] gate tensors in forward
+  (the FlashAttention trade applied to the RNN cell).
+- backward: reversed-time grid; dh/dc carries and the full dW
+  accumulator live in VMEM scratch; emits per-step dX-projection and the
+  initial-state grads.
+
+Gradients are wired at the PROGRAM level (ops/nn_ops.py registers an
+explicit ``lstm`` grad that calls :func:`lstm_fused_grad`), not via
+``jax.custom_vjp`` — the axon PJRT plugin miscompiles custom_vjp bwd
+closures under ``lax.scan`` (KeyError in the closed_call lowering cache),
+and the explicit grad op is the framework's native mechanism anyway.
+
+Length masking matches the XLA lowering (ops/nn_ops.py _lstm): finished
+rows pass h/c through unchanged, so grads flow straight through masked
+steps.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import kept lazy-safe for exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+if _HAVE_PALLAS:
+    # w + dW output + dW scratch are ~4 MB each at H=512 — past the 16 MB
+    # default scoped-vmem limit with double-buffered blocks; v5e has
+    # 128 MB physical VMEM, so raise the cap for these kernels.
+    _VMEM_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+else:  # pragma: no cover
+    _VMEM_PARAMS = None
+
+
+def _gates(x_t, h, w):
+    """[B,4H] pre-activations -> post-activation (i, f, g, o)."""
+    H = h.shape[-1]
+    pre = x_t.astype(jnp.float32) + jnp.dot(
+        h.astype(w.dtype), w[:], preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(pre[:, :H])
+    f = jax.nn.sigmoid(pre[:, H:2 * H])
+    g = jnp.tanh(pre[:, 2 * H:3 * H])
+    o = jax.nn.sigmoid(pre[:, 3 * H:])
+    return i, f, g, o
+
+
+def _lstm_fwd_kernel(xs_ref, w_ref, m_ref, h0_ref, c0_ref,
+                     hs_ref, cs_ref, h_scr, c_scr, *, T: int):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h, c = h_scr[:], c_scr[:]
+    i, f, g, o = _gates(xs_ref[0], h, w_ref)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    m = m_ref[0, 0][:, None].astype(jnp.float32)      # [B, 1]
+    c_out = m * c_new + (1.0 - m) * c
+    h_out = m * h_new + (1.0 - m) * h
+    h_scr[:] = h_out
+    c_scr[:] = c_out
+    hs_ref[0] = h_out.astype(hs_ref.dtype)
+    cs_ref[0] = c_out.astype(cs_ref.dtype)
+
+
+def _lstm_bwd_kernel(xs_ref, w_ref, m_ref, h0_ref, c0_ref,
+                     hsm1_ref, csm1_ref, cs_ref, dhs_ref, dcs_ref,
+                     dxs_ref, dw_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr, dw_scr, *, T: int):
+    idx = pl.program_id(0)          # 0..T-1, walking time BACKWARD
+    t = T - 1 - idx
+
+    @pl.when(idx == 0)
+    def _init():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dc_scr[:] = jnp.zeros_like(dc_scr)
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+
+    is_first = t == 0
+    c_prev = jnp.where(is_first, c0_ref[:].astype(jnp.float32),
+                       csm1_ref[0].astype(jnp.float32))
+    h_prev = jnp.where(is_first, h0_ref[:].astype(jnp.float32),
+                       hsm1_ref[0].astype(jnp.float32))
+    i, f, g, o = _gates(xs_ref[0], h_prev, w_ref)     # recompute
+    c_t = cs_ref[0].astype(jnp.float32)
+    m = m_ref[0, 0][:, None].astype(jnp.float32)
+
+    dh_total = dhs_ref[0].astype(jnp.float32) + dh_scr[:]
+    dc_total = dcs_ref[0].astype(jnp.float32) + dc_scr[:]
+    dh_new = m * dh_total
+    dc_new = m * dc_total
+    tc = jnp.tanh(c_t)
+    do = dh_new * tc
+    dc_new = dc_new + dh_new * o * (1.0 - tc * tc)
+    di = dc_new * g
+    df = dc_new * c_prev
+    dg = dc_new * i
+    dc_prev = dc_new * f + (1.0 - m) * dc_total
+    dgates = jnp.concatenate(
+        [di * i * (1.0 - i), df * f * (1.0 - f),
+         dg * (1.0 - g * g), do * o * (1.0 - o)], axis=-1)  # [B, 4H]
+    dxs_ref[0] = dgates.astype(dxs_ref.dtype)
+    wd = w_ref[:]
+    dh_prev = jnp.dot(dgates.astype(wd.dtype), wd.T,
+                      preferred_element_type=jnp.float32) \
+        + (1.0 - m) * dh_total
+    dw_scr[:] += jnp.dot(h_prev.astype(wd.dtype).T, dgates.astype(wd.dtype),
+                         preferred_element_type=jnp.float32)
+    dh_scr[:] = dh_prev
+    dc_scr[:] = dc_prev
+
+    @pl.when(idx == T - 1)
+    def _finish():
+        dw_ref[:] = dw_scr[:].astype(dw_ref.dtype)
+        dh0_ref[:] = dh_scr[:].astype(dh0_ref.dtype)
+        dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
+
+
+def _tm(x):
+    """[B,T,...] -> time-major [T,B,...]."""
+    return jnp.swapaxes(x, 0, 1)
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def lstm_fused(xproj, w, h0, c0, mask, interpret=None):
+    """Fused LSTM scan (forward only — grads via :func:`lstm_fused_grad`).
+
+    xproj [B,T,4H] (x·Wx+b), w [H,4H], h0/c0 [B,H], mask [B,T] (1.0 =
+    live step).  Returns (hs [B,T,H], cs [B,T,H]).  Gate order i,f,c,o
+    matches ops/nn_ops.py _lstm."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, T, H4 = xproj.shape
+    H = H4 // 4
+    xs, ms = _tm(xproj), _tm(mask)[:, None, :]   # [T,1,B]: TPU-tileable
+    kernel = functools.partial(_lstm_fwd_kernel, T=T)
+    hs, cs = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((T, B, H), xproj.dtype),
+                   jax.ShapeDtypeStruct((T, B, H), xproj.dtype)],
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),   # xs
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),         # w (resident)
+            pl.BlockSpec((1, 1, B), lambda t: (t, 0, 0)),    # mask
+            pl.BlockSpec((B, H), lambda t: (0, 0)),          # h0
+            pl.BlockSpec((B, H), lambda t: (0, 0)),          # c0
+        ],
+        out_specs=[pl.BlockSpec((1, B, H), lambda t: (t, 0, 0))] * 2,
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32),
+                        pltpu.VMEM((B, H), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(xs, w, ms, h0, c0)
+    return _tm(hs), _tm(cs)
+
+
+def lstm_fused_grad(xproj, w, h0, c0, mask, hs, cs, dhs, dcs,
+                    interpret=None):
+    """Backward of :func:`lstm_fused` — all batch-major [B,T,...] in/out.
+    Returns (dxproj, dw, dh0, dc0)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, T, H4 = xproj.shape
+    H = H4 // 4
+    xs, ms = _tm(xproj), _tm(mask)[:, None, :]   # [T,1,B]
+    hs_tm, cs_tm = _tm(hs), _tm(cs)
+    dhs_tm = _tm(dhs).astype(xproj.dtype)
+    dcs_tm = _tm(dcs).astype(xproj.dtype)
+    kernel = functools.partial(_lstm_bwd_kernel, T=T)
+
+    def rev(t):
+        return (T - 1 - t, 0, 0)
+
+    def revm1(t):
+        # block t-1 (clamped to 0; kernel selects the initial state at t=0)
+        return (jnp.maximum(T - 2 - t, 0), 0, 0)
+
+    dxs, dw, dh0, dc0 = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((T, B, H4), xproj.dtype),
+                   jax.ShapeDtypeStruct((H, H4), w.dtype),
+                   jax.ShapeDtypeStruct((B, H), xproj.dtype),
+                   jax.ShapeDtypeStruct((B, H), xproj.dtype)],
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), rev),                  # xs
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),        # w
+            pl.BlockSpec((1, 1, B), rev),                   # mask
+            pl.BlockSpec((B, H), lambda t: (0, 0)),         # h0
+            pl.BlockSpec((B, H), lambda t: (0, 0)),         # c0
+            pl.BlockSpec((1, B, H), revm1),                 # hs[t-1]
+            pl.BlockSpec((1, B, H), revm1),                 # cs[t-1]
+            pl.BlockSpec((1, B, H), rev),                   # cs[t]
+            pl.BlockSpec((1, B, H), rev),                   # dhs
+            pl.BlockSpec((1, B, H), rev),                   # dcs
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H4), rev),                  # dxs
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),        # dw
+            pl.BlockSpec((B, H), lambda t: (0, 0)),         # dh0
+            pl.BlockSpec((B, H), lambda t: (0, 0)),         # dc0
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32),
+                        pltpu.VMEM((B, H), jnp.float32),
+                        pltpu.VMEM((H, H4), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(xs, w, ms, h0, c0, hs_tm, cs_tm, cs_tm, dhs_tm, dcs_tm)
+    return _tm(dxs), dw, dh0, dc0
+
+
+def lstm_supported(B, T, H, dtype) -> bool:
+    """Pallas path gate: MXU-friendly shapes whose VMEM-resident weight
+    footprint fits (w + dW output block + dW f32 scratch ≈ 3·H·4H·4 B
+    must stay well under the 100 MB cap); anything else takes the XLA
+    scan lowering."""
+    if not _HAVE_PALLAS:
+        return False
+    if 3 * H * 4 * H * 4 > 80 * 1024 * 1024:   # H > ~1290
+        return False
+    return H % 128 == 0 and B % 8 == 0 and T >= 1

@@ -360,6 +360,17 @@ def _length_mask(seq_len, B, T, dtype):
     return (t < seq_len[:, None]).astype(dtype)
 
 
+def _lstm_pallas_eligible(ctx, B, T, H, dtype, attrs):
+    from ..kernels import rnn as _rnn
+
+    force = attrs.get("use_pallas_kernel", None)
+    if force is not None:
+        return bool(force)
+    top_level = ctx.block is None or getattr(ctx.block, "idx", 0) == 0
+    return (jax.default_backend() == "tpu" and top_level
+            and _rnn.lstm_supported(B, T, H, dtype))
+
+
 @register("lstm", no_grad_slots=("SeqLen",))
 def _lstm(ctx, ins, attrs):
     """Fused LSTM over a padded batch (lstm_op.cc + math/lstm_compute
@@ -379,6 +390,25 @@ def _lstm(ctx, ins, attrs):
     seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
     mask = _length_mask(seq_len, B, T, xproj.dtype)
     reverse = attrs.get("is_reverse", False)
+
+    # Fused Pallas cell (jit_kernel_rnn.cc analogue): whole scan in one
+    # kernel, recurrent weights VMEM-resident.  TPU + MXU-aligned shapes
+    # + top-level block only (control-flow sub-blocks differentiate via
+    # jax.vjp, which cannot see through a pallas_call — they keep the XLA
+    # scan); attr use_pallas_kernel forces it (interpret) for kernel tests.
+    use_pallas = _lstm_pallas_eligible(ctx, B, T, H, xproj.dtype, attrs)
+    from ..kernels import rnn as _rnn
+    if use_pallas:
+        xp, mk = (jnp.flip(xproj, 1), jnp.flip(mask, 1)) if reverse \
+            else (xproj, mask)
+        hs_bt, cs_bt = _rnn.lstm_fused(
+            xp, w, h0.astype(xproj.dtype), c0.astype(xproj.dtype),
+            mk.astype(jnp.float32))
+        h_last, c_last = hs_bt[:, -1], cs_bt[:, -1]
+        if reverse:
+            hs_bt, cs_bt = jnp.flip(hs_bt, 1), jnp.flip(cs_bt, 1)
+        return {"Hidden": [hs_bt], "Cell": [cs_bt],
+                "LastH": [h_last], "LastC": [c_last]}
 
     xs = jnp.swapaxes(xproj, 0, 1)  # [T,B,4H]
     ms = jnp.swapaxes(mask, 0, 1)[..., None]  # [T,B,1]
@@ -407,6 +437,67 @@ def _lstm(ctx, ins, attrs):
         "LastH": [h_last],
         "LastC": [c_last],
     }
+
+
+@register_grad("lstm")
+def _lstm_grad(ctx, ins, attrs):
+    """Explicit lstm backward: the Pallas path calls the fused backward
+    kernel (gates recomputed in-kernel); other shapes fall back to
+    jax.vjp of the XLA scan lowering.  Registered explicitly because the
+    axon plugin miscompiles custom_vjp closures under lax.scan (see
+    kernels/rnn.py module docstring)."""
+    from ..core import registry as _registry
+    from ..kernels import rnn as _rnn
+
+    xproj = ins["Input"][0]
+    B, T, H4 = xproj.shape
+    H = H4 // 4
+    if not _lstm_pallas_eligible(ctx, B, T, H, xproj.dtype, attrs):
+        fwd_attrs = {**attrs, "use_pallas_kernel": False}
+        return _registry.vjp_grad(_registry.get("lstm"), ctx, ins, fwd_attrs)
+
+    w = ins["Weight"][0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), xproj.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), xproj.dtype)
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    mask = _length_mask(seq_len, B, T, jnp.float32)
+    reverse = attrs.get("is_reverse", False)
+    hs, cs = ins["Hidden"][0], ins["Cell"][0]
+
+    def grad_or_zeros(slot, shape):
+        g = ins.get(slot)
+        if g and g[0] is not None:
+            return g[0].astype(jnp.float32)
+        return jnp.zeros(shape, jnp.float32)
+
+    dhs = grad_or_zeros("Hidden@GRAD", (B, T, H))
+    dcs = grad_or_zeros("Cell@GRAD", (B, T, H))
+    # move everything into the (possibly flipped) scan domain; LastH/LastC
+    # are the scan-domain step T-1 states, so their cotangents fold there
+    if reverse:
+        xp, mk = jnp.flip(xproj, 1), jnp.flip(mask, 1)
+        hs_f, cs_f = jnp.flip(hs, 1), jnp.flip(cs, 1)
+        dhs_f, dcs_f = jnp.flip(dhs, 1), jnp.flip(dcs, 1)
+    else:
+        xp, mk, hs_f, cs_f, dhs_f, dcs_f = xproj, mask, hs, cs, dhs, dcs
+    g = ins.get("LastH@GRAD")
+    if g and g[0] is not None:
+        dhs_f = dhs_f.at[:, -1].add(g[0].astype(jnp.float32))
+    g = ins.get("LastC@GRAD")
+    if g and g[0] is not None:
+        dcs_f = dcs_f.at[:, -1].add(g[0].astype(jnp.float32))
+
+    dxs, dw, dh0, dc0 = _rnn.lstm_fused_grad(
+        xp, w, h0.astype(xproj.dtype), c0.astype(xproj.dtype), mk,
+        hs_f, cs_f, dhs_f, dcs_f)
+    if reverse:
+        dxs = jnp.flip(dxs, 1)
+    outs = {"Input@GRAD": [dxs], "Weight@GRAD": [dw]}
+    if ins.get("H0"):
+        outs["H0@GRAD"] = [dh0]
+    if ins.get("C0"):
+        outs["C0@GRAD"] = [dc0]
+    return outs
 
 
 @register("gru", no_grad_slots=("SeqLen",))
@@ -621,7 +712,10 @@ def _fused_lstm_tail(ctx, op_name, xproj, ins, attrs):
     for slot in ("H0", "C0", "SeqLen"):
         if ins.get(slot):
             sub[slot] = ins[slot]
-    out = _lstm(ctx, sub, attrs)
+    # XLA scan only: the fused family's backward is vjp_grad through this
+    # lowering, and jax.vjp cannot see through the Pallas cell (only the
+    # plain lstm op has the explicit Pallas grad)
+    out = _lstm(ctx, sub, {**attrs, "use_pallas_kernel": False})
     return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": [xproj]}
 
 

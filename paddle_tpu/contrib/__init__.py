@@ -3,7 +3,8 @@ from .trainer import (BeginEpochEvent, BeginStepEvent, CheckpointConfig,
                       EndEpochEvent, EndStepEvent, Trainer)
 from .inferencer import Inferencer
 from .mixed_precision import Float16Transpiler, transpile_to_bf16
+from .quantize import QuantizeTranspiler
 
 __all__ = ["Trainer", "Inferencer", "BeginEpochEvent", "EndEpochEvent",
            "BeginStepEvent", "EndStepEvent", "CheckpointConfig",
-           "Float16Transpiler", "transpile_to_bf16"]
+           "Float16Transpiler", "transpile_to_bf16", "QuantizeTranspiler"]

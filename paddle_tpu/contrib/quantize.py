@@ -1,0 +1,177 @@
+"""Quantization-aware training transpiler.
+
+Reference: ``python/paddle/fluid/contrib/quantize/quantize_transpiler.py:1``
+(QuantizeTranspiler): rewrite a program so every quantizable op (conv2d,
+depthwise_conv2d, mul) consumes fake-quantized versions of its inputs —
+simulating int8 error during training; gradients pass straight through
+(ops/quant_ops.py registers STE grads).
+
+TPU redesign notes:
+- The reference inserts a fake_quantize op producing an int-domain tensor
+  followed by a fake_dequantize back to float.  This repo's fake_quantize
+  lowerings (ops/quant_ops.py) emit the quantize→dequantize COMPOSITION
+  directly (one op, float in/float out) — same math, one HLO fusion, and
+  the int tensor never materializes in HBM.  The ``.quantized.dequantized``
+  var naming of the reference is kept so freeze tooling can recognize it.
+- ``range_abs_max`` maps to the moving-average scale op (the reference's
+  window-based range tracker serves the same purpose: a running estimate
+  of the activation range that inference can reuse); its scale/accum/state
+  ride persistable vars initialized by the startup program.
+- Transpile may run before OR after backward ops exist, like the
+  reference: forward ops are rewired to the quantized inputs, and any
+  existing grad ops get their forward-input references renamed
+  (straight-through at the same points).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.program import (Program, default_main_program,
+                            default_startup_program)
+from ..core.registry import GRAD_OP_SUFFIX
+
+_QUANTIZABLE_OP_TYPES = ("conv2d", "depthwise_conv2d", "mul")
+_QUANT_TYPES = ("abs_max", "range_abs_max", "moving_average_abs_max")
+
+
+def _quantized_var_name(name):
+    return f"{name}.quantized.dequantized"
+
+
+def _scale_name(name):
+    return f"{name}.scale"
+
+
+class QuantizeTranspiler:
+    """Program rewrite for simulated-quantization training (reference
+    quantize_transpiler.py:80 API)."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 window_size: Optional[int] = None,
+                 moving_rate: float = 0.9):
+        if activation_quantize_type not in _QUANT_TYPES:
+            raise ValueError(
+                f"Unknown activation_quantize_type {activation_quantize_type!r};"
+                f" one of {_QUANT_TYPES}")
+        if weight_quantize_type not in ("abs_max",):
+            raise ValueError(
+                f"Unknown weight_quantize_type {weight_quantize_type!r}; "
+                "weights are fixed per step, 'abs_max' is the supported mode")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        # the reference's range tracker averages over a window_size-step
+        # window; the moving-average scale op approximates it with an EMA
+        # of the same effective horizon (rate = 1 - 1/window)
+        self.moving_rate = (moving_rate if window_size is None
+                            else max(moving_rate, 1.0 - 1.0 / window_size))
+
+    # -- public API --------------------------------------------------------
+    def training_transpile(self, program: Optional[Program] = None,
+                           startup_program: Optional[Program] = None):
+        """In-place rewrite: insert fake-quant ops ahead of every
+        quantizable op and rewire op (and existing grad-op) inputs."""
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        block = program.global_block
+        params = {name for name, v in block.vars.items()
+                  if getattr(v, "persistable", False)}
+        grad_types = {t + GRAD_OP_SUFFIX for t in _QUANTIZABLE_OP_TYPES}
+
+        qdq_of = {}           # original name -> qdq name
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type in _QUANTIZABLE_OP_TYPES and not op.attrs.get(
+                    "__quantized__", False):
+                for slot, names in list(op.inputs.items()):
+                    for j, name in enumerate(names):
+                        if not name or name.endswith(".quantized.dequantized"):
+                            continue
+                        if name not in qdq_of:
+                            n_new = self._insert_qdq(
+                                block, startup, i, name, name in params)
+                            qdq_of[name] = n_new
+                            i += 1              # one op inserted before
+                        op.inputs[slot][j] = qdq_of[name]
+                op.attrs["__quantized__"] = True
+                program._version += 1
+            elif op.type in grad_types:
+                # straight-through: grad ops read the same qdq'ed values
+                # the forward consumed (reference _transpile_backward)
+                for slot, names in op.inputs.items():
+                    if slot.endswith("@GRAD"):
+                        continue
+                    for j, name in enumerate(names):
+                        if name in qdq_of:
+                            op.inputs[slot][j] = qdq_of[name]
+                program._version += 1
+            i += 1
+        return program
+
+    def freeze_program(self, program: Optional[Program] = None):
+        """Stamp the rewritten program for inference (is_test):
+        moving-average/range activation quantizers switch to their stored
+        running scales; plain abs_max quantizers stay dynamic BY DESIGN —
+        the reference documents abs_max as "calculated dynamically each
+        step in both training and testing period"
+        (quantize_transpiler.py:96).  The save/load_inference_model path
+        keeps the ops in-graph."""
+        program = program or default_main_program()
+        for op in program.global_block.ops:
+            if op.type.startswith("fake_") and "quantize" in op.type:
+                op.attrs["is_test"] = True
+        program._version += 1
+        return program
+
+    # -- internals ---------------------------------------------------------
+    def _insert_qdq(self, block, startup, idx, name, is_param):
+        var = block.var(name)
+        qdq = block.create_var(name=_quantized_var_name(name),
+                               shape=var.shape, dtype=var.dtype)
+        bits = self.weight_bits if is_param else self.activation_bits
+        scale = block.create_var(
+            name=_scale_name(name), dtype="float32",
+            shape=(var.shape[0],) if (is_param and len(var.shape) == 4)
+            else (1,),
+            persistable=True, stop_gradient=True)
+        if is_param and len(var.shape) == 4:
+            # conv filters: per-output-channel scales (reference
+            # channel-wise path for OIHW weights)
+            block.insert_op(
+                idx, "fake_channel_wise_quantize_abs_max",
+                {"X": [name]}, {"Out": [qdq.name], "OutScale": [scale.name]},
+                {"bit_length": bits})
+            return qdq.name
+        if is_param or self.activation_quantize_type == "abs_max":
+            block.insert_op(
+                idx, "fake_quantize_abs_max",
+                {"X": [name]}, {"Out": [qdq.name], "OutScale": [scale.name]},
+                {"bit_length": bits})
+            return qdq.name
+        # running-range activation scale: persistable accum/state seeded
+        # by the startup program
+        accum = block.create_var(name=f"{name}.quant_accum", shape=(1,),
+                                 dtype="float32", persistable=True,
+                                 stop_gradient=True)
+        state = block.create_var(name=f"{name}.quant_state", shape=(1,),
+                                 dtype="float32", persistable=True,
+                                 stop_gradient=True)
+        sblock = startup.global_block
+        for v in (scale, accum, state):
+            sblock.create_var(name=v.name, shape=(1,), dtype="float32",
+                              persistable=True)
+            sblock.append_op("fill_constant", {}, {"Out": [v.name]},
+                             {"shape": [1], "dtype": "float32",
+                              "value": 0.0})
+        block.insert_op(
+            idx, "fake_quantize_moving_average_abs_max",
+            {"X": [name], "InScale": [scale.name], "InAccum": [accum.name],
+             "InState": [state.name]},
+            {"Out": [qdq.name], "OutScale": [scale.name],
+             "OutAccum": [accum.name], "OutState": [state.name]},
+            {"bit_length": bits, "moving_rate": self.moving_rate})
+        return qdq.name

@@ -92,3 +92,8 @@ define_flag("rpc_transport", "native",
             "role) or 'python' (stdlib sockets fallback)")
 define_flag("paddle_num_threads", 1,
             "accepted for parity; host threading is owned by XLA")
+define_flag("pserver_registry", "",
+            "host:port of the pserver discovery registry "
+            "(distributed/registry.py — the etcd analogue): pservers "
+            "register their logical endpoint with a TTL lease, trainers "
+            "re-resolve on connection failure; empty = static endpoints")

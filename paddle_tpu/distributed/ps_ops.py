@@ -390,12 +390,30 @@ class PServerLoop:
 
 @register_host_op("listen_and_serv")
 def _listen_and_serv(exe, program, op, scope):
+    from ..core import flags
+    from . import registry as registry_mod
+
     loop = PServerLoop(exe, program, op, scope)
-    server = transport.RPCServer(op.attr("endpoint"), loop)
+    # bind_endpoint lets a RESTARTED pserver come up on a fresh port while
+    # keeping its logical identity (the transpiler-time endpoint attr and
+    # the ps_index-keyed shard checkpoint) — the etcd re-claim path of
+    # go/pserver/etcd_client.go
+    bind_ep = op.attr("bind_endpoint", None) or op.attr("endpoint")
+    server = transport.RPCServer(bind_ep, loop)
     server.start()
+    hb = None
+    registry_ep = (op.attr("registry_endpoint", None)
+                   or flags.get_flags("pserver_registry") or None)
+    if registry_ep:
+        host = bind_ep.rsplit(":", 1)[0]
+        hb = registry_mod.Heartbeat(registry_ep, op.attr("endpoint"),
+                                    f"{host}:{server.port}")
+        hb.start()
     try:
         loop.wait_exit()
     finally:
+        if hb is not None:
+            hb.stop()
         server.stop()
 
 

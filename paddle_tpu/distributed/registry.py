@@ -1,0 +1,123 @@
+"""Service discovery / elastic re-binding for pserver mode.
+
+Reference: the etcd-backed discovery of the Go pserver world —
+``go/pserver/etcd_client.go:1`` (pservers register themselves under TTL
+leases and claim shard slots) and ``go/pserver/client/etcd_client.go:1``
+(trainers watch and re-resolve endpoints when the membership changes).
+
+TPU-native redesign: one small registry service riding the SAME framed-TCP
+transport as the variable RPC (no external etcd).  Keys are the LOGICAL
+pserver endpoints the transpiler baked into the program (stable identity ≙
+the etcd shard key); values are the CURRENT physical endpoint plus a TTL
+lease refreshed by a heartbeat thread.  A pserver that dies and restarts
+elsewhere re-registers the same logical key from its shard checkpoint;
+trainers re-resolve on connection failure and carry on — no trainer
+restart (the ``client.Client`` re-dial path of the reference).
+
+Enabled by ``FLAGS_pserver_registry=<host:port>`` on trainers and
+pservers; off (empty) keeps the static-endpoint behavior.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from . import transport
+
+# message types (continuing transport's numbering)
+REG_SET = 8
+REG_GET = 9
+
+DEFAULT_TTL = 10.0
+
+
+class RegistryService:
+    """handle() contract of transport.RPCServer services."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._map: Dict[str, Tuple[str, float]] = {}  # logical -> (phys, expiry)
+
+    def handle(self, msg_type, trainer_id, name, payload):
+        if msg_type == REG_SET:
+            body = json.loads(payload.decode("utf-8"))
+            with self._lock:
+                self._map[name] = (body["endpoint"],
+                                   time.monotonic() + float(body["ttl"]))
+            return transport.OK, b""
+        if msg_type == REG_GET:
+            with self._lock:
+                ent = self._map.get(name)
+                if ent is not None and ent[1] < time.monotonic():
+                    del self._map[name]     # lease expired (lazy reap)
+                    ent = None
+            if ent is None:
+                return transport.ERR, f"no live pserver for {name!r}".encode()
+            return transport.OK, ent[0].encode("utf-8")
+        return transport.ERR, f"registry: unknown msg {msg_type}".encode()
+
+
+class RegistryServer:
+    def __init__(self, endpoint: str):
+        self.service = RegistryService()
+        self._server = transport.RPCServer(endpoint, self.service)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self):
+        self._server.start()
+
+    def stop(self):
+        self._server.stop()
+
+
+def register(client: "transport.RPCClient", registry_ep: str, logical: str,
+             physical: str, ttl: float = DEFAULT_TTL) -> None:
+    payload = json.dumps({"endpoint": physical, "ttl": ttl}).encode("utf-8")
+    client._raw_request(registry_ep, REG_SET, logical, payload,
+                        retry_all=True)
+
+
+def resolve(client: "transport.RPCClient", registry_ep: str,
+            logical: str) -> Optional[str]:
+    try:
+        out = client._raw_request(registry_ep, REG_GET, logical, b"",
+                                  retry_all=True)
+        return out.decode("utf-8")
+    except RuntimeError:
+        return None          # not registered / lease expired
+
+
+class Heartbeat:
+    """Daemon lease-refresher (etcd_client.go keepalive analogue)."""
+
+    def __init__(self, registry_ep: str, logical: str, physical: str,
+                 ttl: float = DEFAULT_TTL, trainer_id: int = 0):
+        self.registry_ep = registry_ep
+        self.logical = logical
+        self.physical = physical
+        self.ttl = ttl
+        self._client = transport.RPCClient(trainer_id)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"registry-hb-{logical}")
+
+    def start(self):
+        register(self._client, self.registry_ep, self.logical,
+                 self.physical, self.ttl)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.ttl / 3.0):
+            try:
+                register(self._client, self.registry_ep, self.logical,
+                         self.physical, self.ttl)
+            except Exception:
+                pass             # registry briefly down: keep trying
+
+    def stop(self):
+        self._stop.set()

@@ -376,6 +376,57 @@ class RPCClient:
         self._conns_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="rpc-client")
+        # elastic re-binding (distributed/registry.py): when a registry is
+        # configured, op endpoints are LOGICAL keys resolved to the current
+        # physical endpoint; re-resolved on connection failure
+        from ..core import flags
+        try:
+            self._registry = flags.get_flags("pserver_registry") or None
+        except KeyError:  # pragma: no cover
+            self._registry = None
+        self._resolved: Dict[str, str] = {}
+
+    def set_registry(self, endpoint: Optional[str]) -> None:
+        self._registry = endpoint or None
+        self._resolved.clear()
+
+    def _resolve(self, logical: str, refresh: bool = False,
+                 avoid: Optional[str] = None) -> str:
+        """logical -> physical endpoint via the registry (identity when no
+        registry).  ``refresh`` polls until a LIVE registration different
+        from ``avoid`` (a dead endpoint) appears, up to the rpc deadline —
+        covering the window between a pserver dying and its replacement
+        re-registering from the shard checkpoint."""
+        if self._registry is None or logical == self._registry:
+            return logical
+        if not refresh and logical in self._resolved:
+            return self._resolved[logical]
+        from . import registry as _registry_mod
+        deadline = time.monotonic() + _CONNECT_TIMEOUT
+        while True:
+            phys = _registry_mod.resolve(self, self._registry, logical)
+            if phys is not None:
+                # same address as the dead server: could be its stale lease
+                # (TTL not yet expired) OR a supervisor restart on the SAME
+                # port — distinguish by probing the socket; a live listener
+                # means the replacement is up
+                if phys != avoid or self._probe(phys):
+                    self._resolved[logical] = phys
+                    return phys
+            if time.monotonic() > deadline:
+                raise ConnectionError(
+                    f"no live pserver re-registered for {logical!r} "
+                    f"within the deadline (registry {self._registry})")
+            time.sleep(0.3)
+
+    @staticmethod
+    def _probe(endpoint: str, timeout: float = 1.0) -> bool:
+        host, port = endpoint.rsplit(":", 1)
+        try:
+            socket.create_connection((host, int(port)), timeout).close()
+            return True
+        except OSError:
+            return False
 
     def _conn(self, endpoint: str, timeout: float = _CONNECT_TIMEOUT) -> _Conn:
         with self._conns_lock:
@@ -404,8 +455,8 @@ class RPCClient:
     _RETRYABLE = frozenset((GET_VAR, PREFETCH, FETCH_BARRIER,
                             CHECKPOINT_NOTIFY))
 
-    def _request(self, endpoint: str, msg_type: int, name: str = "",
-                 payload: bytes = b""):
+    def _raw_request(self, endpoint: str, msg_type: int, name: str = "",
+                     payload: bytes = b"", retry_all: bool = False):
         body = None
         for attempt in (0, 1):
             # retry connects get a short deadline: the long one is only for
@@ -424,7 +475,8 @@ class RPCClient:
                 # stale cached connection (pserver restarted, or the port
                 # was reassigned): reconnect once for idempotent requests
                 self._drop_conn(endpoint, c)
-                if attempt or msg_type not in self._RETRYABLE:
+                if attempt or not (retry_all
+                                   or msg_type in self._RETRYABLE):
                     raise
         rtype, _, _, rpayload = _unpack_body(body)
         if rtype == ERR:
@@ -432,6 +484,24 @@ class RPCClient:
                 f"pserver {endpoint} error for {name!r}: "
                 f"{rpayload.decode('utf-8', 'replace')}")
         return rpayload
+
+    def _request(self, endpoint: str, msg_type: int, name: str = "",
+                 payload: bytes = b""):
+        phys = self._resolve(endpoint)
+        try:
+            return self._raw_request(phys, msg_type, name, payload)
+        except ConnectionError:
+            if self._registry is None or endpoint == self._registry:
+                raise
+            # the pserver behind this logical endpoint is gone: wait for a
+            # replacement registration and retry there.  At-most-once
+            # caveat: a SEND_VAR the dead server applied before crashing is
+            # re-sent to the restarted server — it restarts from its shard
+            # checkpoint, so the duplicate is one extra async grad, the
+            # same tolerance the reference's elastic mode accepts.
+            new_phys = self._resolve(endpoint, refresh=True, avoid=phys)
+            return self._raw_request(new_phys, msg_type, name, payload,
+                                     retry_all=True)
 
     # -- public API (grpc_client.h:180-206 signatures) ---------------------
     def send_var(self, endpoint: str, name: str, value) -> None:
@@ -458,6 +528,7 @@ class RPCClient:
         down, which can race the response/connection teardown — a dropped
         connection here means the server exited, i.e. success.  Never
         retried (a duplicate COMPLETE would double-count the trainer)."""
+        endpoint = self._resolve(endpoint)
         c = self._conn(endpoint)
         try:
             with c.lock:

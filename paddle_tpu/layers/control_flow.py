@@ -7,6 +7,7 @@ build ``lax.while_loop``/``scan``/``cond`` (see ops/control_flow_ops.py).
 """
 from __future__ import annotations
 
+import collections
 from typing import List, Optional
 
 from ..core.program import Variable, default_main_program
@@ -490,21 +491,48 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
     return sel_ids, sel_scores, parent
 
 
+BeamDecodeResult = collections.namedtuple(
+    "BeamDecodeResult", ["ids", "scores", "cand_len", "src_len"])
+
+
 def beam_search_decode(ids_array, parents_array, beam_size, end_id,
-                       name=None):
-    """Backtrack TensorArrays of per-step selections into sequences
-    [batch*beam, max_len] (beam_search_decode_op.cc)."""
+                       scores_array=None, name=None):
+    """Backtrack TensorArrays of per-step selections into the reference's
+    level-2 nested result (beam_search_decode_op.cc: source -> candidate
+    -> token LoD, framework/lod_tensor.h:58), padded encoding:
+
+    - ``ids``      [batch*beam, max_len] flat token values, with its
+      ``@LEN`` companion aliased to ``cand_len`` so sequence ops mask
+      each candidate at its real token length
+    - ``scores``   [batch*beam, max_len] per-token scores along the same
+      backtrack (None unless ``scores_array`` is given)
+    - ``cand_len`` [batch*beam] tokens per candidate (incl. the end_id)
+    - ``src_len``  [batch] candidates per source sentence
+    """
+    from .nn import _alias_len
+
     helper = LayerHelper("beam_search_decode", name=name)
     t_max, bw = ids_array.shape[0], ids_array.shape[1]
     sents = helper.create_variable_for_type_inference(
         "int64", shape=(bw, t_max))
-    helper.append_op(
-        "beam_search_decode",
-        {"Ids": [ids_array], "Parents": [parents_array],
-         "ArrayLen": [_array_len_var(ids_array)]},
-        {"SentenceIds": [sents]},
-        {"end_id": end_id, "beam_size": beam_size})
-    return sents
+    cand_len = helper.create_variable_for_type_inference(
+        "int64", shape=(bw,), stop_gradient=True)
+    src_len = helper.create_variable_for_type_inference(
+        "int64", shape=(bw // beam_size,), stop_gradient=True)
+    ins = {"Ids": [ids_array], "Parents": [parents_array],
+           "ArrayLen": [_array_len_var(ids_array)]}
+    outs = {"SentenceIds": [sents], "SentenceLen": [cand_len],
+            "SourceLen": [src_len]}
+    scores = None
+    if scores_array is not None:
+        ins["Scores"] = [scores_array]
+        scores = helper.create_variable_for_type_inference(
+            scores_array.dtype, shape=(bw, t_max))
+        outs["SentenceScores"] = [scores]
+    helper.append_op("beam_search_decode", ins, outs,
+                     {"end_id": end_id, "beam_size": beam_size})
+    _alias_len(sents, cand_len)
+    return BeamDecodeResult(sents, scores, cand_len, src_len)
 
 
 # ---------------------------------------------------------------------------

@@ -130,6 +130,7 @@ def build(src_vocab=10000, tgt_vocab=10000, emb_dim=256, hid=256,
     pre_scores = L.data("beam_seed", [1])                 # [bw,1] 0/-inf
     ids_arr = L.create_array("int64", [bw], max_len=max_len)
     par_arr = L.create_array("int64", [bw], max_len=max_len)
+    score_arr = L.create_array("float32", [bw], max_len=max_len)
     i = L.fill_constant([1], "int64", 0)
     n = L.fill_constant([1], "int64", max_len)
     cond = L.less_than(i, n)
@@ -149,13 +150,14 @@ def build(src_vocab=10000, tgt_vocab=10000, emb_dim=256, hid=256,
         L.assign(L.gather(new_h, parent), h)
         L.array_write(L.squeeze(sel_ids, [1]), i, ids_arr)
         L.array_write(parent, i, par_arr)
+        L.array_write(L.squeeze(sel_scores, [1]), i, score_arr)
         L.assign(sel_ids, pre_ids)
         L.assign(sel_scores, pre_scores)
         L.increment(i, 1)
         L.less_than(i, n, cond=cond)
-    sents = L.beam_search_decode(ids_arr, par_arr, beam_size=beam_size,
-                                 end_id=end_id)
-    return (["src_ids", "src_mask", "cand_ids", "beam_seed"], sents,
+    decode = L.beam_search_decode(ids_arr, par_arr, beam_size=beam_size,
+                                  end_id=end_id, scores_array=score_arr)
+    return (["src_ids", "src_mask", "cand_ids", "beam_seed"], decode,
             pre_scores)
 
 

@@ -91,34 +91,66 @@ def _beam_search(ctx, ins, attrs):
     }
 
 
-@register("beam_search_decode", no_grad_slots=("Ids", "Parents", "ArrayLen"))
+@register("beam_search_decode",
+          no_grad_slots=("Ids", "Parents", "Scores", "ArrayLen"))
 def _beam_search_decode(ctx, ins, attrs):
-    """Backtrack stacked per-step selections into full sequences
-    (beam_search_decode_op.cc).
+    """Backtrack stacked per-step selections into the reference's LEVEL-2
+    nested result (beam_search_decode_op.cc emits a 2-level LoD:
+    source -> candidates -> tokens; framework/lod_tensor.h:58).
 
-    Ids, Parents: [T_max, BW] (TensorArray data); ArrayLen: written steps.
+    Ids, Parents: [T_max, BW] (TensorArray data); optional Scores
+    [T_max, BW] (per-step selected scores); ArrayLen: written steps.
     Walks parent pointers from the last written step back to step 0;
     steps beyond ArrayLen are padded with end_id.
-    Outputs: SentenceIds [BW, T_max] int64, SentenceScores passthrough of
-    the final beam order (identity — scores already live per final beam).
+
+    Padded level-2 encoding (values + two length vectors):
+    - SentenceIds    [BW, T_max] int64 — flat token values
+    - SentenceScores [BW, T_max]       — scores along the same backtrack
+    - SentenceLen    [BW]  int64 — tokens per candidate (up to and
+      including the first end_id; T_max when the beam never finished)
+    - SourceLen      [B]   int64 — candidates per source sentence
+      (beam_size under the padded contract: unlike the reference's
+      pruned candidate lists, every beam slot is materialized and
+      SentenceLen tells which suffix is padding)
     """
     ids = ins["Ids"][0]          # [T, BW]
     parents = ins["Parents"][0]  # [T, BW]
     t_max, bw = ids.shape
     end_id = int(attrs["end_id"])
+    beam_size = int(attrs.get("beam_size", 1))
     length = ins["ArrayLen"][0].reshape(()).astype(jnp.int32) \
         if ins.get("ArrayLen") else jnp.asarray(t_max, jnp.int32)
+    scores = ins["Scores"][0] if ins.get("Scores") else None
 
     def step(cur, tp):
-        t, ids_t, par_t = tp
+        t, ids_t, par_t, sc_t = tp
         active = t < length
         tok = jnp.where(active, ids_t[cur], jnp.asarray(end_id, ids.dtype))
+        sc = jnp.where(active, sc_t[cur], 0.0)
         nxt = jnp.where(active, par_t[cur], cur)
-        return nxt, tok
+        return nxt, (tok, sc)
 
+    sc_arr = (scores if scores is not None
+              else jnp.zeros((t_max, bw), jnp.float32))
     ts = jnp.arange(t_max - 1, -1, -1)
-    _, toks = lax.scan(step, jnp.arange(bw), (ts, ids[::-1], parents[::-1]))
-    return {"SentenceIds": [toks[::-1].T.astype(jnp.int64)]}
+    _, (toks, scs) = lax.scan(
+        step, jnp.arange(bw), (ts, ids[::-1], parents[::-1], sc_arr[::-1]))
+    sent_ids = toks[::-1].T.astype(jnp.int64)        # [BW, T]
+    sent_scores = scs[::-1].T
+
+    is_end = sent_ids == end_id
+    has_end = jnp.any(is_end, axis=1)
+    first_end = jnp.argmax(is_end, axis=1)
+    cand_len = jnp.where(has_end, first_end + 1, t_max).astype(jnp.int64)
+    # steps beyond ArrayLen were end_id-padded; cap at the written length
+    cand_len = jnp.minimum(cand_len, length.astype(jnp.int64))
+    src_len = jnp.full((bw // beam_size,), beam_size, jnp.int64)
+    out = {"SentenceIds": [sent_ids],
+           "SentenceLen": [cand_len],
+           "SourceLen": [src_len]}
+    if scores is not None:
+        out["SentenceScores"] = [sent_scores]
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -256,3 +256,49 @@ def test_flash_dropout_grad_is_directional_derivative():
         num = (float(f(*args_p)) - float(f(*args_m))) / (2 * eps)
         ana = float(jnp.vdot(g[i], d))
         np.testing.assert_allclose(num, ana, rtol=2e-2, atol=2e-2)
+
+
+def test_dropout_engages_in_lowered_hlo():
+    """A training program with attention dropout_rate > 0 must carry the
+    regenerable-dropout hash in its lowered computation (the murmur
+    finalizer constant 0x7FEB352D), and lose it at dropout=0 — verifying
+    dropout is live in the compiled step, not silently elided."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.lowering import analyze_block, build_block_fn
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.models import transformer
+
+    def hlo_for(dropout):
+        prog, startup = Program(), Program()
+        prog.random_seed = 3
+        with program_guard(prog, startup), unique_name.guard():
+            feeds, loss, _ = transformer.build(
+                src_vocab=50, tgt_vocab=50, max_len=8, d_model=16,
+                n_head=2, d_ffn=32, n_layer=1, dropout=dropout,
+                attention_impl="xla")
+        B, T = 2, 8
+        r = np.random.RandomState(0)
+        feed = {"src_ids": r.randint(0, 50, (B, T)).astype("int64"),
+                "tgt_ids": r.randint(0, 50, (B, T)).astype("int64"),
+                "lbl_ids": r.randint(0, 50, (B, T)).astype("int64"),
+                "src_mask": np.ones((B, T), "float32"),
+                "tgt_mask": np.ones((B, T), "float32")}
+        scope, exe = Scope(), Executor()
+        with scope_guard(scope):
+            exe.run(startup)
+            ordered = sorted(feed)
+            plan = analyze_block(prog, 0, ordered, [loss.name])
+            fn = build_block_fn(prog, plan)
+            args = ([jnp.asarray(feed[n]) for n in ordered],
+                    [jnp.asarray(np.asarray(scope.find_var(n)))
+                     for n in plan.donated_reads],
+                    [jnp.asarray(np.asarray(scope.find_var(n)))
+                     for n in plan.const_reads],
+                    jax.random.PRNGKey(0))
+            return jax.jit(fn).lower(*args).as_text()
+
+    hash_const = str(0x7FEB352D)
+    assert hash_const in hlo_for(0.1)
+    assert hash_const not in hlo_for(0.0)

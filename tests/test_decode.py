@@ -360,14 +360,27 @@ def test_beam_search_decode_backtracks():
             L.assign(ss, pre_scores)
             L.increment(i, 1)
             L.less_than(i, n, cond=cond)
-        sents = L.beam_search_decode(ids_arr, par_arr, beam_size=beam,
-                                     end_id=end_id)
+        decode = L.beam_search_decode(ids_arr, par_arr, beam_size=beam,
+                                      end_id=end_id)
     seed = np.array([[0.0]] + [[-1e9]] * (beam - 1), "float32")
     iota = np.tile(np.arange(V, dtype="int64"), (beam, 1))
-    sents_v, scores_v = _run(prog, startup,
-                             {"seed": seed, "cand_ids": iota},
-                             [sents, pre_scores])
+    sents_v, clen_v, slen_v, scores_v = _run(
+        prog, startup, {"seed": seed, "cand_ids": iota},
+        [decode.ids, decode.cand_len, decode.src_len, pre_scores])
     got_top_seq = tuple(int(t) for t in sents_v[0])
     got_top_score = float(scores_v[0, 0])
     assert got_top_seq == want_top[1], (got_top_seq, want_top)
     np.testing.assert_allclose(got_top_score, want_top[0], rtol=1e-5)
+    # level-2 nesting against the hand-computed backtracks: candidate
+    # token length = first end_id + 1 (or all steps), one source with
+    # `beam` candidates
+    want_lens = []
+    for b in range(beam):
+        seq, cur = [], b
+        for t in range(steps - 1, -1, -1):
+            seq.append(int(hist_ids[t][cur]))
+            cur = int(hist_par[t][cur])
+        seq = list(reversed(seq))
+        want_lens.append(seq.index(end_id) + 1 if end_id in seq else steps)
+    np.testing.assert_array_equal(clen_v, want_lens)
+    np.testing.assert_array_equal(slen_v, [beam])

@@ -45,7 +45,7 @@ def test_machine_translation_trains_and_beam_decodes(tmp_path):
 
     infer_prog, infer_startup = Program(), Program()
     with program_guard(infer_prog, infer_startup), unique_name.guard():
-        ifeeds, sents, scores = mt.build(src_vocab=V, tgt_vocab=V,
+        ifeeds, decode, scores = mt.build(src_vocab=V, tgt_vocab=V,
                                          emb_dim=32, hid=32, max_len=T,
                                          beam_size=3, mode="infer")
     iscope = Scope()
@@ -60,7 +60,7 @@ def test_machine_translation_trains_and_beam_decodes(tmp_path):
                       feed={"src_ids": feed["src_ids"][:1],
                             "src_mask": feed["src_mask"][:1],
                             "cand_ids": iota, "beam_seed": seed},
-                      fetch_list=[sents, scores], scope=iscope)
+                      fetch_list=[decode.ids, scores], scope=iscope)
     assert out.shape == (beam, T)
     assert (out >= 0).all() and (out < V).all()
     # beams are score-ordered

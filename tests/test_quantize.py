@@ -1,0 +1,97 @@
+"""QAT transpiler (reference contrib/quantize/quantize_transpiler.py):
+programs rewritten with fake-quant ops train, quantize what they should,
+and round-trip through save/load_inference_model with quant ops stamped."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.quantize import QuantizeTranspiler
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+rng = np.random.RandomState(7)
+
+
+def _conv_net():
+    img = fluid.layers.data("img", [1, 8, 8])
+    label = fluid.layers.data("label", [1], dtype="int64")
+    c = fluid.layers.conv2d(img, 4, 3, padding=1, act="relu")
+    p = fluid.layers.pool2d(c, 2, "max", pool_stride=2)
+    pred = fluid.layers.fc(p, 10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    return pred, loss
+
+
+@pytest.mark.parametrize("act_type", ["abs_max", "moving_average_abs_max"])
+def test_qat_trains_and_quantizes(act_type):
+    prog, startup = Program(), Program()
+    prog.random_seed = 1
+    with program_guard(prog, startup), unique_name.guard():
+        pred, loss = _conv_net()
+        t = QuantizeTranspiler(activation_quantize_type=act_type)
+        t.training_transpile(prog, startup)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    qops = [op.type for op in prog.global_block.ops
+            if op.type.startswith("fake_")]
+    # conv: filter (channel-wise) + activation; mul (fc): weight + input
+    assert "fake_channel_wise_quantize_abs_max" in qops
+    assert len(qops) >= 4, qops
+    # the conv now consumes qdq'ed inputs
+    conv = next(op for op in prog.global_block.ops if op.type == "conv2d")
+    assert all(n.endswith(".quantized.dequantized")
+               for n in conv.input("Filter"))
+    assert all(n.endswith(".quantized.dequantized")
+               for n in conv.input("Input"))
+
+    exe = Executor()
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        img = rng.randn(16, 1, 8, 8).astype("float32")
+        label = rng.randint(0, 10, (16, 1)).astype("int64")
+        losses = [float(exe.run(prog, feed={"img": img, "label": label},
+                                fetch_list=[loss])[0]) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+        if act_type == "moving_average_abs_max":
+            # running scale state advanced
+            sc = np.asarray(scope.find_var(
+                [n for n in prog.global_block.vars
+                 if n.endswith(".quant_state")][0]))
+            assert sc[0] > 0
+
+
+def test_qat_save_load_inference_roundtrip(tmp_path):
+    prog, startup = Program(), Program()
+    prog.random_seed = 2
+    with program_guard(prog, startup), unique_name.guard():
+        pred, loss = _conv_net()
+        t = QuantizeTranspiler()
+        t.training_transpile(prog, startup)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    exe = Executor()
+    scope = Scope()
+    img = rng.randn(4, 1, 8, 8).astype("float32")
+    with scope_guard(scope):
+        exe.run(startup)
+        label = rng.randint(0, 10, (4, 1)).astype("int64")
+        exe.run(prog, feed={"img": img, "label": label}, fetch_list=[loss])
+        infer = prog.clone().prune([pred.name])
+        t.freeze_program(infer)
+        path = str(tmp_path / "qat_model")
+        fluid.io.save_inference_model(path, ["img"], [pred], exe,
+                                      main_program=infer)
+        want = exe.run(infer, feed={"img": img}, fetch_list=[pred])[0]
+
+    scope2 = Scope()
+    with scope_guard(scope2):
+        prog2, feeds2, fetches2 = fluid.io.load_inference_model(path, exe)
+        qops = [op for op in prog2.global_block.ops
+                if op.type.startswith("fake_")]
+        assert qops and all(op.attrs.get("is_test") for op in qops)
+        got = exe.run(prog2, feed={feeds2[0]: img},
+                      fetch_list=fetches2)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)

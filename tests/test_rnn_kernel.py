@@ -81,9 +81,12 @@ def test_fused_lstm_grads_match_scan(masked):
                                    rtol=2e-4, atol=2e-4, err_msg=name)
 
 
-def test_lstm_op_pallas_parity_in_program():
+@pytest.mark.parametrize("reverse", [False, True])
+def test_lstm_op_pallas_parity_in_program(reverse):
     """The lstm op with use_pallas_kernel=True (interpret) reproduces the
-    XLA lowering inside a full program, including the backward pass."""
+    XLA lowering inside a full program, including the backward pass —
+    both directions (is_reverse exercises the scan-domain flips and the
+    LastH/LastC cotangent folding in the explicit Pallas grad)."""
     import paddle_tpu as fluid
     from paddle_tpu.core import unique_name
     from paddle_tpu.core.executor import Executor, Scope, scope_guard
@@ -109,7 +112,7 @@ def test_lstm_op_pallas_parity_in_program():
                 "float32", shape=(B, H))
             lc = helper.create_variable_for_type_inference(
                 "float32", shape=(B, H))
-            attrs = {}
+            attrs = {"is_reverse": reverse}
             if use_pallas is not None:
                 attrs["use_pallas_kernel"] = use_pallas
             from paddle_tpu.layers.nn import seq_len_var
@@ -118,7 +121,9 @@ def test_lstm_op_pallas_parity_in_program():
                 {"Input": [d], "Weight": [w], "SeqLen": [seq_len_var(d)]},
                 {"Hidden": [hidden], "Cell": [cell],
                  "LastH": [lh], "LastC": [lc]}, attrs)
-            loss = fluid.layers.mean(hidden)
+            loss = fluid.layers.elementwise_add(
+                fluid.layers.mean(hidden),
+                fluid.layers.mean(lh))
             pairs = fluid.append_backward(loss)
             grad_w = dict((p.name, g) for p, g in pairs)[w.name]
         scope, exe = Scope(), Executor()

@@ -56,7 +56,7 @@ def test_seq2seq_copy_task_converges_and_decodes(tmp_path):
     beam = 4
     infer_prog, infer_startup = Program(), Program()
     with program_guard(infer_prog, infer_startup), unique_name.guard():
-        ifeeds, sents, scores = mt.build(
+        ifeeds, decode, scores = mt.build(
             src_vocab=V, tgt_vocab=V, emb_dim=EMB, hid=HID,
             max_len=T, beam_size=beam, mode="infer",
             with_optimizer=False)
@@ -70,14 +70,19 @@ def test_seq2seq_copy_task_converges_and_decodes(tmp_path):
         matches = 0
         nb = 4
         for i in range(nb):
-            out, sc = exe.run(
+            out, clen, slen, sc = exe.run(
                 infer_prog,
                 feed={"src_ids": batch["src_ids"][i:i + 1],
                       "src_mask": batch["src_mask"][i:i + 1],
                       "cand_ids": iota, "beam_seed": seed},
-                fetch_list=[sents, scores], scope=iscope)
+                fetch_list=[decode.ids, decode.cand_len, decode.src_len,
+                            scores], scope=iscope)
             hyp = np.asarray(out)[0]          # top beam
             ref = batch["src_ids"][i]
             body_len = T - 1
             matches += int(np.array_equal(hyp[:body_len], ref[:body_len]))
+            # level-2 nesting: one source, beam candidates, per-candidate
+            # token lengths within [1, T]
+            assert np.asarray(slen).tolist() == [beam]
+            assert ((1 <= np.asarray(clen)) & (np.asarray(clen) <= T)).all()
         assert matches >= nb - 1, matches

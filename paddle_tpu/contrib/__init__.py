@@ -4,7 +4,9 @@ from .trainer import (BeginEpochEvent, BeginStepEvent, CheckpointConfig,
 from .inferencer import Inferencer
 from .mixed_precision import Float16Transpiler, transpile_to_bf16
 from .quantize import QuantizeTranspiler
+from .introspection import memory_usage, op_freq_statistic
 
 __all__ = ["Trainer", "Inferencer", "BeginEpochEvent", "EndEpochEvent",
            "BeginStepEvent", "EndStepEvent", "CheckpointConfig",
-           "Float16Transpiler", "transpile_to_bf16", "QuantizeTranspiler"]
+           "Float16Transpiler", "transpile_to_bf16", "QuantizeTranspiler",
+           "memory_usage", "op_freq_statistic"]

@@ -1,0 +1,61 @@
+"""Program introspection utilities.
+
+Reference: ``python/paddle/fluid/contrib/memory_usage_calc.py:46``
+(memory_usage) and ``contrib/op_frequence.py:23`` (op_freq_statistic).
+TPU note: actual device memory is owned by XLA buffer assignment, so
+``memory_usage`` is the same static var-shape estimate the reference
+gives — a sizing heuristic, not an allocator report.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from ..core.program import Program
+from ..core.types import np_dtype
+
+_UNITS = ["B", "KB", "MB", "GB", "TB"]
+
+
+def memory_usage(program: Program, batch_size: int):
+    """Estimate (lower, upper, unit) memory usage of one replica of
+    ``program`` at ``batch_size`` (reference memory_usage_calc.py:46:
+    sums var sizes with -1 leading dims taken as the batch; the bounds
+    bracket XLA's buffer reuse between 70% and 150% of the var total,
+    the same fudge band the reference applies)."""
+    if not isinstance(program, Program):
+        raise ValueError("memory_usage expects a Program")
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+    total = 0.0
+    for block in program.blocks:
+        for var in block.vars.values():
+            shape = [batch_size if d == -1 else d for d in (var.shape or ())]
+            total += float(np.prod(shape)) * np.dtype(
+                np_dtype(var.dtype)).itemsize if shape else 0.0
+    lo, hi = total * 0.7, total * 1.5
+    unit = 0
+    while hi >= 1024.0 and unit < len(_UNITS) - 1:
+        lo /= 1024.0
+        hi /= 1024.0
+        unit += 1
+    return lo, hi, _UNITS[unit]
+
+
+def op_freq_statistic(program: Program):
+    """(single-op freq, adjacent-op-pair freq) ordered by count desc
+    (reference op_frequence.py:23)."""
+    if not isinstance(program, Program):
+        raise ValueError("op_freq_statistic expects a Program")
+    uni, adj = {}, {}
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = f"{prev}->{op.type}"
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    order = lambda d: OrderedDict(sorted(d.items(), key=lambda kv: -kv[1]))
+    return order(uni), order(adj)

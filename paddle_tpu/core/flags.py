@@ -92,6 +92,10 @@ define_flag("rpc_transport", "native",
             "role) or 'python' (stdlib sockets fallback)")
 define_flag("paddle_num_threads", 1,
             "accepted for parity; host threading is owned by XLA")
+define_flag("rpc_server_profile_period", 0,
+            "pserver self-profiling: log request-rate stats every N "
+            "handled RPCs (reference FLAGS_rpc_server_profile_period, "
+            "python/paddle/fluid/__init__.py:121); 0 disables")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

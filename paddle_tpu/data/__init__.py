@@ -2,6 +2,7 @@
 async DataLoader (reference: python/paddle/reader/, python/paddle/dataset/,
 paddle/fluid/recordio/, operators/reader/)."""
 from . import datasets  # noqa: F401
+from . import image  # noqa: F401
 from .decorator import (  # noqa: F401
     batch,
     buffered,

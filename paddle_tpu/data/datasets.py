@@ -458,3 +458,95 @@ class sentiment:
     @staticmethod
     def test():
         return sentiment._synth_reader(512, 21)
+
+
+# ---------------------------------------------------------------------------
+# mq2007 (dataset/mq2007.py: LETOR 4.0 learning-to-rank; 46-dim features,
+# relevance grades 0-2, grouped by query; pointwise / pairwise / listwise
+# reader formats)
+# ---------------------------------------------------------------------------
+
+class mq2007:
+    FEATURE_DIM = 46
+
+    @staticmethod
+    def _synth_queries(n_queries, seed):
+        rng = np.random.RandomState(seed)
+        for qid in range(n_queries):
+            n_docs = rng.randint(5, 20)
+            rel = rng.randint(0, 3, n_docs).astype("int64")
+            # learnable: relevance raises a feature-block mean
+            feats = rng.rand(n_docs, mq2007.FEATURE_DIM).astype(
+                "float32") * 0.1
+            feats += rel[:, None] * 0.3
+            yield qid, rel, feats
+
+    @staticmethod
+    def _reader(format, n_queries, seed):
+        def reader():
+            _warn_synth("mq2007")
+            for qid, rel, feats in mq2007._synth_queries(n_queries, seed):
+                if format == "pointwise":
+                    for r, f in zip(rel, feats):
+                        yield float(r), f
+                elif format == "pairwise":
+                    n = len(rel)
+                    for i in range(n):
+                        for j in range(i + 1, n):
+                            if rel[i] == rel[j]:
+                                continue
+                            if rel[i] > rel[j]:
+                                yield np.array(1.0), feats[i], feats[j]
+                            else:
+                                yield np.array(1.0), feats[j], feats[i]
+                elif format == "listwise":
+                    yield rel.astype("float32"), feats
+                else:
+                    raise ValueError(f"unknown mq2007 format {format!r}")
+        return reader
+
+    @staticmethod
+    def train(format="pairwise"):
+        return mq2007._reader(format, 64, 22)
+
+    @staticmethod
+    def test(format="pairwise"):
+        return mq2007._reader(format, 16, 23)
+
+
+# ---------------------------------------------------------------------------
+# voc2012 (dataset/voc2012.py: segmentation — HWC uint8 image + HW uint8
+# class mask, classes 0-20, 255 = void border)
+# ---------------------------------------------------------------------------
+
+class voc2012:
+    CLASSES = 21
+
+    @staticmethod
+    def _synth_reader(n, seed):
+        def reader():
+            _warn_synth("voc2012")
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                h, w = 128, 128
+                img = rng.randint(0, 256, (h, w, 3)).astype("uint8")
+                label = np.zeros((h, w), "uint8")
+                cls = rng.randint(1, voc2012.CLASSES)
+                y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+                label[y0:y0 + h // 3, x0:x0 + w // 3] = cls
+                # learnable: the object region is brighter in channel cls%3
+                img[y0:y0 + h // 3, x0:x0 + w // 3, cls % 3] |= 128
+                yield img, label
+        return reader
+
+    @staticmethod
+    def train():
+        return voc2012._synth_reader(512, 24)
+
+    @staticmethod
+    def val():
+        return voc2012._synth_reader(128, 25)
+
+    @staticmethod
+    def test():
+        return voc2012._synth_reader(128, 26)

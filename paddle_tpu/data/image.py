@@ -1,0 +1,140 @@
+"""Image augmentation utilities (reference python/paddle/dataset/image.py).
+
+The reference shells out to cv2; this environment has no cv2/PIL, so the
+array-space transforms (the pieces training pipelines actually run per
+sample: resize_short, crops, flip, to_chw, simple_transform) are
+implemented in pure numpy — bilinear resize included — and the file
+decoders degrade gracefully: they use cv2/PIL when importable and raise
+an actionable error otherwise.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["load_image", "load_image_bytes", "resize_short", "to_chw",
+           "center_crop", "random_crop", "left_right_flip",
+           "simple_transform", "load_and_transform"]
+
+
+def _decoder():
+    try:
+        import cv2
+        return ("cv2", cv2)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        return ("pil", Image)
+    except ImportError:
+        return (None, None)
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    """Decode encoded image bytes to an HWC (or HW) uint8 array."""
+    kind, mod = _decoder()
+    if kind == "cv2":
+        flag = mod.IMREAD_COLOR if is_color else mod.IMREAD_GRAYSCALE
+        img = mod.imdecode(np.frombuffer(data, np.uint8), flag)
+        return img
+    if kind == "pil":
+        import io
+        img = mod.open(io.BytesIO(data))
+        img = img.convert("RGB" if is_color else "L")
+        return np.asarray(img)
+    raise RuntimeError(
+        "decoding image bytes needs cv2 or PIL; neither is installed "
+        "(the numpy transforms below work on already-decoded arrays)")
+
+
+def load_image(path: str, is_color: bool = True) -> np.ndarray:
+    with open(path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _bilinear_resize(im: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Pure-numpy bilinear resize, HWC or HW."""
+    h, w = im.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return im
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
+    if im.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    a = im[y0][:, x0].astype(np.float32)
+    b = im[y0][:, x1].astype(np.float32)
+    c = im[y1][:, x0].astype(np.float32)
+    d = im[y1][:, x1].astype(np.float32)
+    out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+           + c * wy * (1 - wx) + d * wy * wx)
+    return out.astype(im.dtype) if np.issubdtype(im.dtype, np.integer) \
+        else out
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Scale so the SHORTER edge equals ``size`` (image.py:180)."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _bilinear_resize(im, size, int(round(w * size / h)))
+    return _bilinear_resize(im, int(round(h * size / w)), size)
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True):
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True,
+                rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, h - size + 1)
+    w0 = rng.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im: np.ndarray, is_color: bool = True) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True,
+                     mean=None, rng=None) -> np.ndarray:
+    """The reference's standard pipeline (image.py:310): resize-short →
+    crop (random+flip for train, center for eval) → CHW float32 →
+    optional mean subtraction (scalar, per-channel, or full image)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        rng2 = rng or np.random
+        if rng2.randint(0, 2) == 1:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.asarray(mean, dtype=np.float32)
+        if mean.ndim == 1:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color: bool = True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import defaultdict, deque
 from typing import Dict, List
 
@@ -206,12 +207,41 @@ class PServerLoop:
 
         # periodic self-checkpoint + recovery (go/pserver/service.go:346
         # checkpoint / :175 LoadCheckpoint)
+        from ..core import flags as _flags
+        try:
+            self._profile_period = int(
+                _flags.get_flags("rpc_server_profile_period") or 0)
+        except KeyError:  # pragma: no cover
+            self._profile_period = 0
+        self._profile_lock = threading.Lock()
+        self._req_count = 0
+        self._profile_t0 = time.monotonic()
+
         self.ckpt_dir = op.attr("checkpoint_dir") or None
         self.ckpt_every = int(op.attr("checkpoint_every_rounds", 0) or 0)
         if self.ckpt_dir and os.path.exists(self._ckpt_path()):
             with np.load(self._ckpt_path()) as data:
                 for n in data.files:
                     self.scope.set_var(n, data[n])
+
+    # -- self-profiling (reference FLAGS_rpc_server_profile_period,
+    # python/paddle/fluid/__init__.py:121 + rpc_server.cc profiling):
+    # every N handled requests, log one line of request-rate stats
+    def _profile_tick(self):
+        period = self._profile_period
+        if not period:
+            return
+        with self._profile_lock:
+            self._req_count += 1
+            if self._req_count % period:
+                return
+            now = time.monotonic()
+            dt = max(now - self._profile_t0, 1e-9)
+            rate = period / dt
+            self._profile_t0 = now
+        print(f"[pserver {self.op.attr('endpoint')}] handled "
+              f"{self._req_count} requests ({rate:.0f} req/s over the "
+              f"last {period})", flush=True)
 
     def _ckpt_path(self) -> str:
         # keyed by shard index, not endpoint: a restarted pserver may come
@@ -290,6 +320,7 @@ class PServerLoop:
 
     # -- service entry (one call per request, many threads) ----------------
     def handle(self, msg_type, trainer_id, name, payload):
+        self._profile_tick()
         if msg_type == SEND_VAR:
             value = serde.loads_value(payload)
             if self.sync_mode:

@@ -494,12 +494,18 @@ class RPCClient:
             if self._registry is None or endpoint == self._registry:
                 raise
             # the pserver behind this logical endpoint is gone: wait for a
-            # replacement registration and retry there.  At-most-once
-            # caveat: a SEND_VAR the dead server applied before crashing is
-            # re-sent to the restarted server — it restarts from its shard
-            # checkpoint, so the duplicate is one extra async grad, the
-            # same tolerance the reference's elastic mode accepts.
+            # replacement registration and retry there.
             new_phys = self._resolve(endpoint, refresh=True, avoid=phys)
+            if new_phys == phys and msg_type not in self._RETRYABLE:
+                # same address answering the probe: could be the SAME live
+                # server after a transient drop — re-sending a SEND_VAR or
+                # BATCH_BARRIER there could double-apply (sync rounds
+                # would close early).  Keep at-most-once and surface the
+                # error; only a DIFFERENT replacement address proves a new
+                # server instance, where a duplicate of the lost-response
+                # request lands on checkpoint-restored state (one extra
+                # async grad — the reference's elastic-mode tolerance).
+                raise
             return self._raw_request(new_phys, msg_type, name, payload,
                                      retry_all=True)
 

@@ -314,9 +314,11 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
 def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.5, evaluate_difficult=True,
                   ap_version="integral", name=None):
-    """Mean average precision metric (detection_map_op.cc) — host op:
-    per-class AP over NMS outputs [B, K, 6] vs padded gt
-    [B, Mg, 6] = (label, x1, y1, x2, y2, difficult)."""
+    """Mean average precision metric (detection_map_op.cc) — IN-GRAPH
+    device op: per-class AP over NMS outputs [B, K, 6] vs padded gt
+    [B, Mg, 6] = (label, x1, y1, x2, y2, difficult).  For accumulative
+    mAP across batches, append the op directly with PosCount/TruePos/
+    FalsePos state slots (ops/detection_ops.py docstring)."""
     from .nn import seq_len_var
 
     helper = LayerHelper("detection_map", name=name)

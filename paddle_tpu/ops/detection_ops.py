@@ -482,85 +482,119 @@ def _ssd_loss(ctx, ins, attrs):
     return {"Loss": [loss]}
 
 
-from ..core.host_ops import register_host_op
+@register("detection_map",
+          no_grad_slots=("DetectRes", "Label", "GtLen", "PosCount",
+                         "TruePos", "FalsePos"))
+def _detection_map(ctx, ins, attrs):
+    """detection_map_op.cc as an IN-GRAPH device op (padded redesign).
 
+    DetectRes [B, K, 6] = (label, score, x1, y1, x2, y2), label -1 = pad;
+    Label [B, Mg, 6] = (label, x1, y1, x2, y2, difficult) with GtLen [B].
 
-@register_host_op("detection_map")
-def _detection_map(exe, program, op, scope):
-    """detection_map_op.cc (host): mean AP of NMS outputs vs ground truth.
-    DetectRes [B, K, 6] = (label, score, x1, y1, x2, y2), -1 label = pad;
-    Label [B, Mg, 6] = (label, x1, y1, x2, y2, difficult) with GtLen."""
-    det = np.asarray(scope.find_var(op.input("DetectRes")[0]))
-    gt = np.asarray(scope.find_var(op.input("Label")[0]))
-    gt_len = None
-    if op.input("GtLen"):
-        gt_len = np.asarray(scope.find_var(op.input("GtLen")[0]))
-    class_num = op.attr("class_num")
-    bg = op.attr("background_label", 0)
-    thr = op.attr("overlap_threshold", 0.5)
-    eval_diff = op.attr("evaluate_difficult", True)
-    version = op.attr("ap_version", "integral")
-    B = det.shape[0]
+    Matching is the reference's greedy rule vectorized on device: per
+    image, detections in descending-score order claim their best-IoU
+    unmatched ground truth of the same class (IoU >= overlap_threshold).
 
-    def iou(a, b):
-        ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
-        ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
-        iw = max(0.0, ix2 - ix1); ih = max(0.0, iy2 - iy1)
-        inter = iw * ih
-        ua = ((a[2] - a[0]) * (a[3] - a[1])
-              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
-        return inter / ua if ua > 0 else 0.0
+    Accumulative state redesign: the reference keeps dynamic per-class
+    score lists (LoD state); static shapes use score-BUCKETED histograms
+    instead — TruePos/FalsePos [C, BINS] counts per score bin (BINS=1000
+    over [0,1]) + PosCount [C].  AP from the bin-cumulative curves is the
+    same integral/11point formula with <=1/BINS recall-ordering error.
+    """
+    det = ins["DetectRes"][0]                 # [B,K,6]
+    gt = ins["Label"][0]                      # [B,Mg,6]
+    B, K, _ = det.shape
+    Mg = gt.shape[1]
+    C = int(attrs["class_num"])
+    bg = int(attrs.get("background_label", 0))
+    thr = float(attrs.get("overlap_threshold", 0.5))
+    eval_diff = bool(attrs.get("evaluate_difficult", True))
+    version = attrs.get("ap_version", "integral")
+    BINS = 1000
+    gt_len = (ins["GtLen"][0] if ins.get("GtLen")
+              else jnp.full((B,), Mg, jnp.int32))
 
-    aps = []
-    for c in range(class_num):
-        if c == bg:
-            continue
-        # gather per-image gt and detections of class c
-        scores, tps, n_gt = [], [], 0
-        for b in range(B):
-            m = int(gt_len[b]) if gt_len is not None else gt.shape[1]
-            gts = [g for g in gt[b, :m] if int(g[0]) == c]
-            if not eval_diff:
-                n_gt += sum(1 for g in gts if not g[5])
-            else:
-                n_gt += len(gts)
-            used = [False] * len(gts)
-            dets = [d for d in det[b] if int(d[0]) == c]
-            dets.sort(key=lambda d: -d[1])
-            for d in dets:
-                best, best_iou = -1, thr
-                for gi, g in enumerate(gts):
-                    v = iou(d[2:6], g[1:5])
-                    if v >= best_iou and not used[gi]:
-                        best, best_iou = gi, v
-                scores.append(float(d[1]))
-                if best >= 0:
-                    used[best] = True
-                    tps.append(1)
-                else:
-                    tps.append(0)
-        if n_gt == 0:
-            continue
-        order = np.argsort(-np.asarray(scores)) if scores else []
-        tp_sorted = np.asarray(tps, float)[order] if scores else np.array([])
-        tp_cum = np.cumsum(tp_sorted)
-        fp_cum = np.cumsum(1.0 - tp_sorted)
-        rec = tp_cum / n_gt
-        prec = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
-        if version == "11point":
-            ap = 0.0
-            for t in np.arange(0.0, 1.01, 0.1):
-                p = prec[rec >= t].max() if (rec >= t).any() else 0.0
-                ap += p / 11.0
-        else:  # integral
-            ap = 0.0
-            prev_r = 0.0
-            for r, p in zip(rec, prec):
-                ap += p * (r - prev_r)
-                prev_r = r
-        aps.append(ap)
-    m = float(np.mean(aps)) if aps else 0.0
-    scope.set_var(op.output("MAP")[0], np.asarray([m], np.float32))
+    d_label = det[..., 0].astype(jnp.int32)           # [B,K]
+    d_score = jnp.clip(det[..., 1].astype(jnp.float32), 0.0, 1.0)
+    d_box = det[..., 2:6].astype(jnp.float32)
+    g_label = gt[..., 0].astype(jnp.int32)            # [B,Mg]
+    g_box = gt[..., 1:5].astype(jnp.float32)
+    g_diff = gt[..., 5] > 0
+    g_valid = (jnp.arange(Mg)[None, :] < gt_len[:, None].astype(jnp.int32))
+    g_counted = g_valid & (eval_diff | ~g_diff)       # enters PosCount
+    d_valid = d_label >= 0
+
+    # IoU [B,K,Mg]
+    lt = jnp.maximum(d_box[:, :, None, :2], g_box[:, None, :, :2])
+    rb = jnp.minimum(d_box[:, :, None, 2:], g_box[:, None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_d = ((d_box[..., 2] - d_box[..., 0])
+              * (d_box[..., 3] - d_box[..., 1]))[:, :, None]
+    area_g = ((g_box[..., 2] - g_box[..., 0])
+              * (g_box[..., 3] - g_box[..., 1]))[:, None, :]
+    union = jnp.maximum(area_d + area_g - inter, 1e-12)
+    iou = inter / union
+    can_match = (iou >= thr) & g_valid[:, None, :] \
+        & (d_label[:, :, None] == g_label[:, None, :])
+
+    order = jnp.argsort(-d_score, axis=1)             # [B,K] score-desc
+
+    def match_image(order_b, can_b, iou_b, dval_b):
+        def step(used, k):
+            cand = can_b[k] & ~used                  # [Mg]
+            best = jnp.argmax(jnp.where(cand, iou_b[k], -1.0))
+            hit = cand[best] & dval_b[k]
+            used = used.at[best].set(used[best] | hit)
+            return used, hit
+        _, hits = lax.scan(step, jnp.zeros((Mg,), bool), order_b)
+        # hits are in score order; return to detection order
+        return jnp.zeros((K,), bool).at[order_b].set(hits)
+
+    is_tp = jax.vmap(match_image)(order,
+                                  can_match, iou, d_valid)   # [B,K]
+
+    # bucket detections into [C, BINS] histograms
+    bins = jnp.minimum((d_score * BINS).astype(jnp.int32), BINS - 1)
+    flat_cls = jnp.clip(d_label.reshape(-1), 0, C - 1)
+    flat_idx = flat_cls * BINS + bins.reshape(-1)
+    w = d_valid.reshape(-1).astype(jnp.float32)
+    tp_new = jnp.zeros((C * BINS,), jnp.float32).at[flat_idx].add(
+        w * is_tp.reshape(-1)).reshape(C, BINS)
+    fp_new = jnp.zeros((C * BINS,), jnp.float32).at[flat_idx].add(
+        w * (~is_tp.reshape(-1).astype(bool)).astype(jnp.float32)
+    ).reshape(C, BINS)
+    pos_new = jnp.zeros((C,), jnp.float32).at[
+        jnp.clip(g_label.reshape(-1), 0, C - 1)].add(
+        g_counted.reshape(-1).astype(jnp.float32))
+
+    if ins.get("PosCount"):
+        pos_new = pos_new + ins["PosCount"][0]
+        tp_new = tp_new + ins["TruePos"][0]
+        fp_new = fp_new + ins["FalsePos"][0]
+
+    # AP per class from descending-score bin cumsums
+    tp_cum = jnp.cumsum(tp_new[:, ::-1], axis=1)       # [C,BINS] desc
+    fp_cum = jnp.cumsum(fp_new[:, ::-1], axis=1)
+    npos = jnp.maximum(pos_new, 1e-12)
+    rec = tp_cum / npos[:, None]
+    prec = tp_cum / jnp.maximum(tp_cum + fp_cum, 1e-12)
+    if version == "11point":
+        ts = jnp.arange(11, dtype=jnp.float32) / 10.0   # [11]
+        pmax = jnp.max(jnp.where(rec[:, None, :] >= ts[None, :, None],
+                                 prec[:, None, :], 0.0), axis=2)
+        ap = jnp.sum(pmax, axis=1) / 11.0
+    else:
+        prev_rec = jnp.concatenate(
+            [jnp.zeros((C, 1)), rec[:, :-1]], axis=1)
+        ap = jnp.sum(prec * (rec - prev_rec), axis=1)
+    cls_mask = (pos_new > 0) & (jnp.arange(C) != bg)
+    n_cls = jnp.maximum(jnp.sum(cls_mask.astype(jnp.float32)), 1.0)
+    m = jnp.sum(jnp.where(cls_mask, ap, 0.0)) / n_cls
+    return {"MAP": [m.reshape((1,))],
+            "AccumPosCount": [pos_new],
+            "AccumTruePos": [tp_new],
+            "AccumFalsePos": [fp_new]}
 
 
 @register("generate_proposals",

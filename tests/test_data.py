@@ -205,3 +205,41 @@ def test_new_dataset_readers_yield_consistent_shapes():
         for _ in range(3):
             sample = next(it)
             assert checks(sample), name
+
+
+def test_mq2007_formats_and_voc2012_shapes():
+    from paddle_tpu.data.datasets import mq2007, voc2012
+
+    rel, feats = next(mq2007.train(format="pointwise")())
+    assert feats.shape == (46,) and 0.0 <= rel <= 2.0
+    lbl, a, b = next(mq2007.train(format="pairwise")())
+    assert a.shape == (46,) and b.shape == (46,) and float(lbl) == 1.0
+    rels, mat = next(mq2007.train(format="listwise")())
+    assert mat.shape == (len(rels), 46)
+
+    img, label = next(voc2012.train()())
+    assert img.dtype == np.uint8 and img.ndim == 3 and img.shape[2] == 3
+    assert label.shape == img.shape[:2] and label.max() <= 21
+
+
+def test_image_transforms_numpy():
+    from paddle_tpu.data import image as I
+
+    rng2 = np.random.RandomState(0)
+    im = rng2.randint(0, 256, (80, 120, 3)).astype("uint8")
+    r = I.resize_short(im, 64)
+    assert min(r.shape[:2]) == 64 and r.shape[1] == 96
+    c = I.center_crop(r, 56)
+    assert c.shape[:2] == (56, 56)
+    rc = I.random_crop(r, 56, rng=rng2)
+    assert rc.shape[:2] == (56, 56)
+    f = I.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, 0], c[:, -1])
+    chw = I.to_chw(c)
+    assert chw.shape == (3, 56, 56)
+    out = I.simple_transform(im, 64, 56, is_train=True, rng=rng2,
+                             mean=[127.0, 127.0, 127.0])
+    assert out.shape == (3, 56, 56) and out.dtype == np.float32
+    # bilinear resize interpolates: a constant image stays constant
+    const = np.full((40, 60, 3), 7, "uint8")
+    np.testing.assert_array_equal(I.resize_short(const, 20), 7)

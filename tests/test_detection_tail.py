@@ -189,3 +189,173 @@ def test_detection_output_and_map():
         r, = exe.run(prog, feed={"det": det, "gt": gt, "gt@LEN": gt_len},
                      fetch_list=[m.name])
     np.testing.assert_allclose(float(np.asarray(r).reshape(())), 1.0)
+
+
+def test_detection_map_partial():
+    """Imperfect detections: AP checked against the hand-computed
+    integral formula."""
+    # class 1: det A TP (iou 1.0, score .9), det B FP (.7, no overlap);
+    # 2 ground truths -> recall after A = .5, after B = .5
+    det = np.full((1, 3, 6), -1.0, "float32")
+    det[0, 0] = [1, 0.9, 0, 0, 10, 10]
+    det[0, 1] = [1, 0.7, 50, 50, 60, 60]
+    gt = np.zeros((1, 2, 6), "float32")
+    gt[0, 0] = [1, 0, 0, 10, 10, 0]
+    gt[0, 1] = [1, 20, 20, 30, 30, 0]
+    gt_len = np.array([2], "int64")
+    # integral AP: first point r=.5 p=1 -> ap = .5*1 = 0.5
+    want = 0.5
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        gb = prog.global_block
+        d = gb.create_var(name="det", shape=det.shape, dtype="float32",
+                          stop_gradient=True)
+        g = gb.create_var(name="gt", shape=gt.shape, dtype="float32",
+                          stop_gradient=True)
+        gb.create_var(name="gt@LEN", shape=(1,), dtype="int64",
+                      stop_gradient=True)
+        gb.seq_len_map["gt"] = "gt@LEN"
+        m = fluid.layers.detection_map(d, g, class_num=3)
+    exe = Executor()
+    with scope_guard(Scope()):
+        (mv,) = exe.run(prog, feed={"det": det, "gt": gt,
+                                    "gt@LEN": gt_len}, fetch_list=[m])
+    np.testing.assert_allclose(float(np.asarray(mv)[0]), want, atol=2e-3)
+
+
+def test_attention_lstm_matches_numpy():
+    """attention_lstm op vs a step-by-step numpy simulation of the
+    reference kernel (attention_lstm_op.cc:340-401 math, padded)."""
+    B, T, M, D = 2, 4, 3, 5
+    x = rng.randn(B, T, M).astype("float32") * 0.5
+    lens = np.array([4, 2], "int64")
+    c0 = rng.randn(B, D).astype("float32") * 0.3
+    h0 = rng.randn(B, D).astype("float32") * 0.3
+    atten_w = rng.randn(M + D, 1).astype("float32") * 0.4
+    atten_b = rng.randn(1, 1).astype("float32")
+    lstm_w = rng.randn(D + M, 4 * D).astype("float32") * 0.3
+    lstm_b = rng.randn(1, 4 * D).astype("float32") * 0.1
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    # numpy reference
+    want_h = np.zeros((B, T, D), "float32")
+    for b in range(B):
+        h, c = h0[b], c0[b]
+        L = int(lens[b])
+        atted = x[b] @ atten_w[:M, 0] + atten_b[0, 0]      # [T]
+        for t in range(T):
+            if t >= L:
+                want_h[b, t] = h
+                continue
+            e = np.maximum(atted + c @ atten_w[M:, 0], 0.0)[:L]
+            a = np.exp(e - e.max()); a /= a.sum()
+            lx = a @ x[b, :L]                              # [M]
+            gates = lx @ lstm_w[D:] + h @ lstm_w[:D] + lstm_b[0]
+            f = sigmoid(gates[:D]); i = sigmoid(gates[D:2*D])
+            o = sigmoid(gates[2*D:3*D]); cand = np.tanh(gates[3*D:])
+            c = f * c + i * cand
+            h = np.tanh(c) * o
+            want_h[b, t] = h
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        gb = prog.global_block
+        for name, arr in [("x", x), ("c0", c0), ("h0", h0),
+                          ("aw", atten_w), ("ab", atten_b),
+                          ("lw", lstm_w), ("lb", lstm_b)]:
+            gb.create_var(name=name, shape=arr.shape, dtype="float32",
+                          stop_gradient=True)
+        gb.create_var(name="x@LEN", shape=(B,), dtype="int64",
+                      stop_gradient=True)
+        gb.seq_len_map["x"] = "x@LEN"
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("attention_lstm")
+        outs = {k: [helper.create_variable_for_type_inference(
+                    "float32", shape=(B, T, D))]
+                for k in ("Hidden", "Cell")}
+        for k, shp in [("AttentionedX", (B, T, 1)),
+                       ("AttentionFCOut", (B, T, 1)),
+                       ("LSTMX", (B, M)), ("LSTMOUT", (B, 4 * D))]:
+            outs[k] = [helper.create_variable_for_type_inference(
+                "float32", shape=shp)]
+        from paddle_tpu.layers.nn import seq_len_var
+        helper.append_op(
+            "attention_lstm",
+            {"X": [gb.var("x")], "C0": [gb.var("c0")], "H0": [gb.var("h0")],
+             "AttentionWeight": [gb.var("aw")],
+             "AttentionBias": [gb.var("ab")],
+             "LSTMWeight": [gb.var("lw")], "LSTMBias": [gb.var("lb")],
+             "SeqLen": [seq_len_var(gb.var("x"))]},
+            outs, {})
+        hidden = outs["Hidden"][0]
+    exe = Executor()
+    with scope_guard(Scope()):
+        (hv,) = exe.run(prog, feed={"x": x, "x@LEN": lens, "c0": c0,
+                                    "h0": h0, "aw": atten_w, "ab": atten_b,
+                                    "lw": lstm_w, "lb": lstm_b},
+                        fetch_list=[hidden])
+    np.testing.assert_allclose(np.asarray(hv), want_h, rtol=2e-5, atol=2e-5)
+
+
+def test_detection_map_accumulative_state_two_batches():
+    """The op's PosCount/TruePos/FalsePos state: feeding batch 2 with
+    batch 1's accumulated state must give the same mAP as both images in
+    one batch (reference detection_map_op.cc accumulative inputs)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    C, BINS = 3, 1000
+    det1 = np.full((1, 2, 6), -1.0, "float32")
+    det1[0, 0] = [1, 0.9, 0, 0, 10, 10]           # TP
+    det2 = np.full((1, 2, 6), -1.0, "float32")
+    det2[0, 0] = [1, 0.8, 50, 50, 60, 60]         # FP
+    gt1 = np.zeros((1, 1, 6), "float32")
+    gt1[0, 0] = [1, 0, 0, 10, 10, 0]
+    gt2 = np.zeros((1, 1, 6), "float32")
+    gt2[0, 0] = [1, 70, 70, 80, 80, 0]
+
+    def run(det, gt, state=None):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup), unique_name.guard():
+            gb = prog.global_block
+            d = gb.create_var(name="det", shape=det.shape, dtype="float32",
+                              stop_gradient=True)
+            g = gb.create_var(name="gt", shape=gt.shape, dtype="float32",
+                              stop_gradient=True)
+            helper = LayerHelper("detection_map")
+            m = helper.create_variable_for_type_inference(
+                "float32", shape=(1,), stop_gradient=True)
+            outs = {"MAP": [m]}
+            ins = {"DetectRes": [d], "Label": [g]}
+            for slot, shp in [("AccumPosCount", (C,)),
+                              ("AccumTruePos", (C, BINS)),
+                              ("AccumFalsePos", (C, BINS))]:
+                outs[slot] = [helper.create_variable_for_type_inference(
+                    "float32", shape=shp, stop_gradient=True)]
+            if state is not None:
+                for slot, name in [("PosCount", "pc"), ("TruePos", "tp"),
+                                   ("FalsePos", "fp")]:
+                    gb.create_var(name=name, shape=state[slot].shape,
+                                  dtype="float32", stop_gradient=True)
+                    ins[slot] = [gb.var(name)]
+            helper.append_op("detection_map", ins, outs, {"class_num": C})
+        exe = Executor()
+        feed = {"det": det, "gt": gt}
+        if state is not None:
+            feed.update({"pc": state["PosCount"], "tp": state["TruePos"],
+                         "fp": state["FalsePos"]})
+        with scope_guard(Scope()):
+            vals = exe.run(prog, feed=feed, fetch_list=[
+                m, outs["AccumPosCount"][0], outs["AccumTruePos"][0],
+                outs["AccumFalsePos"][0]])
+        return [np.asarray(v) for v in vals]
+
+    _, pc1, tp1, fp1 = run(det1, gt1)
+    m_acc, *_ = run(det2, gt2, {"PosCount": pc1, "TruePos": tp1,
+                                "FalsePos": fp1})
+    both_det = np.concatenate([det1, det2], 0)
+    both_gt = np.concatenate([gt1, gt2], 0)
+    m_joint, *_ = run(both_det, both_gt)
+    np.testing.assert_allclose(m_acc, m_joint, atol=1e-5)

@@ -76,3 +76,21 @@ def test_check_nan_inf_bf16():
                     fetch_list=[y], scope=scope)
     finally:
         fluid.set_flags({"check_nan_inf": False})
+
+
+def test_contrib_introspection_tools():
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import memory_usage, op_freq_statistic
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.program import Program, program_guard
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [16])
+        h = fluid.layers.fc(x, 8, act="relu")
+        fluid.layers.fc(h, 2)
+    lo, hi, unit = memory_usage(prog, batch_size=32)
+    assert 0 < lo < hi and unit in ("B", "KB", "MB", "GB", "TB")
+    uni, adj = op_freq_statistic(prog)
+    assert uni.get("mul", 0) == 2 and uni.get("relu", 0) == 1
+    assert any("->" in k for k in adj)

@@ -238,9 +238,10 @@ class PServerLoop:
             now = time.monotonic()
             dt = max(now - self._profile_t0, 1e-9)
             rate = period / dt
+            count = self._req_count
             self._profile_t0 = now
         print(f"[pserver {self.op.attr('endpoint')}] handled "
-              f"{self._req_count} requests ({rate:.0f} req/s over the "
+              f"{count} requests ({rate:.0f} req/s over the "
               f"last {period})", flush=True)
 
     def _ckpt_path(self) -> str:

@@ -244,3 +244,163 @@ def lstm_supported(B, T, H, dtype) -> bool:
     if 3 * H * 4 * H * 4 > 80 * 1024 * 1024:   # H > ~1290
         return False
     return H % 128 == 0 and B % 8 == 0 and T >= 1
+
+
+# ---------------------------------------------------------------------------
+# Fused GRU cell — same design as the LSTM above (jit_kernel_rnn.cc GRU
+# precedent): grid=(T,), weights VMEM-resident, backward recomputes gates.
+# Gate layout matches ops/nn_ops.py _gru: w = [update | reset | candidate].
+# ---------------------------------------------------------------------------
+
+def _gru_gates(x_t, h, w):
+    """Returns (u, r, c) post-activation for one step."""
+    H = h.shape[-1]
+    w_uz, w_c = w[:, :2 * H], w[:, 2 * H:]
+    a = x_t[:, :2 * H].astype(jnp.float32) + jnp.dot(
+        h.astype(w.dtype), w_uz, preferred_element_type=jnp.float32)
+    u = jax.nn.sigmoid(a[:, :H])
+    r = jax.nn.sigmoid(a[:, H:])
+    b = x_t[:, 2 * H:].astype(jnp.float32) + jnp.dot(
+        (r * h).astype(w.dtype), w_c, preferred_element_type=jnp.float32)
+    return u, r, jnp.tanh(b)
+
+
+def _gru_fwd_kernel(xs_ref, w_ref, m_ref, h0_ref, hs_ref, h_scr, *, T: int):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    u, r, c = _gru_gates(xs_ref[0], h, w_ref)
+    h_new = u * h + (1.0 - u) * c
+    m = m_ref[0, 0][:, None].astype(jnp.float32)
+    h_out = m * h_new + (1.0 - m) * h
+    h_scr[:] = h_out
+    hs_ref[0] = h_out.astype(hs_ref.dtype)
+
+
+def _gru_bwd_kernel(xs_ref, w_ref, m_ref, h0_ref, hsm1_ref, dhs_ref,
+                    dxs_ref, dw_ref, dh0_ref, dh_scr, dw_scr, *, T: int):
+    idx = pl.program_id(0)
+    t = T - 1 - idx
+
+    @pl.when(idx == 0)
+    def _init():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+
+    H = dh_scr.shape[-1]
+    h_prev = jnp.where(t == 0, h0_ref[:].astype(jnp.float32),
+                       hsm1_ref[0].astype(jnp.float32))
+    u, r, c = _gru_gates(xs_ref[0], h_prev, w_ref)
+    m = m_ref[0, 0][:, None].astype(jnp.float32)
+    wd = w_ref[:]
+    w_uz, w_c = wd[:, :2 * H], wd[:, 2 * H:]
+
+    dh_total = dhs_ref[0].astype(jnp.float32) + dh_scr[:]
+    din = m * dh_total
+    du = din * (h_prev - c)
+    dh_prev = din * u + (1.0 - m) * dh_total
+    dc = din * (1.0 - u)
+    db = dc * (1.0 - c * c)                          # [B,H]
+    drh = jnp.dot(db.astype(wd.dtype), w_c.T,
+                  preferred_element_type=jnp.float32)
+    dr = drh * h_prev
+    dh_prev = dh_prev + drh * r
+    da = jnp.concatenate([du * u * (1.0 - u), dr * r * (1.0 - r)], axis=-1)
+    dh_prev = dh_prev + jnp.dot(da.astype(wd.dtype), w_uz.T,
+                                preferred_element_type=jnp.float32)
+    dxs_ref[0] = jnp.concatenate([da, db], axis=-1).astype(dxs_ref.dtype)
+    dw_scr[:, :2 * H] += jnp.dot(h_prev.astype(wd.dtype).T,
+                                 da.astype(wd.dtype),
+                                 preferred_element_type=jnp.float32)
+    dw_scr[:, 2 * H:] += jnp.dot((r * h_prev).astype(wd.dtype).T,
+                                 db.astype(wd.dtype),
+                                 preferred_element_type=jnp.float32)
+    dh_scr[:] = dh_prev
+
+    @pl.when(idx == T - 1)
+    def _finish():
+        dw_ref[:] = dw_scr[:].astype(dw_ref.dtype)
+        dh0_ref[:] = dh_scr[:].astype(dh0_ref.dtype)
+
+
+def gru_fused(xproj, w, h0, mask, interpret=None):
+    """Fused GRU scan (forward; grads via :func:`gru_fused_grad`).
+    xproj [B,T,3H], w [H,3H], h0 [B,H], mask [B,T] -> hs [B,T,H]."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, T, H3 = xproj.shape
+    H = H3 // 3
+    xs, ms = _tm(xproj), _tm(mask)[:, None, :]
+    kernel = functools.partial(_gru_fwd_kernel, T=T)
+    hs = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((T, B, H), xproj.dtype),
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H3), lambda t: (t, 0, 0)),
+            pl.BlockSpec((H, H3), lambda t: (0, 0)),
+            pl.BlockSpec((1, 1, B), lambda t: (t, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(xs, w, ms, h0)
+    return _tm(hs)
+
+
+def gru_fused_grad(xproj, w, h0, mask, hs, dhs, interpret=None):
+    """Backward of :func:`gru_fused`; returns (dxproj, dw, dh0)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, T, H3 = xproj.shape
+    H = H3 // 3
+    xs, ms = _tm(xproj), _tm(mask)[:, None, :]
+    hs_tm = _tm(hs)
+    dhs_tm = _tm(dhs).astype(xproj.dtype)
+    kernel = functools.partial(_gru_bwd_kernel, T=T)
+
+    def rev(t):
+        return (T - 1 - t, 0, 0)
+
+    def revm1(t):
+        return (jnp.maximum(T - 2 - t, 0), 0, 0)
+
+    dxs, dw, dh0 = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((T, B, H3), xproj.dtype),
+                   jax.ShapeDtypeStruct((H, H3), w.dtype),
+                   jax.ShapeDtypeStruct((B, H), xproj.dtype)],
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H3), rev),                  # xs
+            pl.BlockSpec((H, H3), lambda t: (0, 0)),        # w
+            pl.BlockSpec((1, 1, B), rev),                   # mask
+            pl.BlockSpec((B, H), lambda t: (0, 0)),         # h0
+            pl.BlockSpec((1, B, H), revm1),                 # hs[t-1]
+            pl.BlockSpec((1, B, H), rev),                   # dhs
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H3), rev),
+            pl.BlockSpec((H, H3), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32),
+                        pltpu.VMEM((H, H3), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(xs, w, ms, h0, hs_tm, dhs_tm)
+    return _tm(dxs), dw, dh0
+
+
+def gru_supported(B, T, H, dtype) -> bool:
+    if not _HAVE_PALLAS:
+        return False
+    if 3 * H * 3 * H * 4 > 80 * 1024 * 1024:
+        return False
+    return H % 128 == 0 and B % 8 == 0 and T >= 1

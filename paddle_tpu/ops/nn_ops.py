@@ -360,15 +360,23 @@ def _length_mask(seq_len, B, T, dtype):
     return (t < seq_len[:, None]).astype(dtype)
 
 
-def _lstm_pallas_eligible(ctx, B, T, H, dtype, attrs):
-    from ..kernels import rnn as _rnn
-
+def _rnn_pallas_eligible(ctx, B, T, H, dtype, attrs, supported_fn):
+    """Shared Pallas-cell dispatch policy (lstm + gru): explicit attr
+    wins; otherwise TPU backend + top-level block (control-flow
+    sub-blocks differentiate via jax.vjp, which cannot see through a
+    pallas_call) + MXU/VMEM-compatible shapes."""
     force = attrs.get("use_pallas_kernel", None)
     if force is not None:
         return bool(force)
     top_level = ctx.block is None or getattr(ctx.block, "idx", 0) == 0
     return (jax.default_backend() == "tpu" and top_level
-            and _rnn.lstm_supported(B, T, H, dtype))
+            and supported_fn(B, T, H, dtype))
+
+
+def _lstm_pallas_eligible(ctx, B, T, H, dtype, attrs):
+    from ..kernels import rnn as _rnn
+    return _rnn_pallas_eligible(ctx, B, T, H, dtype, attrs,
+                                _rnn.lstm_supported)
 
 
 @register("lstm", no_grad_slots=("SeqLen",))
@@ -527,11 +535,17 @@ def _attention_lstm(ctx, ins, attrs):
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
     c0 = ins["C0"][0]                                 # required (attention)
     seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
-    mask = _length_mask(seq_len, B, T, jnp.float32)   # [B,T]
+    # the whole scan runs in f32 (attention logits + cell state) and the
+    # outputs cast back — bf16 carries would both break lax.scan's carry
+    # dtype invariant under mixed masking and underflow the -1e30 fill
+    cdt = jnp.float32
+    mask = _length_mask(seq_len, B, T, cdt)           # [B,T]
 
-    w_x, w_c = atten_w[:M, 0], atten_w[M:, 0]         # [M], [D]
-    w_h, w_in = lstm_w[:D], lstm_w[D:]                # [D,4D], [M,4D]
-    atted_x = jnp.einsum("btm,m->bt", x, w_x)         # [B,T]
+    w_x, w_c = (atten_w[:M, 0].astype(cdt),
+                atten_w[M:, 0].astype(cdt))           # [M], [D]
+    w_h, w_in = lstm_w[:D].astype(cdt), lstm_w[D:].astype(cdt)
+    xf = x.astype(cdt)
+    atted_x = jnp.einsum("btm,m->bt", xf, w_x)        # [B,T]
     if atten_b is not None:
         atted_x = atted_x + atten_b
 
@@ -544,8 +558,8 @@ def _attention_lstm(ctx, ins, attrs):
             e = jax.nn.relu(e + (atten_sb if atten_sb is not None else 0.0))
         e = jnp.where(mask > 0, e, -1e30)
         alpha = jax.nn.softmax(e, axis=-1)            # [B,T]
-        lstm_x = jnp.einsum("bt,btm->bm", alpha, x)   # [B,M]
-        gates = lstm_x @ w_in + h @ w_h + lstm_b      # [B,4D]
+        lstm_x = jnp.einsum("bt,btm->bm", alpha, xf)  # [B,M]
+        gates = lstm_x @ w_in + h @ w_h + lstm_b.astype(cdt)
         f = jax.nn.sigmoid(gates[:, :D])
         i = jax.nn.sigmoid(gates[:, D:2 * D])
         o = jax.nn.sigmoid(gates[:, 2 * D:3 * D])
@@ -558,9 +572,9 @@ def _attention_lstm(ctx, ins, attrs):
         return (h_new, c_new), (h_new, c_new)
 
     (h_last, c_last), (hs, cs) = lax.scan(
-        step, (h0.astype(x.dtype), c0.astype(x.dtype)), jnp.arange(T))
-    return {"Hidden": [jnp.swapaxes(hs, 0, 1)],
-            "Cell": [jnp.swapaxes(cs, 0, 1)],
+        step, (h0.astype(cdt), c0.astype(cdt)), jnp.arange(T))
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1).astype(x.dtype)],
+            "Cell": [jnp.swapaxes(cs, 0, 1).astype(x.dtype)],
             "AttentionedX": [atted_x[..., None]],
             # AttentionFCOut/LSTMX/LSTMOUT are per-step SCRATCH in the
             # reference kernel (overwritten every iteration, exposed only
@@ -569,6 +583,12 @@ def _attention_lstm(ctx, ins, attrs):
             "AttentionFCOut": [jnp.zeros((B, T, 1), x.dtype)],
             "LSTMX": [jnp.zeros((B, M), x.dtype)],
             "LSTMOUT": [jnp.zeros((B, 4 * D), x.dtype)]}
+
+
+def _gru_pallas_eligible(ctx, B, T, H, dtype, attrs):
+    from ..kernels import rnn as _rnn
+    return _rnn_pallas_eligible(ctx, B, T, H, dtype, attrs,
+                                _rnn.gru_supported)
 
 
 @register("gru", no_grad_slots=("SeqLen",))
@@ -584,6 +604,19 @@ def _gru(ctx, ins, attrs):
     seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
     mask = _length_mask(seq_len, B, T, xproj.dtype)
     reverse = attrs.get("is_reverse", False)
+
+    # Fused Pallas cell (same dispatch contract as lstm above)
+    use_pallas = _gru_pallas_eligible(ctx, B, T, H, xproj.dtype, attrs)
+    if use_pallas:
+        from ..kernels import rnn as _rnn
+        xp, mk = (jnp.flip(xproj, 1), jnp.flip(mask, 1)) if reverse \
+            else (xproj, mask)
+        hs_bt = _rnn.gru_fused(xp, w, h0.astype(xproj.dtype),
+                               mk.astype(jnp.float32))
+        h_last = hs_bt[:, -1]
+        if reverse:
+            hs_bt = jnp.flip(hs_bt, 1)
+        return {"Hidden": [hs_bt], "LastH": [h_last]}
 
     w_uz = w[:, : 2 * H]
     w_c = w[:, 2 * H :]
@@ -606,6 +639,50 @@ def _gru(ctx, ins, attrs):
     if reverse:
         hs = jnp.flip(hs, 0)
     return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
+
+
+@register_grad("gru")
+def _gru_grad(ctx, ins, attrs):
+    """Explicit gru backward: Pallas path calls the fused backward kernel
+    (gates recomputed in-kernel); other shapes fall back to jax.vjp of
+    the XLA scan lowering (same rationale as _lstm_grad)."""
+    from ..core import registry as _registry
+    from ..kernels import rnn as _rnn
+
+    xproj = ins["Input"][0]
+    B, T, H3 = xproj.shape
+    H = H3 // 3
+    if not _gru_pallas_eligible(ctx, B, T, H, xproj.dtype, attrs):
+        fwd_attrs = {**attrs, "use_pallas_kernel": False}
+        return _registry.vjp_grad(_registry.get("gru"), ctx, ins, fwd_attrs)
+
+    w = ins["Weight"][0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), xproj.dtype)
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    mask = _length_mask(seq_len, B, T, jnp.float32)
+    reverse = attrs.get("is_reverse", False)
+    hs = ins["Hidden"][0]
+
+    g = ins.get("Hidden@GRAD")
+    dhs = (g[0].astype(jnp.float32) if g and g[0] is not None
+           else jnp.zeros((B, T, H), jnp.float32))
+    if reverse:
+        xp, mk = jnp.flip(xproj, 1), jnp.flip(mask, 1)
+        hs_f, dhs_f = jnp.flip(hs, 1), jnp.flip(dhs, 1)
+    else:
+        xp, mk, hs_f, dhs_f = xproj, mask, hs, dhs
+    g = ins.get("LastH@GRAD")
+    if g and g[0] is not None:
+        dhs_f = dhs_f.at[:, -1].add(g[0].astype(jnp.float32))
+
+    dxs, dw, dh0 = _rnn.gru_fused_grad(
+        xp, w, h0.astype(xproj.dtype), mk, hs_f, dhs_f)
+    if reverse:
+        dxs = jnp.flip(dxs, 1)
+    outs = {"Input@GRAD": [dxs], "Weight@GRAD": [dw]}
+    if ins.get("H0"):
+        outs["H0@GRAD"] = [dh0]
+    return outs
 
 
 @register("fused_fc")
@@ -812,7 +889,9 @@ def _fusion_gru(ctx, ins, attrs):
     for slot in ("H0", "SeqLen"):
         if ins.get(slot):
             sub[slot] = ins[slot]
-    out = _gru(ctx, sub, attrs)
+    # XLA scan only: fusion_gru's backward is vjp_grad through this
+    # lowering and cannot see through the Pallas cell (see _fused_lstm_tail)
+    out = _gru(ctx, sub, {**attrs, "use_pallas_kernel": False})
     return {"Hidden": out["Hidden"], "XX": [xproj]}
 
 

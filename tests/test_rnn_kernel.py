@@ -137,3 +137,105 @@ def test_lstm_op_pallas_parity_in_program(reverse):
     h_p, gw_p = run(True)       # forced pallas interpret
     np.testing.assert_allclose(h_p, h_x, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(gw_p, gw_x, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# fused GRU cell
+# ---------------------------------------------------------------------------
+
+def ref_gru(xproj, w, h0, mask):
+    """jnp scan reference — same math as ops/nn_ops.py _gru."""
+    B, T, H3 = xproj.shape
+    H = H3 // 3
+    w_uz, w_c = w[:, :2 * H], w[:, 2 * H:]
+    xs = jnp.swapaxes(xproj, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[..., None]
+
+    def step(h, inp):
+        x_t, m_t = inp
+        uz = jax.nn.sigmoid(x_t[:, :2 * H] + jnp.matmul(h, w_uz))
+        u, r = uz[:, :H], uz[:, H:]
+        c = jnp.tanh(x_t[:, 2 * H:] + jnp.matmul(r * h, w_c))
+        h_new = u * h + (1 - u) * c
+        h_new = m_t * h_new + (1 - m_t) * h
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(step, h0, (xs, ms))
+    return jnp.swapaxes(hs, 0, 1)
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_fused_gru_forward_and_grads_match_scan(masked):
+    B, T, H = 4, 6, 16
+    xproj = jnp.asarray(rng.randn(B, T, 3 * H).astype("float32") * 0.5)
+    w = jnp.asarray(rng.randn(H, 3 * H).astype("float32") * 0.3)
+    h0 = jnp.asarray(rng.randn(B, H).astype("float32") * 0.1)
+    if masked:
+        lens = rng.randint(1, T + 1, (B,))
+        mask = jnp.asarray(
+            (np.arange(T)[None, :] < lens[:, None]).astype("float32"))
+    else:
+        mask = jnp.ones((B, T), "float32")
+
+    hs1 = R.gru_fused(xproj, w, h0, mask, True)
+    hs2 = ref_gru(xproj, w, h0, mask)
+    np.testing.assert_allclose(np.asarray(hs1), np.asarray(hs2),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_ref(xproj, w, h0):
+        return jnp.sum(ref_gru(xproj, w, h0, mask) ** 2)
+
+    g1 = R.gru_fused_grad(xproj, w, h0, mask, hs1, 2.0 * hs1, True)
+    g2 = jax.grad(loss_ref, (0, 1, 2))(xproj, w, h0)
+    for a, b, name in zip(g1, g2, ["dx", "dw", "dh0"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_gru_op_pallas_parity_in_program(reverse):
+    """gru op with use_pallas_kernel=True vs the XLA scan, fwd + grads,
+    both directions."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    B, T, H = 4, 5, 8
+    x = rng.randn(B, T, 3 * H).astype("float32") * 0.3
+    lens = np.array([5, 3, 1, 4], "int64")
+
+    def run(use_pallas):
+        prog, startup = Program(), Program()
+        prog.random_seed = 7
+        with program_guard(prog, startup), unique_name.guard():
+            d = fluid.layers.data("x", [T, 3 * H], lod_level=1)
+            from paddle_tpu.layer_helper import LayerHelper
+            helper = LayerHelper("gru")
+            w = helper.create_parameter("w", (H, 3 * H), "float32")
+            hidden = helper.create_variable_for_type_inference(
+                "float32", shape=(B, T, H))
+            lh = helper.create_variable_for_type_inference(
+                "float32", shape=(B, H))
+            attrs = {"is_reverse": reverse}
+            if use_pallas is not None:
+                attrs["use_pallas_kernel"] = use_pallas
+            from paddle_tpu.layers.nn import seq_len_var
+            helper.append_op(
+                "gru",
+                {"Input": [d], "Weight": [w], "SeqLen": [seq_len_var(d)]},
+                {"Hidden": [hidden], "LastH": [lh]}, attrs)
+            loss = fluid.layers.elementwise_add(
+                fluid.layers.mean(hidden), fluid.layers.mean(lh))
+            pairs = fluid.append_backward(loss)
+            grad_w = dict((p.name, g) for p, g in pairs)[w.name]
+        scope, exe = Scope(), Executor()
+        with scope_guard(scope):
+            exe.run(startup)
+            return exe.run(prog, feed={"x": x, "x@LEN": lens},
+                           fetch_list=[hidden.name, grad_w.name])
+
+    h_x, gw_x = run(None)
+    h_p, gw_p = run(True)
+    np.testing.assert_allclose(h_p, h_x, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(gw_p, gw_x, rtol=2e-4, atol=2e-4)

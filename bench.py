@@ -44,9 +44,17 @@ def two_point_fit(timed):
     device; only the final readback pays the RTT, so
     t(n calls) = RTT + n*t_dispatch and the n=3 minus n=1 difference is
     2 dispatches of pure device time.  ``timed(n)`` runs n back-to-back
-    dispatches and returns wall seconds."""
-    t1 = min(timed(1) for _ in range(3))
-    t3 = min(timed(3) for _ in range(2))
+    dispatches and returns wall seconds.
+
+    Reps: RTT noise is ±several hundred ms, so each point takes the MIN
+    over several samples, interleaved (1,3,1,3,...) so a slow-network
+    window hits both points rather than biasing one side of the fit."""
+    t1s, t3s = [], []
+    for _ in range(3):
+        t1s.append(timed(1))
+        t3s.append(timed(3))
+    t1s.append(timed(1))
+    t1, t3 = min(t1s), min(t3s)
     dt = t3 - t1
     if dt <= 0:  # noise swamped the fit; conservative fallback
         return t3 / 3

@@ -301,6 +301,7 @@ class _NativeServer:
             raise OSError(f"cannot bind {host}:{port}")
         self._service = service
         self._conns = []
+        self._threads = []
         self._closing = False
         self._port = self._lib.ptq_listener_port(self._l)
         self._lock = threading.Lock()
@@ -334,9 +335,14 @@ class _NativeServer:
                     with self._lock:
                         if io in self._conns:
                             self._conns.remove(io)
+                        if threading.current_thread() in self._threads:
+                            self._threads.remove(threading.current_thread())
                     io.close()  # the serving thread OWNS the handle
 
-            threading.Thread(target=serve, daemon=True).start()
+            t = threading.Thread(target=serve, daemon=True)
+            with self._lock:
+                self._threads.append(t)
+            t.start()
 
     def stop(self) -> None:
         lstn = self._l
@@ -349,10 +355,22 @@ class _NativeServer:
             else:
                 self._l = None
                 self._lib.ptq_listener_close(lstn)
+        # quiesce the ACCEPT LOOP first: a connection accepted while we
+        # snapshot would escape both the shutdown and the join below
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
         with self._lock:
             conns = list(self._conns)
+            threads = list(self._threads)
         for io in conns:
             io.shutdown()  # wake readers; serving threads free handles
+        # JOIN the woken threads (bounded): a daemon thread still inside
+        # the C++ transport when the interpreter finalizes dies via
+        # pthread_exit, whose forced unwind aborts through g++ frames
+        # ("FATAL: exception not rethrown") — seen as flaky pserver
+        # crash-on-exit under load
+        for t in threads:
+            t.join(timeout=5.0)
 
 
 # ---------------------------------------------------------------------------

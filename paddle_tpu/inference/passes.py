@@ -270,3 +270,150 @@ def convert_to_nhwc(program: Program, scope=None, keep_vars=()) -> int:
     block.ops[:] = new_ops
     program._version += 1
     return converted
+
+
+# ---------------------------------------------------------------------------
+# fc+RNN fusion (fc_lstm_fuse_pass.cc / fc_gru_fuse_pass.cc): the
+# x-projection matmul (+ bias adds) feeding an lstm/gru collapses into the
+# fusion_lstm / fusion_gru op, whose lowering runs the projection and the
+# scan in one op (the CPU jit-kernel fusion's graph form).
+# ---------------------------------------------------------------------------
+
+def _bias_vec(scope, name):
+    """1-D bias param value (reshaped), or None."""
+    v = scope.find_var(name) if scope is not None else None
+    if v is None:
+        return None
+    return np.asarray(v).reshape(-1)
+
+
+def _fuse_fc_rnn(program, scope, keep_vars, rnn_type, fused_type,
+                 gate_mult):
+    """Shared fc+lstm / fc+gru rewrite.  Pattern (use-counts == 1 on the
+    intermediates, LastH/LastC unused):
+
+        mul(X, Wx)[x_num_col_dims=2] -> [elementwise_add(b)]{1,2} -> rnn
+
+    becomes ``fused_type`` with the bias vectors summed into one [1, G·H]
+    Bias param (created in the scope).  ``gate_mult`` (4 for lstm, 3 for
+    gru) validates every folded bias is a true gate bias of length G·H —
+    an add of any other 1-D vector (e.g. a per-timestep offset broadcast
+    along T) is left alone."""
+    block = program.global_block
+    uses = _use_counts(program, keep_vars)
+    fused = 0
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type != rnn_type:
+            i += 1
+            continue
+        if op.attrs.get("use_pallas_kernel") is not None:
+            i += 1
+            continue
+        # LastH/LastC must be dead (fusion ops don't emit them)
+        last_names = [n for slot in ("LastH", "LastC")
+                      for n in op.output(slot)]
+        if any(uses.get(n, 0) > 0 for n in last_names):
+            i += 1
+            continue
+        # walk the Input producer chain: up to two bias adds then the mul
+        chain = []          # ops to delete (in block order)
+        biases = []
+        cur = op.input("Input")[0]
+        j = i - 1
+        mul_op = None
+        while j >= 0 and len(chain) < 3:
+            p = block.ops[j]
+            if cur in p.output_arg_names():
+                y_shape = tuple(block.var(p.input("Y")[0]).shape or ())
+                H = (block.var(op.input("Weight")[0]).shape or (0,))[0]
+                if (p.type == "elementwise_add"
+                        and p.output("Out") == [cur]
+                        and uses.get(cur, 0) == 1
+                        and y_shape == (gate_mult * H,)
+                        and p.attr("axis", -1) in (-1, 2)):
+                    biases.append(p.input("Y")[0])
+                    chain.append(p)
+                    cur = p.input("X")[0]
+                elif (p.type == "mul" and p.output("Out") == [cur]
+                        and uses.get(cur, 0) == 1
+                        and p.attr("x_num_col_dims", 1) == 2):
+                    mul_op = p
+                    chain.append(p)
+                    break
+                else:
+                    break
+            j -= 1
+        if mul_op is None or not biases:
+            i += 1
+            continue
+        bias_vals = [_bias_vec(scope, n) for n in biases]
+        if any(b is None for b in bias_vals):
+            i += 1
+            continue
+        total = bias_vals[0]
+        for b in bias_vals[1:]:
+            total = total + b
+        bias_name = f"{op.output('Hidden')[0]}@FUSED_BIAS"
+        block.create_var(name=bias_name, shape=(1, total.shape[0]),
+                         dtype=str(total.dtype), persistable=True)
+        scope.set_var(bias_name, total.reshape(1, -1))
+
+        ins = {"X": mul_op.input("X"), "WeightX": mul_op.input("Y"),
+               "WeightH": op.input("Weight"), "Bias": [bias_name]}
+        for slot in ("H0", "C0", "SeqLen"):
+            if op.input(slot):
+                ins[slot] = op.input(slot)
+        xx = block.create_var(
+            name=f"{op.output('Hidden')[0]}@XX",
+            dtype=block.var(op.output("Hidden")[0]).dtype,
+            shape=block.var(mul_op.output("Out")[0]).shape)
+        outs = {"Hidden": op.output("Hidden"), "XX": [xx.name]}
+        if rnn_type == "lstm":
+            outs["Cell"] = op.output("Cell")
+        op.type = fused_type
+        op.inputs = ins
+        op.outputs = outs
+        for dead in chain:
+            block.ops.remove(dead)
+            i -= 1
+        program._version += 1
+        fused += 1
+        i += 1
+    return fused
+
+
+def fuse_fc_lstm(program: Program, scope=None, keep_vars=()) -> int:
+    return _fuse_fc_rnn(program, scope, keep_vars, "lstm", "fusion_lstm", 4)
+
+
+def fuse_fc_gru(program: Program, scope=None, keep_vars=()) -> int:
+    return _fuse_fc_rnn(program, scope, keep_vars, "gru", "fusion_gru", 3)
+
+
+# what the fused_elemwise_activation LOWERING implements (nn_ops.py
+# unary dict) — narrower than _FUSABLE_ACTS, and attr-free
+_ELEWISE_ACTS = {"relu", "sigmoid", "tanh"}
+
+
+def fuse_elewise_add_act(program: Program, scope=None, keep_vars=()) -> int:
+    """elementwise_add -> activation collapses into
+    fused_elemwise_activation (fuse_elewise_add_act_pass.cc)."""
+    block = program.global_block
+    uses = _use_counts(program, keep_vars)
+    fused = 0
+    i = 0
+    while i < len(block.ops) - 1:
+        op, nxt = block.ops[i], block.ops[i + 1]
+        if (op.type == "elementwise_add" and nxt.type in _ELEWISE_ACTS
+                and nxt.input("X") == op.output("Out")
+                and uses.get(op.output("Out")[0], 0) == 1):
+            op.type = "fused_elemwise_activation"
+            op.outputs = {"Out": nxt.output("Out")}
+            op.attrs["functor_list"] = ["elementwise_add", nxt.type]
+            del block.ops[i + 1]
+            program._version += 1
+            fused += 1
+        i += 1
+    return fused

@@ -22,7 +22,8 @@ class AnalysisConfig:
     def __init__(self, model_dir: Optional[str] = None):
         self.model_dir = model_dir
         self.ir_optim = True
-        self._passes = ["fuse_conv_bn", "fuse_fc_act"]
+        self._passes = ["fuse_fc_lstm", "fuse_fc_gru",
+                        "fuse_conv_bn", "fuse_fc_act"]
 
     def set_model(self, model_dir: str) -> None:
         self.model_dir = model_dir

@@ -575,7 +575,7 @@ def _attention_lstm(ctx, ins, attrs):
         step, (h0.astype(cdt), c0.astype(cdt)), jnp.arange(T))
     return {"Hidden": [jnp.swapaxes(hs, 0, 1).astype(x.dtype)],
             "Cell": [jnp.swapaxes(cs, 0, 1).astype(x.dtype)],
-            "AttentionedX": [atted_x[..., None]],
+            "AttentionedX": [atted_x[..., None].astype(x.dtype)],
             # AttentionFCOut/LSTMX/LSTMOUT are per-step SCRATCH in the
             # reference kernel (overwritten every iteration, exposed only
             # because C++ kernels need declared workspaces); emitted as
@@ -860,10 +860,13 @@ def _fused_lstm_tail(ctx, op_name, xproj, ins, attrs):
     for slot in ("H0", "C0", "SeqLen"):
         if ins.get(slot):
             sub[slot] = ins[slot]
-    # XLA scan only: the fused family's backward is vjp_grad through this
-    # lowering, and jax.vjp cannot see through the Pallas cell (only the
-    # plain lstm op has the explicit Pallas grad)
-    out = _lstm(ctx, sub, {**attrs, "use_pallas_kernel": False})
+    # training: XLA scan only — the fused family's backward is vjp_grad
+    # through this lowering and jax.vjp cannot see through a pallas_call.
+    # Inference (ctx.training False, e.g. the Predictor after the
+    # fuse_fc_lstm pass) keeps the Pallas cell dispatch.
+    if ctx.training:
+        attrs = {**attrs, "use_pallas_kernel": False}
+    out = _lstm(ctx, sub, attrs)
     return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": [xproj]}
 
 
@@ -889,9 +892,11 @@ def _fusion_gru(ctx, ins, attrs):
     for slot in ("H0", "SeqLen"):
         if ins.get(slot):
             sub[slot] = ins[slot]
-    # XLA scan only: fusion_gru's backward is vjp_grad through this
-    # lowering and cannot see through the Pallas cell (see _fused_lstm_tail)
-    out = _gru(ctx, sub, {**attrs, "use_pallas_kernel": False})
+    # training: XLA scan only (vjp cannot see through the Pallas cell);
+    # inference keeps the Pallas dispatch (see _fused_lstm_tail)
+    if ctx.training:
+        attrs = {**attrs, "use_pallas_kernel": False}
+    out = _gru(ctx, sub, attrs)
     return {"Hidden": out["Hidden"], "XX": [xproj]}
 
 

@@ -76,18 +76,41 @@ def retry_flaky(times=2):
     """Re-run a socket-based test on failure: free_ports() is
     bind-to-0-then-release, so a parallel process can steal the port
     between release and the pserver's bind (rare; the window spans jit
-    compiles).  Each retry picks fresh ports."""
+    compiles).  Each retry picks fresh ports.
+
+    Retries are LOUD (VERDICT r2 weak #7 — silent retries can mask real
+    transport races): every retry prints the swallowed exception, and a
+    run that only passes on its LAST allowed attempt fails anyway with a
+    consistently-flaky diagnosis so the race gets investigated instead
+    of being absorbed."""
     import functools
+
+    class ConsistentlyFlaky(Exception):
+        pass
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*a, **kw):
             last = None
-            for _ in range(times + 1):
+            for attempt in range(times + 1):
                 try:
-                    return fn(*a, **kw)
+                    result = fn(*a, **kw)
+                    if attempt == times and times > 0:
+                        raise ConsistentlyFlaky(
+                            f"{fn.__name__} needed every one of its "
+                            f"{times} retries to pass — investigate the "
+                            f"race; last swallowed error: {last!r}")
+                    if attempt:
+                        print(f"[retry_flaky] {fn.__name__} passed on "
+                              f"attempt {attempt + 1} after: {last!r}",
+                              flush=True)
+                    return result
+                except ConsistentlyFlaky:
+                    raise
                 except Exception as e:  # noqa: BLE001 — retry everything
                     last = e
+                    print(f"[retry_flaky] {fn.__name__} attempt "
+                          f"{attempt + 1} failed: {e!r}", flush=True)
             raise last
         return wrapper
     return deco

@@ -230,3 +230,53 @@ def test_convert_to_nhwc_pass_preserves_outputs():
     layouts = [op.attr("data_layout") for op in prog.global_block.ops
                if op.type in ("conv2d", "pool2d", "batch_norm")]
     assert all(l == "NHWC" for l in layouts), layouts
+
+
+def test_fc_rnn_and_add_act_fusion_passes():
+    """fuse_fc_lstm / fuse_fc_gru rewrite fc(mul+bias adds)+rnn chains
+    into fusion_lstm / fusion_gru (fc_lstm_fuse_pass.cc analogue) and
+    fuse_elewise_add_act folds add+relu — all preserving outputs."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.inference import passes as P
+
+    B, T, M, H = 3, 5, 6, 4
+    rng = np.random.RandomState(5)
+    x = rng.randn(B, T, M).astype("float32") * 0.4
+    lens = np.array([5, 2, 4], "int64")
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 2
+    with program_guard(prog, startup), unique_name.guard():
+        d = fluid.layers.data("x", [M], lod_level=1)
+        proj = fluid.layers.fc(d, 4 * H, num_flatten_dims=2)
+        hidden, cell = fluid.layers.dynamic_lstm(proj, 4 * H)
+        gproj = fluid.layers.fc(d, 3 * H, num_flatten_dims=2)
+        ghidden = fluid.layers.dynamic_gru(gproj, H)
+        s = fluid.layers.elementwise_add(
+            fluid.layers.sequence_pool(hidden, "sum"),
+            fluid.layers.sequence_pool(ghidden, "sum"))
+        out = fluid.layers.relu(s)
+
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": x, "x@LEN": lens}
+        (want,) = exe.run(prog, feed=feed, fetch_list=[out])
+
+        n_lstm = P.fuse_fc_lstm(prog, scope, keep_vars=[out.name])
+        n_gru = P.fuse_fc_gru(prog, scope, keep_vars=[out.name])
+        n_act = P.fuse_elewise_add_act(prog, scope, keep_vars=[out.name])
+        assert n_lstm == 1 and n_gru == 1 and n_act >= 1, \
+            (n_lstm, n_gru, n_act)
+        types = [op.type for op in prog.global_block.ops]
+        assert "fusion_lstm" in types and "fusion_gru" in types
+        assert "lstm" not in types and "gru" not in types
+        assert "fused_elemwise_activation" in types
+
+        (got,) = exe.run(prog, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -127,9 +127,15 @@ class Executor:
     ``paddle_tpu.TPUPlace()`` / ``CPUPlace()`` for API parity.
     """
 
-    def __init__(self, place=None):
+    def __init__(self, place=None, training: bool = True):
         self.place = place
         self._cache: Dict = {}
+        # lowering mode: inference executors (the Predictor) pass
+        # training=False so ctx.training-gated lowerings (dropout off
+        # without an is_test attr, Pallas RNN cells inside the fusion ops
+        # whose training path needs the vjp-friendly scan) pick the test
+        # branch; part of the executable cache key
+        self._training = training
 
     # -- public API --------------------------------------------------------
     def run(
@@ -158,11 +164,13 @@ class Executor:
             feed_vals.append(self._put_feed(_as_device_array(feed[n], var)))
 
         sig = tuple((n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_vals))
-        key = (id(program), program._version, sig, tuple(fetch_names))
+        key = (id(program), program._version, sig, tuple(fetch_names),
+               self._training)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             plan = analyze_block(program, 0, feed_names, fetch_names)
-            fn = build_block_fn(program, plan, mesh=self._mesh())
+            fn = build_block_fn(program, plan, training=self._training,
+                                mesh=self._mesh())
             jitted = jax.jit(fn, donate_argnums=(1,))
             entry = (plan, jitted)
             if use_program_cache:
@@ -274,11 +282,12 @@ class Executor:
         sig = tuple((n, v.shape, str(v.dtype))
                     for n, v in zip(feed_names, stacked))
         key = (id(program), program._version, sig, tuple(fetch_names),
-               "run_steps")
+               "run_steps", self._training)
         entry = self._cache.get(key)
         if entry is None:
             plan = analyze_block(program, 0, feed_names, fetch_names)
-            fn = build_block_fn(program, plan, mesh=self._mesh())
+            fn = build_block_fn(program, plan, training=self._training,
+                                mesh=self._mesh())
             refeed = plan.donated_write_indices
 
             n_writes = len(plan.persist_writes)

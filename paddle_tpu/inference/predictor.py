@@ -56,7 +56,7 @@ class Predictor:
         self._feed_names = list(feed_names)
         self._fetch_names = list(fetch_names)
         self._scope = scope          # shared weights (clone keeps sharing)
-        self._exe = Executor()
+        self._exe = Executor(training=False)   # inference lowering mode
         self._lock = threading.Lock()  # executor cache is per-predictor
 
     # -- PaddlePredictor::Run ---------------------------------------------

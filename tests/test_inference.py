@@ -280,3 +280,26 @@ def test_fc_rnn_and_add_act_fusion_passes():
         (got,) = exe.run(prog, feed=feed, fetch_list=[out])
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_predictor_mode_lowers_training_false():
+    """An Executor in inference mode (the Predictor's configuration)
+    lowers ctx.training-gated ops in their test branch even WITHOUT
+    is_test attrs: dropout becomes identity."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 9
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [32])
+        out = fluid.layers.dropout(x, dropout_prob=0.5)
+    xv = np.ones((4, 32), "float32")
+    exe = Executor(training=False)
+    with scope_guard(Scope()):
+        (o,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    # downgrade_in_infer test branch: deterministic x*(1-p), no mask draw
+    np.testing.assert_allclose(np.asarray(o), xv * 0.5, rtol=1e-6)

@@ -94,3 +94,57 @@ def test_contrib_introspection_tools():
     uni, adj = op_freq_statistic(prog)
     assert uni.get("mul", 0) == 2 and uni.get("relu", 0) == 1
     assert any("->" in k for k in adj)
+
+
+def test_tools_kube_gen_job_and_timeline(tmp_path):
+    """tools/ parity (SURVEY §2.12): the k8s job generator emits the
+    PADDLE_* env contract + registry wiring; timeline.py merges span
+    dumps with per-input pids."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(here, "tools", "kube_gen_job.py"),
+         "--jobname", "t", "--image", "img", "--entry", "python x.py",
+         "--registry", "reg:7000", "--outdir", str(tmp_path)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    ps = json.load(open(tmp_path / "pserver.yaml"))
+    tn = json.load(open(tmp_path / "trainer.yaml"))
+    svc = json.load(open(tmp_path / "service.yaml"))
+    envs = {e["name"]: e["value"] for e in
+            ps["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert envs["PADDLE_TRAINING_ROLE"] == "PSERVER"
+    assert envs["FLAGS_pserver_registry"] == "reg:7000"
+    # identity + DNS mechanics: Indexed jobs, headless service subdomain,
+    # shell-exported per-pod identity (kubelet can't expand
+    # JOB_COMPLETION_INDEX in user env)
+    assert ps["spec"]["completionMode"] == "Indexed"
+    assert tn["spec"]["completionMode"] == "Indexed"
+    assert svc["spec"]["clusterIP"] == "None"
+    assert ps["spec"]["template"]["spec"]["subdomain"] == "t-svc"
+    ps_cmd = ps["spec"]["template"]["spec"]["containers"][0]["command"][2]
+    assert "PADDLE_CURRENT_ENDPOINT=" in ps_cmd
+    assert "$JOB_COMPLETION_INDEX" in ps_cmd
+    tn_cmd = tn["spec"]["template"]["spec"]["containers"][0]["command"][2]
+    assert "PADDLE_TRAINER_ID=" in tn_cmd
+    tn_envs = {e["name"]: e["value"] for e in
+               tn["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "t-trainer-0.t-svc:" in tn_envs["PADDLE_TRAINER_ENDPOINTS"]
+
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    json.dump({"traceEvents": [{"name": "x", "ph": "X", "ts": 0,
+                                "dur": 5, "tid": 1}]}, open(a, "w"))
+    json.dump({"traceEvents": [{"name": "y", "ph": "X", "ts": 1,
+                                "dur": 2, "tid": 1}]}, open(b, "w"))
+    out = tmp_path / "tl.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(here, "tools", "timeline.py"),
+         "--profile_path", f"{a},{b}", "--timeline_path", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    tl = json.load(open(out))
+    assert {e.get("pid") for e in tl["traceEvents"]} == {0, 1}

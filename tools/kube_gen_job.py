@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Generate Kubernetes manifests for pserver-mode distributed training
+(reference benchmark/fluid/kube_gen_job.py:65 — emits pserver/trainer
+jobs wired through the PADDLE_* env contract).
+
+TPU-native notes: trainers are TPU-VM pods (one JAX process per host;
+``parallel/multihost.py`` forms the JAX world from
+``PADDLE_TRAINER_ENDPOINTS`` + ``PADDLE_TRAINER_ID``), pservers are CPU
+pods serving the framed-TCP transport, and ``FLAGS_pserver_registry``
+points every pod at the elastic discovery registry
+(``distributed/registry.py``) so a rescheduled pserver pod re-claims its
+shard on a new address.
+
+Kubernetes mechanics: both Jobs use Indexed completion mode + a headless
+Service + pod ``subdomain``, so pod *i* is resolvable as
+``<job>-<i>.<service>`` and knows its identity from the controller-set
+``JOB_COMPLETION_INDEX`` env var.  Identity exports
+(``PADDLE_CURRENT_ENDPOINT``, ``PADDLE_TRAINER_ID``) happen in the
+entrypoint SHELL — the kubelet cannot expand ``$(JOB_COMPLETION_INDEX)``
+in user env because the controller appends it after them.
+
+Manifests are plain JSON (a strict YAML subset) — no yaml dependency.
+
+Usage:
+    python tools/kube_gen_job.py --jobname mnist-dist --pservers 2 \
+        --trainers 4 --image my/image --entry "python train.py" --outdir jobs/
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def _env(d):
+    return [{"name": k, "value": str(v)} for k, v in d.items()]
+
+
+def _headless_service(name):
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name},
+        "spec": {"clusterIP": "None",
+                 "selector": {"paddle-job-svc": name},
+                 "ports": [{"port": 1, "name": "placeholder"}]},
+    }
+
+
+def _job(name, svc, replicas, image, command, envs, port=None):
+    container = {"name": name, "image": image,
+                 "command": ["sh", "-c", command], "env": _env(envs)}
+    if port:
+        container["ports"] = [{"containerPort": port}]
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name},
+        "spec": {
+            "parallelism": replicas,
+            "completions": replicas,
+            "completionMode": "Indexed",
+            "template": {
+                "metadata": {"labels": {"paddle-job": name,
+                                        "paddle-job-svc": svc}},
+                "spec": {"restartPolicy": "OnFailure",
+                         "subdomain": svc,
+                         "containers": [container]},
+            },
+        },
+    }
+
+
+def gen_job(args):
+    svc = f"{args.jobname}-svc"
+    ps_job = f"{args.jobname}-pserver"
+    tn_job = f"{args.jobname}-trainer"
+    # Indexed-Job pod i has hostname <job>-<i>; with subdomain=svc it is
+    # resolvable at <job>-<i>.<svc>
+    pserver_eps = ",".join(
+        f"{ps_job}-{i}.{svc}:{args.ps_port}" for i in range(args.pservers))
+    trainer_eps = ",".join(
+        f"{tn_job}-{i}.{svc}:{args.coord_port}" for i in range(args.trainers))
+    common = {
+        "PADDLE_PSERVER_ENDPOINTS": pserver_eps,
+        "PADDLE_TRAINERS_NUM": args.trainers,
+        "FLAGS_rpc_transport": "native",
+    }
+    if args.registry:
+        common["FLAGS_pserver_registry"] = args.registry
+
+    # identity from the controller-set JOB_COMPLETION_INDEX, exported in
+    # the shell (kubelet can't expand it in user env — it is appended
+    # AFTER user vars)
+    ps_cmd = (f'export PADDLE_CURRENT_ENDPOINT='
+              f'"{ps_job}-$JOB_COMPLETION_INDEX.{svc}:{args.ps_port}"; '
+              f'{args.entry}')
+    tn_cmd = (f'export PADDLE_TRAINER_ID="$JOB_COMPLETION_INDEX"; '
+              f'{args.entry}')
+    ps = _job(ps_job, svc, args.pservers, args.image, ps_cmd,
+              {**common, "PADDLE_TRAINING_ROLE": "PSERVER"},
+              port=args.ps_port)
+    tn = _job(tn_job, svc, args.trainers, args.image, tn_cmd,
+              {**common, "PADDLE_TRAINING_ROLE": "TRAINER",
+               # entry 0 is the jax.distributed coordinator
+               # (parallel/multihost.py:30)
+               "PADDLE_TRAINER_ENDPOINTS": trainer_eps})
+    os.makedirs(args.outdir, exist_ok=True)
+    paths = {}
+    for name, manifest in (("service", _headless_service(svc)),
+                           ("pserver", ps), ("trainer", tn)):
+        path = os.path.join(args.outdir, f"{name}.yaml")
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=2)
+        paths[name] = path
+    return paths
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="Generate dist job manifests.")
+    p.add_argument("--jobname", default="paddle-tpu-job")
+    p.add_argument("--pservers", type=int, default=2)
+    p.add_argument("--trainers", type=int, default=2)
+    p.add_argument("--image", required=True)
+    p.add_argument("--entry", required=True,
+                   help="training command run in every pod")
+    p.add_argument("--ps-port", type=int, default=6174)
+    p.add_argument("--coord-port", type=int, default=6175,
+                   help="jax.distributed coordinator port on trainer 0")
+    p.add_argument("--registry", default="",
+                   help="host:port of the discovery registry (optional)")
+    p.add_argument("--outdir", default=".")
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    print(gen_job(parse_args()))

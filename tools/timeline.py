@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Convert profiler span dumps to a Chrome trace file (reference
+tools/timeline.py:115).
+
+The TPU profiler (`paddle_tpu/profiler.py`) already emits Chrome-trace
+JSON natively, so this tool is a thin CLI over it: merge one or more
+span-dump files (the `profiler.stop_profiler(dump_path)` output) into a
+single chrome://tracing-loadable file, offsetting pids per input like the
+reference merges multi-device profiles.
+
+Usage: python tools/timeline.py --profile_path a.json,b.json \
+       --timeline_path timeline.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def merge(paths):
+    events = []
+    for pid, path in enumerate(paths):
+        with open(path) as f:
+            data = json.load(f)
+        evs = data if isinstance(data, list) else data.get("traceEvents", [])
+        for e in evs:
+            e = dict(e)
+            e["pid"] = pid
+            events.append(e)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"profile {path}"}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--profile_path", required=True,
+                   help="comma-separated span-dump json files")
+    p.add_argument("--timeline_path", required=True)
+    args = p.parse_args(argv)
+    out = merge([s for s in args.profile_path.split(",") if s])
+    with open(args.timeline_path, "w") as f:
+        json.dump(out, f)
+    print(f"wrote {args.timeline_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,7 @@ from __future__ import annotations
 import ctypes
 import socket
 import socketserver
+import sys as _sys
 import struct
 import threading
 import time
@@ -111,8 +112,11 @@ class _PyIO:
         deadline = time.time() + timeout
         last = None
         while True:
+            # per-attempt timeout capped by the REMAINING deadline so a
+            # SYN-black-holing peer honors short failover deadlines
+            attempt = max(0.2, min(30.0, deadline - time.time()))
             try:
-                s = socket.create_connection((host, port), timeout=30.0)
+                s = socket.create_connection((host, port), timeout=attempt)
                 s.settimeout(None)
                 return cls(s)
             except OSError as e:  # pserver may not be up yet
@@ -391,6 +395,7 @@ class RPCClient:
     def __init__(self, trainer_id: int = 0):
         self.trainer_id = trainer_id
         self._conns: Dict[str, _Conn] = {}
+        self._was_connected: set = set()
         self._conns_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="rpc-client")
@@ -421,8 +426,15 @@ class RPCClient:
             return self._resolved[logical]
         from . import registry as _registry_mod
         deadline = time.monotonic() + _CONNECT_TIMEOUT
+        reg_err = None
         while True:
-            phys = _registry_mod.resolve(self, self._registry, logical)
+            try:
+                phys = _registry_mod.resolve(self, self._registry, logical)
+                reg_err = None
+            except ConnectionError as e:
+                # registry briefly unreachable (its own conn dropped under
+                # load): indistinguishable from not-yet-registered — poll
+                phys, reg_err = None, e
             if phys is not None:
                 # same address as the dead server: could be its stale lease
                 # (TTL not yet expired) OR a supervisor restart on the SAME
@@ -434,7 +446,9 @@ class RPCClient:
             if time.monotonic() > deadline:
                 raise ConnectionError(
                     f"no live pserver re-registered for {logical!r} "
-                    f"within the deadline (registry {self._registry})")
+                    f"within the deadline (registry {self._registry}"
+                    + (", which is itself UNREACHABLE" if reg_err else "")
+                    + ")") from reg_err
             time.sleep(0.3)
 
     @staticmethod
@@ -449,10 +463,31 @@ class RPCClient:
     def _conn(self, endpoint: str, timeout: float = _CONNECT_TIMEOUT) -> _Conn:
         with self._conns_lock:
             c = self._conns.get(endpoint)
-            if c is None:
-                c = _Conn(endpoint, timeout)
-                self._conns[endpoint] = c
+            was = endpoint in self._was_connected
+        if c is not None:
             return c
+        # Reconnect deadline policy: the LONG deadline exists for initial
+        # bring-up (pservers may start after trainers).  A previously-
+        # connected endpoint reconnects with a SHORT deadline only when a
+        # registry exists to fail over to — static-endpoint mode keeps the
+        # long deadline so an in-place pserver restart is ridden out.
+        if was and self._registry is not None:
+            timeout = min(timeout, 5.0)
+        # connect OUTSIDE the lock: a dead endpoint's blocking connect
+        # must not stall requests to healthy pservers
+        c = _Conn(endpoint, timeout)
+        with self._conns_lock:
+            winner = self._conns.get(endpoint)
+            if winner is None:
+                self._conns[endpoint] = c
+                self._was_connected.add(endpoint)
+                return c
+        # raced another creator: keep theirs, drop ours
+        try:
+            c.io.close()
+        except Exception:
+            pass
+        return winner
 
     def _drop_conn(self, endpoint: str, c: "_Conn") -> None:
         with self._conns_lock:
@@ -514,6 +549,9 @@ class RPCClient:
             # the pserver behind this logical endpoint is gone: wait for a
             # replacement registration and retry there.
             new_phys = self._resolve(endpoint, refresh=True, avoid=phys)
+            # loud by design: operators should see every elastic failover
+            print(f"[rpc-failover] {endpoint} msg={msg_type}: "
+                  f"{phys} -> {new_phys}", file=_sys.stderr, flush=True)
             if new_phys == phys and msg_type not in self._RETRYABLE:
                 # same address answering the probe: could be the SAME live
                 # server after a transient drop — re-sending a SEND_VAR or

@@ -26,11 +26,13 @@ def test_registry_set_get_ttl():
     try:
         ep = f"127.0.0.1:{srv.port}"
         client = transport.RPCClient(0)
-        register(client, ep, "ps0", "10.0.0.1:7000", ttl=0.5)
+        # generous TTL: the assert must run well inside the lease even on
+        # a loaded 1-core CI host
+        register(client, ep, "ps0", "10.0.0.1:7000", ttl=5.0)
         assert resolve(client, ep, "ps0") == "10.0.0.1:7000"
-        register(client, ep, "ps0", "10.0.0.2:7001", ttl=0.5)
+        register(client, ep, "ps0", "10.0.0.2:7001", ttl=2.0)
         assert resolve(client, ep, "ps0") == "10.0.0.2:7001"
-        time.sleep(0.8)
+        time.sleep(2.5)
         assert resolve(client, ep, "ps0") is None   # lease expired
     finally:
         srv.stop()
@@ -95,7 +97,12 @@ def test_pserver_killed_and_restarted_on_new_port():
                 time.sleep(0.1)
             ps2 = start_ps(bind=f"127.0.0.1:{new_port}", ckpt=ckpt)
             out, err = trainer.communicate(timeout=240)
-            assert trainer.returncode == 0, err.decode()[-2000:]
+            if trainer.returncode != 0:
+                ps2.kill()
+                _, ps2_err = ps2.communicate()
+                raise AssertionError(
+                    "trainer failed:\n" + err.decode()[-1500:]
+                    + "\n--- ps2 stderr ---\n" + ps2_err.decode()[-1500:])
             prog = json.load(open(progress))
             assert prog["step"] == 30, prog
             assert all(np.isfinite(l) for l in prog["losses"])

@@ -92,6 +92,14 @@ define_flag("rpc_transport", "native",
             "role) or 'python' (stdlib sockets fallback)")
 define_flag("paddle_num_threads", 1,
             "accepted for parity; host threading is owned by XLA")
+define_flag("sparse_dense_update_max_elems", 32_000_000,
+            "lazy sparse optimizers (adam/momentum/adagrad) use the "
+            "masked-dense update (2 scatters + full-table elementwise; "
+            "4x faster on TPU for medium tables) when the table has at "
+            "most this many elements; larger tables fall back to the "
+            "sorted merge_rows path whose cost is independent of height. "
+            "Read at trace time: set it before the first Executor.run of "
+            "a program (cached executables keep the path they compiled)")
 define_flag("rpc_server_profile_period", 0,
             "pserver self-profiling: log request-rate stats every N "
             "handled RPCs (reference FLAGS_rpc_server_profile_period, "

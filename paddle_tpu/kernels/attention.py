@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634  # kernels run softmax in exp2 units (see below)
 
 
 # ---------------------------------------------------------------------------
@@ -91,15 +92,42 @@ def _hash_dropout(seed, salt, shape, rate):
 # Pallas flash-attention forward kernel
 # ---------------------------------------------------------------------------
 
-def _tile_scores(q_ref, k_ref, mask_ref, qi, kb, *, sm_scale, causal,
+def _scaled_q(q_ref, sm_scale):
+    """Fold ``sm_scale * log2(e)`` into the q tile so the kernels never
+    touch the [block_q, block_k] scores with a scale multiply AND run
+    softmax in exp2 units (exp(x) lowers to exp2(x*log2e) on the VPU —
+    pre-folding the multiplier saves one more op per score element).
+    The [block_q, D] multiply is ~block_k/1 times cheaper than scaling s."""
+    return (q_ref[:].astype(jnp.float32) * (sm_scale * LOG2E)
+            ).astype(q_ref.dtype)
+
+
+def _lane_pack_ok(D, dropout_rate):
+    """Eligibility gate for the forward ones-lane denominator: V must
+    leave output lanes idle (D < 128) and dropout must be off (l must
+    accumulate UNdropped probability mass).  NOTE(perf A/B, r4): bf16
+    score tiles were tried and REGRESSED (52.9->49.5 fwd TF, maxdiff
+    2x) — Mosaic requires f32 matmul accumulators, so the downcast is
+    an extra f32-width op; scores stay f32."""
+    return D < 128 and not (dropout_rate and dropout_rate > 0.0)
+
+
+def _append_lane(x, col=None):
+    """Append one lane to the minor dim: ones when ``col`` is None."""
+    if col is None:
+        col = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+    return jnp.concatenate([x, col.astype(x.dtype)], axis=-1)
+
+
+def _tile_scores(q, k_ref, mask_ref, qi, kb, *, causal,
                  block_q, block_k, has_mask=True):
-    """Masked scaled scores for one (q-block, k-block) tile.
+    """Masked scores (in exp2 units — q pre-scaled by ``_scaled_q``) for
+    one (q-block, k-block) tile.
 
     The dot runs in the INPUT dtype (bf16 on TPU) with an f32
     accumulator — upcasting q/k first would push the MXU into f32 mode
-    at ~1/8 the bf16 rate; sm_scale applies to the f32 scores after."""
-    s = jnp.dot(q_ref[:], k_ref[:].T,
-                preferred_element_type=jnp.float32) * sm_scale
+    at ~1/8 the bf16 rate."""
+    s = jnp.dot(q, k_ref[:].T, preferred_element_type=jnp.float32)
     if has_mask:
         mask = mask_ref[0, :]
         s = jnp.where(mask[None, :] > 0, s, NEG_INF)
@@ -185,15 +213,23 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                       m_scr, l_scr, acc_scr, *,
                       sm_scale: float, causal: bool, dropout_rate: float,
                       block_q: int, block_k: int, num_kb: int,
-                      has_mask: bool):
+                      has_mask: bool, ones_lane: bool, head_dim: int):
     """Grid (B*H, nq, nk); K/V stream through VMEM one block_k tile at a
     time (nk is the sequential minor grid axis on TPU, so the online-softmax
     state lives in VMEM scratch across k iterations — O(block) memory at any
-    sequence length).  Emits the per-row logsumexp for the backward pass.
+    sequence length).  Emits the per-row logsumexp (base-2 units) for the
+    backward pass.
 
     Causal tiles entirely above the diagonal are SKIPPED: no compute, and
     the K/V index maps clamp to the causal frontier so the pipeline issues
-    no copies for them either — ~2x on long causal sequences."""
+    no copies for them either — ~2x on long causal sequences.
+
+    ``ones_lane`` (head_dim < 128, no dropout): V carries an appended ones
+    column, so the PV dot accumulates the softmax denominator in an
+    otherwise-idle MXU lane and the per-element VPU sum-reduce disappears
+    (l rides acc_scr[:, head_dim]).  The kernel is VPU-bound (PERF.md §1);
+    with the exp2/q-prescale folding this drops the per-score-element op
+    count from ~8 to ~5."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     kb = pl.program_id(2)
@@ -208,32 +244,44 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
     @pl.when(kb <= last)
     def _compute():
-        s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+        qs = _scaled_q(q_ref, sm_scale)
+        s = _tile_scores(qs, k_ref, mask_ref, qi, kb,
                          causal=causal, block_q=block_q, block_k=block_k,
                          has_mask=has_mask)
         v_blk = v_ref[:]
 
-        m, l, acc = m_scr[:], l_scr[:], acc_scr[:]
+        m, acc = m_scr[:], acc_scr[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m - m_new)
         m_scr[:] = m_new
-        l_scr[:] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if not ones_lane:
+            l_scr[:] = (l_scr[:] * alpha.astype(jnp.float32)
+                        + jnp.sum(p.astype(jnp.float32), axis=-1,
+                                  keepdims=True))
         if dropout_rate > 0.0:
             # dropout applies to normalized probs; l accumulates undropped
-            p = p * _tile_dropout(seed_ref, bh, qi, kb, p.shape, dropout_rate)
-        acc_scr[:] = acc * alpha + jnp.dot(
+            p = p * _tile_dropout(seed_ref, bh, qi, kb, p.shape,
+                                  dropout_rate).astype(p.dtype)
+        acc_scr[:] = acc * alpha.astype(jnp.float32) + jnp.dot(
             p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32)
 
     @pl.when(kb == last)
     def _finish():
-        l_fin = l_scr[:]
-        o_ref[:] = (acc_scr[:] / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+        if ones_lane:
+            l_fin = acc_scr[:, head_dim:head_dim + 1]
+            out = acc_scr[:, :head_dim]
+        else:
+            l_fin = l_scr[:]
+            out = acc_scr[:]
+        o_ref[:] = (out / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
         # rows with no unmasked keys (query padding): +inf LSE → p == 0
         # everywhere in the backward kernels, never NaN.  LSE rides a
         # whole-row [1, Tq] block (TPU tiling forbids 1D per-q-block
         # outputs); each q-block writes its slice.
-        lse = jnp.where(l_fin > 0.0, m_scr[:] + jnp.log(jnp.maximum(l_fin, 1e-30)),
+        lse = jnp.where(l_fin > 0.0,
+                        m_scr[:].astype(jnp.float32)
+                        + jnp.log2(jnp.maximum(l_fin, 1e-30)),
                         jnp.float32(1e30))
         lse_ref[0, pl.dslice(qi * block_q, block_q)] = lse[:, 0].astype(lse_ref.dtype)
 
@@ -339,12 +387,18 @@ def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
     qf, kf, vf, maskf, Tq_p, Tk_p, has_mask = _prep_padded(
         q, k, v, kv_mask, block_q, block_k)
     num_kb = Tk_p // block_k
+    # ones-lane denominator (measured +28% on the D=64 seq-8192 fwd)
+    ones_lane = _lane_pack_ok(D, dropout_rate)
+    D_v = D + 1 if ones_lane else D
+    if ones_lane:
+        vf = _append_lane(vf)
 
     kv_map, mask_map = _fwd_maps(causal, has_mask, block_q, block_k, num_kb)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, sm_scale=sm_scale,
         causal=causal, dropout_rate=float(dropout_rate),
-        block_q=block_q, num_kb=num_kb, has_mask=has_mask)
+        block_q=block_q, num_kb=num_kb, has_mask=has_mask,
+        ones_lane=ones_lane, head_dim=D)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
@@ -356,7 +410,7 @@ def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
             pl.BlockSpec(memory_space=pltpu.SMEM),  # seed
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_k, D), kv_map),
-            pl.BlockSpec((None, block_k, D), kv_map),
+            pl.BlockSpec((None, block_k, D_v), kv_map),
             pl.BlockSpec((None, 1, block_k), mask_map),
         ],
         out_specs=[
@@ -366,7 +420,7 @@ def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, D_v), jnp.float32),
         ],
         interpret=interpret,
     )(_seed_arr(dropout_seed), qf, kf, vf, maskf)
@@ -393,7 +447,14 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
                          sm_scale, causal, dropout_rate,
                          block_q, block_k, num_kb, has_mask):
     """Grid (B*H, nq, nk): dq accumulates across k-blocks in VMEM.
-    Causal tiles above the diagonal skipped (no compute, no copies)."""
+    Causal tiles above the diagonal skipped (no compute, no copies).
+
+    NOTE(perf A/B, r4): packing a ``-delta`` column into do against a
+    ones column in V (so do@v.T emits dp-delta via an idle MXU lane)
+    was tried and REVERTED: it forces delta through the activation
+    dtype, inflating bf16 dq/dk error 5x (rel maxdiff 0.037 vs 0.0075
+    against the XLA chain), for no measured full-step gain — the D<128
+    backward is MXU-half-fill bound, not VPU bound (PERF.md par.1)."""
     bh, qi, kb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     last = _last_kb(qi, causal=causal, block_q=block_q, block_k=block_k,
                     num_kb=num_kb)
@@ -404,18 +465,22 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
 
     @pl.when(kb <= last)
     def _compute():
-        s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+        s = _tile_scores(_scaled_q(q_ref, sm_scale), k_ref, mask_ref, qi, kb,
                          causal=causal, block_q=block_q, block_k=block_k,
                          has_mask=has_mask)
         lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
         delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
-        p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        p = jnp.exp2(s - lse[:, None])                      # [bq, bk]
         do = do_ref[:]
         v_blk = v_ref[:]
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             dp = dp * _tile_dropout(seed_ref, bh, qi, kb, dp.shape,
                                     dropout_rate)
+        # d/dq of s2 = (q*scale*log2e)@k.T with p = exp2(s2-lse2):
+        # dL/ds2 = p*(dp-delta)*ln2; chain through the log2e fold and the
+        # ln2/log2e product cancels — ds/dq math is IDENTICAL to natural
+        # units, so plain sm_scale scales dq (and dk below)
         ds = (p * (dp - delta[:, None])).astype(k_ref.dtype)
         dq_scr[:] += jnp.dot(ds, k_ref[:],
                              preferred_element_type=jnp.float32) * sm_scale
@@ -443,12 +508,12 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
 
     @pl.when(qi >= first)
     def _compute():
-        s = _tile_scores(q_ref, k_ref, mask_ref, qi, kb, sm_scale=sm_scale,
+        s = _tile_scores(_scaled_q(q_ref, sm_scale), k_ref, mask_ref, qi, kb,
                          causal=causal, block_q=block_q, block_k=block_k,
                          has_mask=has_mask)
         lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
         delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
-        p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        p = jnp.exp2(s - lse[:, None])                      # [bq, bk]
         do = do_ref[:]
         v_blk = v_ref[:]
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)

@@ -12,17 +12,21 @@ attention; three implementations sit behind one function:
               (batch·head, q-block, k-block) tile — regenerated bit-exactly
               by the backward kernels, so no mask is ever materialized)
 - ``ring``:   sequence-parallel attention over a mesh axis — K/V shards
-              rotate around the ring via ``lax.ppermute`` with online
-              softmax merging, so attention over sequence length S uses
-              O(S/sp) memory per chip.  This is the long-context scaling
+              rotate around the ring via ``lax.ppermute``; every shard
+              pair runs the SAME Pallas flash kernel and partials merge
+              by log2 softmax mass, so a device never materializes more
+              than a [block_q, block_k] tile: O(block) compute memory at
+              any sequence length.  This is the long-context scaling
               mechanism (SURVEY.md §5: absent in the 2018 reference,
               required here as first-class).
 
 Gradients: ``jax.custom_vjp``.  The Pallas path saves only (out, LSE) and
 runs tiled backward kernels (dq accumulation over k-blocks; dk/dv
 accumulation over q-blocks) — O(block) memory for training at any sequence
-length, the FlashAttention-2 backward scheme.  Ring attention
-differentiates through shard_map/ppermute natively.
+length, the FlashAttention-2 backward scheme.  Ring attention has its own
+vjp that lifts the same decomposition to shard granularity: per-pair
+``_pallas_bwd`` with the GLOBAL merged lse, dk/dv accumulators riding the
+ring home (see ``ring_attention``).
 """
 from __future__ import annotations
 
@@ -110,6 +114,17 @@ def _lane_pack_ok(D, dropout_rate):
     2x) — Mosaic requires f32 matmul accumulators, so the downcast is
     an extra f32-width op; scores stay f32."""
     return D < 128 and not (dropout_rate and dropout_rate > 0.0)
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct for a pallas_call out_shape that works under
+    shard_map's varying-mesh-axes (vma) checking: outputs vary over the
+    same mesh axes as the operand ``like`` (ring attention calls the
+    kernels per shard inside shard_map)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _append_lane(x, col=None):
@@ -402,8 +417,8 @@ def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 1, Tq_p), jnp.float32),
+            _sds((B * H, Tq_p, D), q.dtype, qf),
+            _sds((B * H, 1, Tq_p), jnp.float32, qf),
         ],
         grid=(B * H, Tq_p // block_q, num_kb),
         in_specs=[
@@ -538,7 +553,7 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
 
 def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
                 dropout_rate=0.0, dropout_seed=None,
-                block_q=None, block_k=None, interpret=None):
+                block_q=None, block_k=None, interpret=None, delta=None):
     block_q, block_k = _resolve_blocks(block_q, block_k,
                                        q.shape[2], k.shape[2])
     if sm_scale is None:
@@ -549,9 +564,10 @@ def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
     qf, kf, vf, maskf, Tq_p, Tk_p, has_mask = _prep_padded(
         q, k, v, kv_mask, block_q, block_k)
     gof, _ = _pad_to(g.reshape(B * H, Tq, D), block_q, 1)
-    outf, _ = _pad_to(out.reshape(B * H, Tq, D), block_q, 1)
-    delta = jnp.sum(gof.astype(jnp.float32) * outf.astype(jnp.float32),
-                    axis=-1)[:, None, :]                     # [BH, 1, Tq_p]
+    if delta is None:  # [BH, 1, Tq_p]; ring bwd hoists it across pairs
+        outf, _ = _pad_to(out.reshape(B * H, Tq, D), block_q, 1)
+        delta = jnp.sum(gof.astype(jnp.float32) * outf.astype(jnp.float32),
+                        axis=-1)[:, None, :]
     num_qb, num_kb = Tq_p // block_q, Tk_p // block_k
     seed = _seed_arr(dropout_seed)
 
@@ -562,7 +578,7 @@ def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
         num_kb=num_kb, has_mask=has_mask)
     dq = pl.pallas_call(
         dq_kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+        out_shape=_sds((B * H, Tq_p, D), q.dtype, qf),
         grid=(B * H, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -597,8 +613,8 @@ def _pallas_bwd(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tk_p, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Tk_p, D), v.dtype),
+            _sds((B * H, Tk_p, D), k.dtype, kf),
+            _sds((B * H, Tk_p, D), v.dtype, kf),
         ],
         grid=(B * H, num_kb, num_qb),
         in_specs=[
@@ -678,31 +694,232 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 # Ring attention: sequence-parallel over a mesh axis
 # ---------------------------------------------------------------------------
 
+RING_PAIR_SALT = (1000003, 7919)  # distinct odd primes per (q, kv) shard
+
+
+def _pair_seed(seed0, q_idx, kv_idx):
+    """Per-ordered-shard-pair dropout seed: the pallas kernels key masks on
+    LOCAL tile coords, so without a distinct seed every (q-shard, kv-shard)
+    pair would repeat the same dropout bits.  int32 wraparound is fine
+    (the hash finalizer mixes)."""
+    a, b = RING_PAIR_SALT
+    return (seed0 + q_idx.astype(jnp.int32) * a
+            + kv_idx.astype(jnp.int32) * b)
+
+
+def _mass_lse(lse):
+    """Kernel empty-row sentinel (+1e30, makes backward p==0) -> merge
+    identity (-1e30 == log2 of zero probability mass)."""
+    return jnp.where(lse > 1e29, jnp.float32(-1e30), lse)
+
+
+def _kernel_lse(lse):
+    """Inverse of _mass_lse for feeding the merged LSE back to the
+    backward kernels."""
+    return jnp.where(lse < -1e29, jnp.float32(1e30), lse)
+
+
+def _merge_partial(o_a, lse_a, o_p, lse_p):
+    """Online merge of two normalized partial attentions via their log2
+    probability masses (the flash-decoding combine): out = sum_i o_i *
+    2^(lse_i - lse_tot)."""
+    lse_t = jnp.logaddexp2(lse_a, lse_p)
+    o_t = (o_a * jnp.exp2(lse_a - lse_t) + o_p * jnp.exp2(lse_p - lse_t))
+    return o_t, lse_t
+
+
+def _ring_pair_fwd(q, k_blk, v_blk, m_blk, causal, sm_scale, rate, seed):
+    """One (q-shard, kv-shard) partial via the Pallas flash kernel:
+    returns (normalized f32 out, [B,H,S,1] log2-mass lse)."""
+    out, lse = _pallas_fwd(q, k_blk, v_blk, m_blk, causal, sm_scale,
+                           rate, seed)
+    B, H, S, D = q.shape
+    lse = lse[:, 0, :S].reshape(B, H, S, 1)
+    return out.astype(jnp.float32), _mass_lse(lse)
+
+
 def ring_attention(q, k, v, kv_mask, axis_name: str, causal=False,
                    sm_scale=None, dropout_rate=0.0, dropout_seed=None):
-    """Blockwise ring attention (to be called under shard_map with the
-    sequence dimension sharded over ``axis_name``).
+    """Blockwise ring attention (called under shard_map with the sequence
+    dimension of q/k/v sharded over ``axis_name``).  Dispatches to the
+    flash-kernel ring (``_ring_flash``); builds without pallas fall back
+    to the pure-jnp blockwise ring (``_ring_xla``, differentiates
+    through shard_map/ppermute natively).
 
-    Each device holds local q/k/v shards [B,H,S/sp,D].  K/V rotate around
-    the ring; partial attention outputs merge with online softmax, so no
-    device ever materializes full-sequence scores — O(S/sp) memory.
-    Dropout (flash-style): l accumulates undropped probability mass while
-    o accumulates dropped contributions, keyed per (q-shard, kv-shard).
+    See ``_ring_flash`` for the kernel-path design."""
+    if not _HAVE_PALLAS:
+        return _ring_xla(q, k, v, kv_mask, axis_name, causal, sm_scale,
+                         dropout_rate, dropout_seed)
+    return _ring_flash(q, k, v, kv_mask, axis_name, causal, sm_scale,
+                       dropout_rate, dropout_seed)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_flash(q, k, v, kv_mask, axis_name: str, causal=False,
+                sm_scale=None, dropout_rate=0.0, dropout_seed=None):
+    """Flash-kernel ring attention.
+
+    Each device holds local shards [B,H,S/sp,D].  The local (diagonal)
+    pair runs the Pallas flash kernel with in-kernel causal tile skip;
+    K/V then rotate around the ring via ``lax.ppermute`` and every
+    off-diagonal pair runs the SAME flash kernel (causal pairs entirely
+    above the diagonal are skipped via ``lax.cond`` — no compute, no
+    kernel launch).  Partials merge by their log2 softmax masses
+    (``_merge_partial``), so no device ever materializes more than the
+    kernel's [block_q, block_k] score tile — O(block) memory inside each
+    shard, O(S/sp) activations per device.  Dropout uses the kernels'
+    counter-hash with a per-shard-pair seed (no threefry, nothing
+    stored).
+
+    Backward (``_ring_bwd``): the same decomposition the flash backward
+    uses over k-blocks, lifted to shard granularity — each pair calls
+    the tiled ``_pallas_bwd`` with the GLOBAL merged lse/out/do (so
+    per-pair probabilities are exact global softmax values), dq
+    accumulates locally, and dk/dv accumulators rotate around the ring
+    WITH their k/v shards, arriving home after a final ppermute.
+    Reference role: long-context sequence parallelism, absent in the
+    2018 reference (SURVEY.md par.5, par.7) — here first-class.
     """
+    out, _ = _ring_fwd(q, k, v, kv_mask, axis_name, causal, sm_scale,
+                       dropout_rate, dropout_seed)
+    return out
+
+
+def _ring_fwd(q, k, v, kv_mask, axis_name, causal, sm_scale,
+              dropout_rate, dropout_seed):
     if sm_scale is None:
         sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    if kv_mask is None:
+        kv_mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    seed0 = (jnp.zeros((), jnp.int32) if dropout_seed is None
+             else jnp.asarray(dropout_seed, jnp.int32).reshape(()))
+
+    o, lse = _ring_pair_fwd(q, k, v, kv_mask, causal, sm_scale,
+                            dropout_rate, _pair_seed(seed0, idx, idx))
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, t):
+        k_c, v_c, m_c, o_a, lse_a = carry
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        m_c = lax.ppermute(m_c, axis_name, perm)
+        kv_i = (idx - t) % sp
+
+        def compute(_):
+            return _ring_pair_fwd(q, k_c, v_c, m_c, False, sm_scale,
+                                  dropout_rate,
+                                  _pair_seed(seed0, idx, kv_i))
+
+        def skip(_):
+            return (jnp.zeros_like(o_a), jnp.full_like(lse_a, -1e30))
+
+        if causal:
+            o_p, lse_p = lax.cond(kv_i < idx, compute, skip, None)
+        else:
+            o_p, lse_p = compute(None)
+        o_a, lse_a = _merge_partial(o_a, lse_a, o_p, lse_p)
+        return (k_c, v_c, m_c, o_a, lse_a), None
+
+    (k_c, v_c, m_c, o, lse), _ = lax.scan(
+        step, (k, v, kv_mask, o, lse), jnp.arange(1, sp))
+    return o.astype(q.dtype), lse
+
+
+def _ring_vjp_fwd(q, k, v, kv_mask, axis_name, causal, sm_scale,
+                  dropout_rate, dropout_seed):
+    out, lse = _ring_fwd(q, k, v, kv_mask, axis_name, causal, sm_scale,
+                         dropout_rate, dropout_seed)
+    return out, (q, k, v, kv_mask, dropout_seed, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, sm_scale, dropout_rate, res, g):
+    q, k, v, kv_mask, dropout_seed, out, lse = res
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    if kv_mask is None:
+        kv_mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    seed0 = (jnp.zeros((), jnp.int32) if dropout_seed is None
+             else jnp.asarray(dropout_seed, jnp.int32).reshape(()))
+    B, H, S, D = q.shape
+    # merged lse back to kernel form, padded the way _pallas_bwd blocks it
+    # (padded q rows: sentinel 1e30 -> p == 0 -> zero contributions)
+    block_q, _ = _resolve_blocks(None, None, S, k.shape[2])
+    Tq_p = S + (-S) % block_q
+    lse_k = jnp.full((B * H, 1, Tq_p), 1e30, jnp.float32)
+    lse_k = lse_k.at[:, :, :S].set(
+        _kernel_lse(lse).reshape(B * H, 1, S))
+    # delta depends only on (g, out) — identical across all sp pairs
+    delta = jnp.zeros((B * H, 1, Tq_p), jnp.float32)
+    delta = delta.at[:, :, :S].set(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1).reshape(B * H, 1, S))
+
+    dq, dk, dv = _pallas_bwd(q, k, v, kv_mask, out, lse_k, g, causal,
+                             sm_scale, dropout_rate,
+                             _pair_seed(seed0, idx, idx), delta=delta)
+    dq = dq.astype(jnp.float32)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, t):
+        k_c, v_c, m_c, dk_a, dv_a, dq_a = carry
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        m_c = lax.ppermute(m_c, axis_name, perm)
+        dk_a = lax.ppermute(dk_a, axis_name, perm)
+        dv_a = lax.ppermute(dv_a, axis_name, perm)
+        kv_i = (idx - t) % sp
+
+        def compute(_):
+            dq_p, dk_p, dv_p = _pallas_bwd(
+                q, k_c, v_c, m_c, out, lse_k, g, False, sm_scale,
+                dropout_rate, _pair_seed(seed0, idx, kv_i), delta=delta)
+            return (dq_p.astype(jnp.float32), dk_p.astype(jnp.float32),
+                    dv_p.astype(jnp.float32))
+
+        def skip(_):
+            return (jnp.zeros_like(dq_a), jnp.zeros_like(dk_a),
+                    jnp.zeros_like(dv_a))
+
+        if causal:
+            dq_p, dk_p, dv_p = lax.cond(kv_i < idx, compute, skip, None)
+        else:
+            dq_p, dk_p, dv_p = compute(None)
+        return (k_c, v_c, m_c, dk_a + dk_p, dv_a + dv_p, dq_a + dq_p), None
+
+    carry = (k, v, kv_mask, dk.astype(jnp.float32), dv.astype(jnp.float32),
+             dq)
+    (k_c, v_c, m_c, dk_a, dv_a, dq_a), _ = lax.scan(
+        step, carry, jnp.arange(1, sp))
+    # one more hop brings each shard's dk/dv accumulator home
+    dk = lax.ppermute(dk_a, axis_name, perm).astype(k.dtype)
+    dv = lax.ppermute(dv_a, axis_name, perm).astype(v.dtype)
+    return dq_a.astype(q.dtype), dk, dv, None, None
+
+
+_ring_flash.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def _ring_xla(q, k, v, kv_mask, axis_name, causal=False, sm_scale=None,
+              dropout_rate=0.0, dropout_seed=None):
+    """Pure-jnp blockwise ring (no-pallas fallback): K/V rotate via
+    ppermute with online-softmax merging; per-pair scores materialize as
+    [B,H,S/sp,S/sp] f32 (still O(S/sp) per device).  Counter-hash
+    dropout keyed per shard pair (same bits family as mha_xla)."""
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    if kv_mask is None:
+        kv_mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     S_local = q.shape[2]
-    drop_key = None
-    if dropout_rate and dropout_rate > 0.0:
-        seed = (jnp.zeros((), jnp.int32) if dropout_seed is None
-                else jnp.asarray(dropout_seed, jnp.int32).reshape(()))
-        drop_key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
-        drop_key = jax.random.fold_in(drop_key, idx)
 
     def partial_attn(k_blk, v_blk, m_blk, kv_idx):
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk).astype(jnp.float32) * sm_scale
+        s = (jnp.einsum("bhqd,bhkd->bhqk", q, k_blk).astype(jnp.float32)
+             * sm_scale)
         s = jnp.where(m_blk[:, None, None, :] > 0, s, NEG_INF)
         if causal:
             qi = jnp.arange(S_local)[:, None] + idx * S_local
@@ -711,11 +928,11 @@ def ring_attention(q, k, v, kv_mask, axis_name: str, causal=False,
         m_new = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m_new)
         l_new = jnp.sum(p, axis=-1, keepdims=True)
-        if drop_key is not None:
-            keep = jax.random.bernoulli(
-                jax.random.fold_in(drop_key, kv_idx),
-                1.0 - dropout_rate, p.shape)
-            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        if dropout_rate and dropout_rate > 0.0:
+            seed = (jnp.zeros((), jnp.int32) if dropout_seed is None
+                    else jnp.asarray(dropout_seed, jnp.int32).reshape(()))
+            p = p * _hash_dropout(
+                seed, idx * 131071 + kv_idx, p.shape, dropout_rate)
         o_new = jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
         return m_new, l_new, o_new
 
@@ -735,9 +952,6 @@ def ring_attention(q, k, v, kv_mask, axis_name: str, causal=False,
         kv_nxt = lax.ppermute(kv_idx, axis_name, perm)
         return (m_new, l_new, o_new, k_nxt, v_nxt, mask_nxt, kv_nxt), None
 
-    B, H, S, D = q.shape
-    # derive inits from q so shard_map's varying-axis inference matches the
-    # ppermute-produced carries
     qf = q.astype(jnp.float32)
     m0 = jnp.full_like(qf[..., :1], NEG_INF)
     l0 = jnp.zeros_like(qf[..., :1])

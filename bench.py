@@ -69,8 +69,9 @@ def bench_program(prog, startup, feed, fetch_names, steps=STEPS,
     (the device-side training loop — amortizes host dispatch the way a
     production TPU loop double-buffers it away); per-step RNG still
     advances so dropout differs step to step.  When ``scan_steps`` is
-    set, ``steps``/``warmup`` are ignored — timing is a fixed
-    1 warmup + 9 fitted dispatches (see two_point_fit).
+    set, ``steps``/``warmup`` are ignored — timing is 1 warmup dispatch
+    plus two_point_fit's interleaved sample schedule (4x n=1 and 3x n=3
+    timed dispatch batches, min-per-point, n=3 minus n=1 fit).
     """
     import jax
     from jax import lax

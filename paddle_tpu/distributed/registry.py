@@ -44,8 +44,14 @@ class RegistryService:
         if msg_type == REG_SET:
             body = json.loads(payload.decode("utf-8"))
             with self._lock:
+                # sweep expired leases so retired logical endpoints don't
+                # accumulate forever (REG_GET only reaps its own key)
+                now = time.monotonic()
+                for k in [k for k, (_, exp) in self._map.items()
+                          if exp < now]:
+                    del self._map[k]
                 self._map[name] = (body["endpoint"],
-                                   time.monotonic() + float(body["ttl"]))
+                                   now + float(body["ttl"]))
             return transport.OK, b""
         if msg_type == REG_GET:
             with self._lock:

@@ -562,8 +562,13 @@ class RPCClient:
                 # request lands on checkpoint-restored state (one extra
                 # async grad — the reference's elastic-mode tolerance).
                 raise
-            return self._raw_request(new_phys, msg_type, name, payload,
-                                     retry_all=True)
+            # Non-idempotent messages (SEND_VAR/BATCH_BARRIER/...) get ONE
+            # attempt at the replacement: with retry_all a transient drop
+            # at the new server could apply the message twice there — two
+            # duplicate grads, beyond the documented one-extra-async-grad
+            # tolerance.  Read-only messages still retry via _raw_request's
+            # own _RETRYABLE gate.
+            return self._raw_request(new_phys, msg_type, name, payload)
 
     # -- public API (grpc_client.h:180-206 signatures) ---------------------
     def send_var(self, endpoint: str, name: str, value) -> None:

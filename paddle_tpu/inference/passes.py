@@ -380,6 +380,10 @@ def _fuse_fc_rnn(program, scope, keep_vars, rnn_type, fused_type,
             i -= 1
         program._version += 1
         fused += 1
+        # the rewrite removed ops and rewired inputs: refresh use-counts
+        # so a later RNN sharing intermediates can't pass a stale
+        # use-count==1 check
+        uses = _use_counts(program, keep_vars)
         i += 1
     return fused
 

@@ -707,6 +707,16 @@ def _pair_seed(seed0, q_idx, kv_idx):
             + kv_idx.astype(jnp.int32) * b)
 
 
+def _pvary(x, axis_name):
+    """Mark a freshly-created (replicated) array as varying over the ring
+    axis so it can enter ppermute/scan carries under shard_map's vma
+    checking; identity where pvary is unavailable."""
+    try:
+        return jax.lax.pvary(x, axis_name)
+    except (AttributeError, TypeError):
+        return x
+
+
 def _mass_lse(lse):
     """Kernel empty-row sentinel (+1e30, makes backward p==0) -> merge
     identity (-1e30 == log2 of zero probability mass)."""
@@ -790,7 +800,10 @@ def _ring_fwd(q, k, v, kv_mask, axis_name, causal, sm_scale,
     if sm_scale is None:
         sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
     if kv_mask is None:
-        kv_mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
+        # fresh arrays are replicated; the ppermute'd scan carry needs the
+        # mask varying over the ring axis (shard_map vma check)
+        kv_mask = _pvary(jnp.ones((q.shape[0], k.shape[2]), jnp.float32),
+                         axis_name)
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     seed0 = (jnp.zeros((), jnp.int32) if dropout_seed is None
@@ -839,7 +852,10 @@ def _ring_vjp_bwd(axis_name, causal, sm_scale, dropout_rate, res, g):
     if sm_scale is None:
         sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
     if kv_mask is None:
-        kv_mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
+        # fresh arrays are replicated; the ppermute'd scan carry needs the
+        # mask varying over the ring axis (shard_map vma check)
+        kv_mask = _pvary(jnp.ones((q.shape[0], k.shape[2]), jnp.float32),
+                         axis_name)
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     seed0 = (jnp.zeros((), jnp.int32) if dropout_seed is None
@@ -912,7 +928,10 @@ def _ring_xla(q, k, v, kv_mask, axis_name, causal=False, sm_scale=None,
     if sm_scale is None:
         sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
     if kv_mask is None:
-        kv_mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
+        # fresh arrays are replicated; the ppermute'd scan carry needs the
+        # mask varying over the ring axis (shard_map vma check)
+        kv_mask = _pvary(jnp.ones((q.shape[0], k.shape[2]), jnp.float32),
+                         axis_name)
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     S_local = q.shape[2]

@@ -119,6 +119,30 @@ def test_ring_attention_causal_grads_match_xla():
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_ring_attention_mask_none_under_shard_map():
+    """kv_mask=None inside shard_map: the fresh ones mask must be marked
+    varying over the ring axis (pvary) before entering ppermute carries
+    — regression for the vma-check crash, fwd AND grads."""
+    q, k, v, _ = qkv(B=1, H=2, T=64, D=16)
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    spec = P(None, None, "sp", None)
+
+    def ring(q, k, v):
+        return A.ring_attention(q, k, v, None, "sp", causal=True)
+
+    sharded = jax.shard_map(ring, mesh=mesh, in_specs=(spec,) * 3,
+                            out_specs=spec)
+    out = sharded(q, k, v)
+    ref = A.mha_xla(q, k, v, None, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    g1 = jax.grad(lambda q: jnp.sum(sharded(q, k, v) ** 2))(q)
+    g2 = jax.grad(lambda q: jnp.sum(
+        A.mha_xla(q, k, v, None, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_ring_attention_dropout_deterministic_o_block_memory():
     """Ring dropout: counter-hash (no threefry), deterministic per seed,
     distinct bits per (q-shard, kv-shard) pair, and the fwd+bwd stay

@@ -70,6 +70,12 @@ def _conv2d(ctx, ins, attrs):
             xs, ws, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         return {"Output": [out]}
+    # NOTE(perf A/B, r4): lowering 1x1 convs as reshape->dot (so XLA could
+    # fuse the BN stats reductions into the dot epilogue, which its conv
+    # emitter cannot take) was tried and REVERTED: whole-model resnet50
+    # measured 2,547 img/s (bf16 dot) / 1,395 (f32-accum dot) vs 2,626
+    # with lax.conv — the reshape barriers break more producer/consumer
+    # fusion than the epilogue recovers.  See PERF.md par.2 round-4 note.
     dn = ("NHWC", "OIHW", "NHWC") if layout == "NHWC" else ("NCHW", "OIHW", "NCHW")
     out = lax.conv_general_dilated(
         x, w,

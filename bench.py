@@ -282,6 +282,101 @@ def _deepfm_scatter_floor(B, rows, emb_dim=10, slots=26, K=24):
     return round(B / dt, 1)
 
 
+def bench_resnet50_datapath():
+    """ResNet-50 with the DATA LAYER on the hot path: batches flow
+    native RecordIO file -> C MPMC queue -> DataLoader (device_prefetch
+    one batch ahead) -> per-step async ``exe.run`` — the reference's
+    double-buffer reader train loop
+    (operators/reader/create_double_buffer_reader_op.cc,
+    benchmark/fluid/fluid_benchmark.py:137).
+
+    On this tunneled chip the HONEST bound is the link, not the model:
+    host->device tops out at ~20 MB/s (measured inline below), which
+    caps ANY fresh-data feed at ~130 img/s f32 — pre-staged feeds are
+    how the main bench isolates device throughput.  The meaningful
+    metric here is pipeline efficiency: measured datapath rate vs the
+    raw ``jax.device_put`` ceiling for the same bytes.  >=0.8 means
+    RecordIO+queue+decode+dispatch add <20% on top of the link."""
+    import os
+    import tempfile
+
+    import jax
+
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.data.loader import DataLoader
+    from paddle_tpu.data.recordio_utils import reader_creator, write_recordio
+    from paddle_tpu.models import resnet
+
+    B, n_batches, steps = 32, 4, 20
+    rng = np.random.RandomState(0)
+    batches = [(rng.randn(B, 3, 224, 224).astype("float32"),
+                rng.randint(0, 1000, (B, 1)).astype("int64"))
+               for _ in range(n_batches)]
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "resnet.recordio")
+
+        def sample_reader():
+            for img, lbl in batches:
+                for i in range(B):
+                    yield (img[i], lbl[i])
+
+        write_recordio(sample_reader, path)
+
+        def batch_reader():
+            while True:  # cycle forever; bench takes `steps` batches
+                buf = []
+                for sample in reader_creator(path)():
+                    buf.append(sample)
+                    if len(buf) == B:
+                        yield buf
+                        buf = []
+
+        prog, startup, (feeds, loss, acc) = _fresh(
+            lambda: resnet.build(dtype="bfloat16", lr=0.1, layout="NHWC"))
+        scope = Scope()
+        exe = Executor()
+        with scope_guard(scope):
+            exe.run(startup)
+            loader = DataLoader(feed_list=["data", "label"],
+                                reader=batch_reader, capacity=2,
+                                program=prog)
+            it = iter(loader)
+            # warmup: compile + settle the queue
+            feed = next(it)
+            l, = exe.run(prog, feed=feed, fetch_list=[loss.name])
+            float(np.asarray(l))
+
+            t0 = time.perf_counter()
+            last = None
+            for _ in range(steps):
+                feed = next(it)
+                last, = exe.run(prog, feed=feed, fetch_list=[loss.name])
+            float(np.asarray(last))      # one batched flush (async run)
+            dt = time.perf_counter() - t0
+        datapath_img_s = steps * B / dt
+
+        # raw link ceiling: device_put the same bytes, nothing else
+        arrs = [b[0] for b in batches]
+        d = jax.device_put(arrs[0])
+        float(np.asarray(d.ravel()[0]))
+        t0 = time.perf_counter()
+        ds = [jax.device_put(arrs[i % n_batches]) for i in range(steps)]
+        for d in ds:
+            d.block_until_ready()
+        float(np.asarray(ds[-1].ravel()[0]))
+        link_img_s = steps * B / (time.perf_counter() - t0)
+
+    return {"images_per_sec": round(datapath_img_s, 1),
+            "link_serial_put_images_per_sec": round(link_img_s, 1),
+            "pipeline_vs_link": round(datapath_img_s / link_img_s, 3),
+            "note": "tunnel H2D ~20MB/s caps fresh-data feeds at ~2% of "
+                    "the pre-staged 2,600 img/s; pipeline_vs_link >= 1 "
+                    "means RecordIO+queue+decode+async-dispatch saturate "
+                    "the link (overlapped transfers beat the serial "
+                    "device_put probe) — the data layer is not the bound"}
+
+
 def bench_mnist():
     from paddle_tpu.models import mnist
 
@@ -461,6 +556,7 @@ def main():
                      ("deepfm", bench_deepfm),
                      ("mnist", bench_mnist),
                      ("flash_attention_seq8k", bench_flash_attention_long),
+                     ("resnet50_datapath", bench_resnet50_datapath),
                      ("scaling_dp8", bench_scaling)]:
         try:
             configs[name] = fn()

@@ -15,7 +15,9 @@ static-shape/recompile-cache policy SURVEY.md §7 calls out.
 """
 from __future__ import annotations
 
+import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -66,6 +68,153 @@ class Scope:
 
     def local_names(self) -> List[str]:
         return list(self.vars)
+
+
+class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
+    """Deferred ``Executor.run`` fetch: holds the device value and
+    materializes to numpy on first host access, so back-to-back ``run``
+    calls pipeline their dispatches instead of paying the host<->device
+    round trip per step (the reference's async stream-execution role,
+    ``details/threaded_ssa_graph_executor.cc:36``; on the tunneled chip
+    one readback costs ~1.4 s, so an N-step user loop was N x RTT).
+
+    Reading ANY pending fetch flushes ALL pending fetches in one batched
+    ``jax.device_get`` — a whole training run's losses cost one round
+    trip at the first read.  Shape/dtype/ndim are served without a sync.
+    Acts as an ndarray for ufuncs/indexing/float()/format; anything else
+    delegates to the materialized array."""
+
+    _PENDING: List = []          # weakrefs: a dropped fetch frees its buffer
+    _LOCK = threading.Lock()     # Executor.run is called from many threads
+    _MAX_PENDING = 512  # flush backstop so unread fetches can't pile up
+
+    def __init__(self, dev):
+        self._dev = dev
+        self._np = None
+        self._err = None
+        with LazyFetch._LOCK:
+            if len(LazyFetch._PENDING) >= LazyFetch._MAX_PENDING:
+                LazyFetch._flush_locked()
+            LazyFetch._PENDING.append(weakref.ref(self))
+
+    @classmethod
+    def _flush(cls):
+        with cls._LOCK:
+            cls._flush_locked()
+
+    @classmethod
+    def _flush_locked(cls):
+        batch = []
+        for ref in cls._PENDING:
+            f = ref()
+            if f is not None and f._np is None and f._err is None:
+                batch.append(f)
+        cls._PENDING.clear()
+        if not batch:
+            return
+        try:
+            vals = jax.device_get([f._dev for f in batch])
+        except Exception:
+            # isolate the poisoned buffer: fetch one by one so a single
+            # failed read cannot lose every other pending value
+            for f in batch:
+                try:
+                    cls._assign(f, jax.device_get(f._dev))
+                except Exception as e:
+                    f._err = e
+                    f._dev = None
+            return
+        for f, v in zip(batch, vals):
+            cls._assign(f, v)
+
+    @staticmethod
+    def _assign(f, v):
+        arr = np.asarray(v)
+        try:
+            arr.setflags(write=False)   # the cache is shared; no aliasing
+        except ValueError:
+            arr = arr.copy()
+            arr.setflags(write=False)
+        f._np = arr
+        f._dev = None
+
+    def _val(self):
+        if self._np is None:
+            LazyFetch._flush()
+            if self._err is not None:
+                raise RuntimeError(
+                    f"deferred fetch failed: {self._err!r}") from self._err
+        return self._np
+
+    # metadata without sync
+    @property
+    def shape(self):
+        return self._np.shape if self._np is not None else tuple(self._dev.shape)
+
+    @property
+    def dtype(self):
+        return self._np.dtype if self._np is not None else np.dtype(self._dev.dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    def __array__(self, dtype=None, *args, **kwargs):
+        # fresh private copy, matching the sync path (np.asarray of a
+        # device value materializes anew each call) — callers may mutate
+        return np.array(self._val(), dtype=dtype, copy=True)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        inputs = tuple(np.asarray(i) if isinstance(i, LazyFetch) else i
+                       for i in inputs)
+        return getattr(ufunc, method)(*inputs, **kwargs)
+
+    def __getitem__(self, idx):
+        return self._val()[idx]
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __iter__(self):
+        return iter(self._val())
+
+    def __float__(self):
+        return float(self._val())
+
+    def __int__(self):
+        return int(self._val())
+
+    def __bool__(self):
+        return bool(self._val())
+
+    def __format__(self, spec):
+        return format(self._val(), spec) if self.ndim == 0 else \
+            format(np.asarray(self._val()), spec)
+
+    def __repr__(self):
+        return repr(self._val())
+
+    def __str__(self):
+        return str(self._val())
+
+    def item(self, *args):
+        return self._val().item(*args)
+
+    def __getattr__(self, name):
+        # anything beyond the fast-path surface: materialize and delegate.
+        # Dunder protocols must NOT leak through (numpy would find the
+        # ml_dtypes array's __array_interface__ and reinterpret bf16
+        # buffers as void bytes; __array__ above is the one true door).
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        return getattr(self._val(), name)
 
 
 _global_scope = Scope()
@@ -146,6 +295,7 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,
+        sync: bool = False,
     ):
         program = program if program is not None else default_main_program()
         feed = _expand_lod_feeds(feed or {})
@@ -211,16 +361,31 @@ class Executor:
                         f"NaN/Inf detected in {name!r} "
                         f"(FLAGS_check_nan_inf)")
         if t0 is not None:
-            sync = next((v for v in list(fetches) + list(new_state)
-                         if v is not None), None)
-            if sync is not None:
-                np.asarray(sync.values if isinstance(sync, SelectedRows)
-                           else sync)
+            sync_ref = next((v for v in list(fetches) + list(new_state)
+                             if v is not None), None)
+            if sync_ref is not None:
+                np.asarray(sync_ref.values
+                           if isinstance(sync_ref, SelectedRows)
+                           else sync_ref)
             print(f"[benchmark] executor run: "
                   f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
 
         if return_numpy:
-            return [self._fetch_to_numpy(v) for v in fetches]
+            if sync:
+                return [self._fetch_to_numpy(v) for v in fetches]
+            # async dispatch: wrap plain-array fetches lazily so user step
+            # loops pipeline (one batched readback at first access).
+            # Fetches that alias persistable state materialize NOW — the
+            # next run() donates that state's buffer, and a deferred read
+            # of a donated buffer would raise.
+            persist = set(plan.persist_writes) | set(plan.donated_reads)
+            out = []
+            for name, v in zip(fetch_names, fetches):
+                if (isinstance(v, jax.Array) and name not in persist):
+                    out.append(LazyFetch(v))
+                else:
+                    out.append(self._fetch_to_numpy(v))
+            return out
         return list(fetches)
 
     def run_steps(

@@ -217,3 +217,82 @@ def test_lod_tensor_feed_shim():
         exe.run(startup)
         out, = exe.run(prog, feed={"seq": lt}, fetch_list=[pooled.name])
     np.testing.assert_allclose(np.asarray(out).reshape(-1), [3.0, 3.0, 15.0])
+
+
+def test_async_run_lazy_fetches():
+    """Executor.run returns lazy fetches by default: ndarray-compatible
+    (ufuncs, float(), indexing, formatting), one batched flush on first
+    access, and sync=True preserves plain-numpy semantics.  Training
+    results must be identical either way."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import (Executor, LazyFetch, Scope,
+                                          scope_guard)
+    from paddle_tpu.core.program import Program, program_guard
+
+    def train(sync):
+        prog, startup = Program(), Program()
+        prog.random_seed = 5
+        with program_guard(prog, startup), unique_name.guard():
+            x = fluid.layers.data("x", [4])
+            y = fluid.layers.data("y", [1])
+            p = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"))
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        scope, exe = Scope(), Executor()
+        rng = np.random.RandomState(0)
+        losses = []
+        with scope_guard(scope):
+            exe.run(startup)
+            for _ in range(5):
+                xb = rng.randn(8, 4).astype("float32")
+                yb = xb.sum(1, keepdims=True).astype("float32")
+                l, = exe.run(prog, feed={"x": xb, "y": yb},
+                             fetch_list=[loss.name], sync=sync)
+                losses.append(l)
+        return losses
+
+    lazy = train(sync=False)
+    plain = train(sync=True)
+    assert all(isinstance(l, LazyFetch) for l in lazy)
+    assert all(isinstance(l, np.ndarray) for l in plain)
+    # ndarray-duck surface
+    l0 = lazy[0]
+    assert l0.shape == () or l0.shape == (1,)
+    assert float(l0) == float(np.asarray(l0))
+    assert f"{float(l0):.3f}"
+    np.testing.assert_allclose(np.asarray(lazy), np.asarray(plain),
+                               rtol=1e-6)
+    assert float(lazy[-1]) < float(lazy[0])  # it actually trained
+
+
+def test_async_run_persistable_fetch_is_eager():
+    """Fetching a persistable var returns a materialized array (its device
+    buffer is donated by the NEXT run; a deferred read would explode)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import (Executor, LazyFetch, Scope,
+                                          scope_guard)
+    from paddle_tpu.core.program import Program, program_guard
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        p = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope, exe = Scope(), Executor()
+    rng = np.random.RandomState(0)
+    with scope_guard(scope):
+        exe.run(startup)
+        ws = []
+        for _ in range(3):
+            xb = rng.randn(8, 4).astype("float32")
+            yb = xb.sum(1, keepdims=True).astype("float32")
+            l, w = exe.run(prog, feed={"x": xb, "y": yb},
+                           fetch_list=[loss.name, "w"])
+            assert not isinstance(w, LazyFetch)
+            ws.append(np.asarray(w).copy())
+        # reads of earlier fetched params stay valid despite donation
+        assert not np.allclose(ws[0], ws[-1])

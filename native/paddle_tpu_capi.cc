@@ -1,0 +1,243 @@
+// C inference API implementation: embeds CPython and drives the
+// paddle_tpu Predictor through paddle_tpu/inference/capi_bridge.py.
+// See paddle_tpu_capi.h for the contract and reference citations
+// (legacy/capi/capi.h; inference/api/paddle_inference_api.h:141,211 —
+// clean-room reimplementation of the deployment CAPABILITY, not the code).
+//
+// Marshaling is bytes-only (PyBytes/PyLong/PyUnicode): no numpy headers,
+// no ctypes — Python.h is the only dependency beyond libc.
+#include "paddle_tpu_capi.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_err;
+thread_local std::string g_name;  // borrowed-string storage for name lookups
+
+void set_err(const char* where) {
+  g_err = where;
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+    if (value != nullptr) {
+      PyObject* s = PyObject_Str(value);
+      if (s != nullptr) {
+        g_err += ": ";
+        g_err += PyUnicode_AsUTF8(s);
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+}
+
+const char* dtype_name(pt_dtype d) {
+  switch (d) {
+    case PT_FLOAT32:  return "float32";
+    case PT_INT64:    return "int64";
+    case PT_INT32:    return "int32";
+    case PT_FLOAT64:  return "float64";
+    case PT_UINT8:    return "uint8";
+    case PT_BFLOAT16: return "bfloat16";
+  }
+  return "float32";
+}
+
+int dtype_from_name(const char* n, pt_dtype* out) {
+  if (std::strcmp(n, "float32") == 0) { *out = PT_FLOAT32; return 0; }
+  if (std::strcmp(n, "int64") == 0)   { *out = PT_INT64;   return 0; }
+  if (std::strcmp(n, "int32") == 0)   { *out = PT_INT32;   return 0; }
+  if (std::strcmp(n, "float64") == 0) { *out = PT_FLOAT64; return 0; }
+  if (std::strcmp(n, "uint8") == 0)   { *out = PT_UINT8;   return 0; }
+  if (std::strcmp(n, "bfloat16") == 0) { *out = PT_BFLOAT16; return 0; }
+  return -1;
+}
+
+PyObject* g_bridge = nullptr;  // paddle_tpu.inference.capi_bridge
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+struct pt_predictor {
+  long handle;
+};
+
+extern "C" {
+
+int pt_init(void) {
+  if (g_bridge != nullptr) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Py_InitializeEx leaves the GIL held by THIS thread; release it so
+    // every capi call (from any thread, including this one) goes through
+    // the Gil ensure/release pair — otherwise worker threads running the
+    // clone-per-thread contract deadlock while this thread sits in C.
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+  if (mod == nullptr) {
+    set_err("pt_init: import paddle_tpu.inference.capi_bridge failed "
+            "(is paddle_tpu on PYTHONPATH?)");
+    return -1;
+  }
+  g_bridge = mod;  // keep the reference for process lifetime
+  return 0;
+}
+
+pt_predictor* pt_predictor_create(const char* model_dir) {
+  if (pt_init() != 0) return nullptr;
+  Gil gil;
+  PyObject* h = PyObject_CallMethod(g_bridge, "create", "s", model_dir);
+  if (h == nullptr) {
+    set_err("pt_predictor_create");
+    return nullptr;
+  }
+  long handle = PyLong_AsLong(h);
+  Py_DECREF(h);
+  pt_predictor* p = new pt_predictor{handle};
+  return p;
+}
+
+pt_predictor* pt_predictor_clone(pt_predictor* p) {
+  Gil gil;
+  PyObject* h = PyObject_CallMethod(g_bridge, "clone", "l", p->handle);
+  if (h == nullptr) {
+    set_err("pt_predictor_clone");
+    return nullptr;
+  }
+  pt_predictor* c = new pt_predictor{PyLong_AsLong(h)};
+  Py_DECREF(h);
+  return c;
+}
+
+int pt_predictor_num_inputs(pt_predictor* p) {
+  Gil gil;
+  PyObject* names = PyObject_CallMethod(g_bridge, "feed_names", "l",
+                                        p->handle);
+  if (names == nullptr) { set_err("pt_predictor_num_inputs"); return -1; }
+  int n = static_cast<int>(PyList_Size(names));
+  Py_DECREF(names);
+  return n;
+}
+
+const char* pt_predictor_input_name(pt_predictor* p, int i) {
+  Gil gil;
+  PyObject* names = PyObject_CallMethod(g_bridge, "feed_names", "l",
+                                        p->handle);
+  if (names == nullptr || i < 0 || i >= PyList_Size(names)) {
+    Py_XDECREF(names);
+    set_err("pt_predictor_input_name: index out of range");
+    return nullptr;
+  }
+  // borrowed via thread-local storage (valid until next name lookup)
+  g_name = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  Py_DECREF(names);
+  return g_name.c_str();
+}
+
+int pt_predictor_num_outputs(pt_predictor* p) {
+  Gil gil;
+  PyObject* n = PyObject_CallMethod(g_bridge, "fetch_count", "l", p->handle);
+  if (n == nullptr) { set_err("pt_predictor_num_outputs"); return -1; }
+  int v = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return v;
+}
+
+int pt_predictor_run(pt_predictor* p, const pt_tensor* inputs, int n_in,
+                     pt_tensor* outputs, int n_out) {
+  Gil gil;
+  PyObject* ins = PyList_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    const pt_tensor& t = inputs[i];
+    PyObject* shape = PyTuple_New(t.ndim);
+    for (int d = 0; d < t.ndim; ++d) {
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
+    }
+    PyObject* tup = Py_BuildValue(
+        "(ssOy#)", t.name, dtype_name(t.dtype), shape,
+        static_cast<const char*>(t.data), (Py_ssize_t)t.nbytes);
+    Py_DECREF(shape);
+    if (tup == nullptr) {
+      Py_DECREF(ins);
+      set_err("pt_predictor_run: input marshal");
+      return -1;
+    }
+    PyList_SET_ITEM(ins, i, tup);
+  }
+  PyObject* outs = PyObject_CallMethod(g_bridge, "run", "lO",
+                                       p->handle, ins);
+  Py_DECREF(ins);
+  if (outs == nullptr) {
+    set_err("pt_predictor_run");
+    return -1;
+  }
+  int n = static_cast<int>(PyList_Size(outs));
+  int written = 0;
+  for (int i = 0; i < n && i < n_out; ++i) {
+    PyObject* tup = PyList_GetItem(outs, i);  // (dtype, shape, bytes)
+    const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+    PyObject* shape = PyTuple_GetItem(tup, 1);
+    PyObject* data = PyTuple_GetItem(tup, 2);
+    pt_tensor* o = &outputs[i];
+    std::memset(o, 0, sizeof(*o));
+    if (dtype_from_name(dt, &o->dtype) != 0) {
+      Py_DECREF(outs);
+      g_err = std::string("pt_predictor_run: unsupported output dtype ") + dt;
+      return -1;
+    }
+    o->ndim = static_cast<int>(PyTuple_Size(shape));
+    for (int d = 0; d < o->ndim && d < 8; ++d) {
+      o->shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+    }
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    PyBytes_AsStringAndSize(data, &buf, &len);
+    o->nbytes = static_cast<size_t>(len);
+    o->data = std::malloc(o->nbytes);
+    std::memcpy(o->data, buf, o->nbytes);
+    o->name = nullptr;
+    ++written;
+  }
+  Py_DECREF(outs);
+  return written;
+}
+
+void pt_tensor_free(pt_tensor* t) {
+  if (t != nullptr && t->data != nullptr) {
+    std::free(t->data);
+    t->data = nullptr;
+    t->nbytes = 0;
+  }
+}
+
+void pt_predictor_destroy(pt_predictor* p) {
+  if (p == nullptr) return;
+  if (g_bridge != nullptr && Py_IsInitialized()) {
+    Gil gil;
+    PyObject* r = PyObject_CallMethod(g_bridge, "destroy", "l", p->handle);
+    Py_XDECREF(r);
+    PyErr_Clear();
+  }
+  delete p;
+}
+
+const char* pt_last_error(void) { return g_err.c_str(); }
+
+}  // extern "C"

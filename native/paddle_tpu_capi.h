@@ -1,0 +1,82 @@
+/* paddle_tpu C inference API — native deployment without writing Python.
+ *
+ * Reference roles mirrored (clean-room, semantics only):
+ *   - paddle/legacy/capi/capi.h:1            (pure-C deployment surface)
+ *   - paddle/fluid/inference/api/paddle_inference_api.h:141,211
+ *     (PaddlePredictor::Run / CreatePaddlePredictor contract)
+ *
+ * The implementation (paddle_tpu_capi.cc) embeds CPython and drives the
+ * paddle_tpu Predictor; the CALLER never touches Python — this header is
+ * plain C and links like any C library:
+ *
+ *   cc app.c -lpaddle_tpu_capi -o app
+ *
+ * Threading: one pt_predictor per thread (mirror of the reference
+ * clone-per-thread contract) — create clones with pt_predictor_clone.
+ * All calls are serialized internally on the embedded interpreter's GIL.
+ */
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  PT_FLOAT32 = 0,
+  PT_INT64 = 1,
+  PT_INT32 = 2,
+  PT_FLOAT64 = 3,
+  PT_UINT8 = 4,
+  PT_BFLOAT16 = 5,
+} pt_dtype;
+
+/* Borrowed-view tensor for inputs; owned-buffer tensor for outputs
+ * (free output tensors with pt_tensor_free). */
+typedef struct {
+  const char* name;     /* feed name; ignored for outputs            */
+  pt_dtype dtype;
+  int ndim;
+  int64_t shape[8];
+  void* data;           /* row-major contiguous                      */
+  size_t nbytes;
+} pt_tensor;
+
+typedef struct pt_predictor pt_predictor;
+
+/* Initialize the embedded runtime (idempotent; called lazily by
+ * pt_predictor_create too).  Returns 0 on success. */
+int pt_init(void);
+
+/* Load a saved inference model directory (fluid.io.save_inference_model
+ * layout) and build a predictor.  NULL on failure — see pt_last_error. */
+pt_predictor* pt_predictor_create(const char* model_dir);
+
+/* Same weights, private executable cache — one clone per serving thread. */
+pt_predictor* pt_predictor_clone(pt_predictor* p);
+
+/* Run one batch.  inputs: n_in borrowed tensors (data not copied until
+ * the call).  outputs: caller-provided array of n_out slots, filled with
+ * malloc'd buffers in the model's fetch order.  Returns the number of
+ * outputs written, or -1 on error. */
+int pt_predictor_run(pt_predictor* p, const pt_tensor* inputs, int n_in,
+                     pt_tensor* outputs, int n_out);
+
+/* Number of feeds / fetches; feed name by index (borrowed string). */
+int pt_predictor_num_inputs(pt_predictor* p);
+int pt_predictor_num_outputs(pt_predictor* p);
+const char* pt_predictor_input_name(pt_predictor* p, int i);
+
+void pt_tensor_free(pt_tensor* t);
+void pt_predictor_destroy(pt_predictor* p);
+
+/* Last error message for this thread (borrowed; valid until next call). */
+const char* pt_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H */

@@ -92,6 +92,7 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
         self._dev = dev
         self._np = None
         self._err = None
+        self._done = threading.Event()
         with LazyFetch._LOCK:
             if len(LazyFetch._PENDING) >= LazyFetch._MAX_PENDING:
                 LazyFetch._flush_locked()
@@ -99,17 +100,14 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
 
     @classmethod
     def _flush(cls):
+        # snapshot under the lock, read back OUTSIDE it: holding the lock
+        # across the ~1.4 s tunneled device_get would serialize every
+        # concurrent Executor.run on LazyFetch construction
         with cls._LOCK:
-            cls._flush_locked()
-
-    @classmethod
-    def _flush_locked(cls):
-        batch = []
-        for ref in cls._PENDING:
-            f = ref()
-            if f is not None and f._np is None and f._err is None:
-                batch.append(f)
-        cls._PENDING.clear()
+            batch = [f for ref in cls._PENDING
+                     if (f := ref()) is not None
+                     and f._np is None and f._err is None]
+            cls._PENDING.clear()
         if not batch:
             return
         try:
@@ -123,37 +121,51 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
                 except Exception as e:
                     f._err = e
                     f._dev = None
+                f._done.set()
             return
         for f, v in zip(batch, vals):
             cls._assign(f, v)
+            f._done.set()
 
     @staticmethod
     def _assign(f, v):
         arr = np.asarray(v)
-        try:
-            arr.setflags(write=False)   # the cache is shared; no aliasing
-        except ValueError:
+        if not arr.flags.writeable:
             arr = arr.copy()
-            arr.setflags(write=False)
+        # ONE mutable array per fetch, like the sync path's returned
+        # ndarray: user mutation through __setitem__/__array__ is visible
+        # to later reads of the same fetch, never to other fetches
         f._np = arr
         f._dev = None
 
     def _val(self):
-        if self._np is None:
+        if self._np is None and self._err is None:
             LazyFetch._flush()
-            if self._err is not None:
-                raise RuntimeError(
-                    f"deferred fetch failed: {self._err!r}") from self._err
+            # raced another thread's in-flight snapshot: its device_get
+            # will assign and signal; wait instead of double-fetching
+            if self._np is None and self._err is None:
+                self._done.wait(timeout=600.0)
+        if self._err is not None:
+            raise RuntimeError(
+                f"deferred fetch failed: {self._err!r}") from self._err
         return self._np
 
     # metadata without sync
     @property
     def shape(self):
-        return self._np.shape if self._np is not None else tuple(self._dev.shape)
+        if self._np is not None:
+            return self._np.shape
+        if self._dev is None:
+            self._val()  # surfaces the stored deferred-fetch error
+        return tuple(self._dev.shape)
 
     @property
     def dtype(self):
-        return self._np.dtype if self._np is not None else np.dtype(self._dev.dtype)
+        if self._np is not None:
+            return self._np.dtype
+        if self._dev is None:
+            self._val()
+        return np.dtype(self._dev.dtype)
 
     @property
     def ndim(self):
@@ -167,9 +179,11 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
         return n
 
     def __array__(self, dtype=None, *args, **kwargs):
-        # fresh private copy, matching the sync path (np.asarray of a
-        # device value materializes anew each call) — callers may mutate
-        return np.array(self._val(), dtype=dtype, copy=True)
+        # identity semantics like the sync path (np.asarray of the one
+        # returned ndarray is that ndarray): hand out the fetch's own
+        # mutable array; only dtype conversion copies
+        a = self._val()
+        return np.asarray(a, dtype=dtype) if dtype is not None else a
 
     def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
         inputs = tuple(np.asarray(i) if isinstance(i, LazyFetch) else i
@@ -179,7 +193,12 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
     def __getitem__(self, idx):
         return self._val()[idx]
 
+    def __setitem__(self, idx, value):
+        self._val()[idx] = value
+
     def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
         return self.shape[0]
 
     def __iter__(self):

@@ -1,0 +1,101 @@
+"""Marshal layer for the native C inference API (native/paddle_tpu_capi.cc).
+
+The embedded interpreter calls ONLY these three functions, passing plain
+Python ints/strs/bytes — no numpy C-API or ctypes on the C side, so the
+native library compiles against Python.h alone.  Reference role: the
+glue the legacy capi's gradient_machine.cpp plays between C structs and
+the C++ core (paddle/legacy/capi/gradient_machine.cpp), redesigned as a
+bytes-protocol bridge.
+
+Wire format per tensor: (name:str, dtype:str, shape:tuple[int], data:bytes).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Tuple
+
+import numpy as np
+
+_DTYPES = {
+    "float32": np.float32,
+    "int64": np.int64,
+    "int32": np.int32,
+    "float64": np.float64,
+    "uint8": np.uint8,
+}
+
+_handles = {}
+_next = [1]
+_lock = threading.Lock()
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(_DTYPES[name])
+
+
+def create(model_dir: str) -> int:
+    import os
+
+    if os.environ.get("PT_CAPI_JAX_PLATFORM"):
+        # the env-var JAX_PLATFORMS route is dead once a PJRT plugin has
+        # registered; honor an explicit platform request in-process (the
+        # C smoke test runs on the forced-CPU mesh this way)
+        import jax
+
+        jax.config.update("jax_platforms",
+                          os.environ["PT_CAPI_JAX_PLATFORM"])
+    from .predictor import AnalysisConfig, create_predictor
+
+    pred = create_predictor(AnalysisConfig(model_dir))
+    with _lock:
+        h = _next[0]
+        _next[0] += 1
+        _handles[h] = pred
+    return h
+
+
+def clone(handle: int) -> int:
+    with _lock:
+        pred = _handles[handle]
+    c = pred.clone()
+    with _lock:
+        h = _next[0]
+        _next[0] += 1
+        _handles[h] = c
+    return h
+
+
+def feed_names(handle: int) -> List[str]:
+    with _lock:
+        return _handles[handle].feed_names
+
+
+def fetch_count(handle: int) -> int:
+    with _lock:
+        return len(_handles[handle].fetch_names)
+
+
+def run(handle: int,
+        inputs: List[Tuple[str, str, tuple, bytes]]
+        ) -> List[Tuple[str, tuple, bytes]]:
+    with _lock:
+        pred = _handles[handle]
+    feed = {}
+    for name, dtype, shape, data in inputs:
+        feed[name] = np.frombuffer(data, dtype=_np_dtype(dtype)).reshape(shape)
+    outs = pred.run(feed)
+    wire = []
+    for o in outs:
+        a = np.ascontiguousarray(np.asarray(o))
+        dt = str(a.dtype)
+        wire.append((dt, tuple(int(d) for d in a.shape), a.tobytes()))
+    return wire
+
+
+def destroy(handle: int) -> None:
+    with _lock:
+        _handles.pop(handle, None)

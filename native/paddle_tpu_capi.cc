@@ -14,6 +14,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 
 namespace {
@@ -80,6 +81,11 @@ struct pt_predictor {
 extern "C" {
 
 int pt_init(void) {
+  // initialization itself must be serialized (two threads racing
+  // Py_InitializeEx is undefined behavior); steady-state calls only
+  // take the GIL
+  static std::mutex init_mu;
+  std::lock_guard<std::mutex> lk(init_mu);
   if (g_bridge != nullptr) return 0;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
@@ -202,15 +208,30 @@ int pt_predictor_run(pt_predictor* p, const pt_tensor* inputs, int n_in,
       g_err = std::string("pt_predictor_run: unsupported output dtype ") + dt;
       return -1;
     }
-    o->ndim = static_cast<int>(PyTuple_Size(shape));
-    for (int d = 0; d < o->ndim && d < 8; ++d) {
+    int ndim = static_cast<int>(PyTuple_Size(shape));
+    if (ndim > 8) {
+      Py_DECREF(outs);
+      g_err = "pt_predictor_run: output rank > 8 unsupported by pt_tensor";
+      return -1;
+    }
+    o->ndim = ndim;
+    for (int d = 0; d < o->ndim; ++d) {
       o->shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
     }
     char* buf = nullptr;
     Py_ssize_t len = 0;
-    PyBytes_AsStringAndSize(data, &buf, &len);
+    if (PyBytes_AsStringAndSize(data, &buf, &len) != 0) {
+      Py_DECREF(outs);
+      set_err("pt_predictor_run: output bytes marshal");
+      return -1;
+    }
     o->nbytes = static_cast<size_t>(len);
-    o->data = std::malloc(o->nbytes);
+    o->data = std::malloc(o->nbytes ? o->nbytes : 1);
+    if (o->data == nullptr) {
+      Py_DECREF(outs);
+      g_err = "pt_predictor_run: out of memory";
+      return -1;
+    }
     std::memcpy(o->data, buf, o->nbytes);
     o->name = nullptr;
     ++written;

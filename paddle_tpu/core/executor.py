@@ -93,10 +93,21 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
         self._np = None
         self._err = None
         self._done = threading.Event()
+        backstop = None
         with LazyFetch._LOCK:
             if len(LazyFetch._PENDING) >= LazyFetch._MAX_PENDING:
-                LazyFetch._flush_locked()
+                backstop = LazyFetch._snapshot_locked()
             LazyFetch._PENDING.append(weakref.ref(self))
+        if backstop:  # materialize OUTSIDE the lock (see _flush)
+            LazyFetch._materialize(backstop)
+
+    @classmethod
+    def _snapshot_locked(cls):
+        batch = [f for ref in cls._PENDING
+                 if (f := ref()) is not None
+                 and f._np is None and f._err is None]
+        cls._PENDING.clear()
+        return batch
 
     @classmethod
     def _flush(cls):
@@ -104,10 +115,11 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
         # across the ~1.4 s tunneled device_get would serialize every
         # concurrent Executor.run on LazyFetch construction
         with cls._LOCK:
-            batch = [f for ref in cls._PENDING
-                     if (f := ref()) is not None
-                     and f._np is None and f._err is None]
-            cls._PENDING.clear()
+            batch = cls._snapshot_locked()
+        cls._materialize(batch)
+
+    @classmethod
+    def _materialize(cls, batch):
         if not batch:
             return
         try:
@@ -144,28 +156,34 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
             # raced another thread's in-flight snapshot: its device_get
             # will assign and signal; wait instead of double-fetching
             if self._np is None and self._err is None:
-                self._done.wait(timeout=600.0)
+                if not self._done.wait(timeout=600.0):
+                    raise RuntimeError(
+                        "deferred fetch timed out waiting for another "
+                        "thread's in-flight device readback")
         if self._err is not None:
             raise RuntimeError(
                 f"deferred fetch failed: {self._err!r}") from self._err
         return self._np
 
-    # metadata without sync
+    # metadata without sync (snapshot fields first: a concurrent flush
+    # may assign _np and null _dev between attribute reads)
     @property
     def shape(self):
-        if self._np is not None:
-            return self._np.shape
-        if self._dev is None:
-            self._val()  # surfaces the stored deferred-fetch error
-        return tuple(self._dev.shape)
+        a, dev = self._np, self._dev
+        if a is not None:
+            return a.shape
+        if dev is not None:
+            return tuple(dev.shape)
+        return self._val().shape
 
     @property
     def dtype(self):
-        if self._np is not None:
-            return self._np.dtype
-        if self._dev is None:
-            self._val()
-        return np.dtype(self._dev.dtype)
+        a, dev = self._np, self._dev
+        if a is not None:
+            return a.dtype
+        if dev is not None:
+            return np.dtype(dev.dtype)
+        return self._val().dtype
 
     @property
     def ndim(self):
@@ -181,8 +199,11 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
     def __array__(self, dtype=None, *args, **kwargs):
         # identity semantics like the sync path (np.asarray of the one
         # returned ndarray is that ndarray): hand out the fetch's own
-        # mutable array; only dtype conversion copies
+        # mutable array; dtype conversion or an explicit numpy-2
+        # copy=True request returns a private copy
         a = self._val()
+        if kwargs.get("copy") or (args and args[0]):
+            return np.array(a, dtype=dtype, copy=True)
         return np.asarray(a, dtype=dtype) if dtype is not None else a
 
     def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
@@ -262,7 +283,8 @@ def scope_guard(scope: Scope):
 def _expand_lod_feeds(feed):
     """A fed LoDTensor splits into its padded array + the ``@LEN``
     companion (the reference's LoD travels inside the tensor; the padded
-    contract carries lengths as a separate feed)."""
+    contract carries lengths as a separate feed).  Nested (level-2)
+    tensors additionally carry the inner [B, S] lengths as ``@LEN2``."""
     from ..lod_tensor import LoDTensor
 
     out = {}
@@ -270,6 +292,8 @@ def _expand_lod_feeds(feed):
         if isinstance(val, LoDTensor):
             out[name] = val.data
             out.setdefault(name + "@LEN", val.seq_lens)
+            if val.inner_lens is not None:
+                out.setdefault(name + "@LEN2", val.inner_lens)
         else:
             out[name] = val
     return out

@@ -244,6 +244,8 @@ class Block:
         # padded-sequence bookkeeping: var name -> companion length var name
         # (the LoDTensor-offsets redesign; see layers/nn.py module docstring)
         self.seq_len_map: Dict[str, str] = {}
+        # nested (lod_level 2) inner lengths: var name -> [B, S] companion
+        self.seq_len2_map: Dict[str, str] = {}
 
     # -- vars --------------------------------------------------------------
     def create_var(self, name: Optional[str] = None, **kwargs) -> Variable:
@@ -316,6 +318,7 @@ class Block:
             "parent_idx": self.parent_idx,
             "forward_block_idx": self.forward_block_idx,
             "seq_len_map": dict(self.seq_len_map),
+            "seq_len2_map": dict(self.seq_len2_map),
             "vars": [v.to_dict() for v in self.vars.values()],
             "ops": [op.to_dict() for op in self.ops],
         }
@@ -426,6 +429,7 @@ class Program:
             b = Block(p, bd["idx"], bd.get("parent_idx", -1))
             b.forward_block_idx = bd.get("forward_block_idx", -1)
             b.seq_len_map = dict(bd.get("seq_len_map", {}))
+            b.seq_len2_map = dict(bd.get("seq_len2_map", {}))
             for vd in bd["vars"]:
                 b.vars[vd["name"]] = Variable.from_dict(b, vd)
             for od in bd["ops"]:

@@ -36,6 +36,16 @@ def seq_len_var(x: Variable) -> Optional[Variable]:
     return x.block.var_or_none(x.name + "@LEN")
 
 
+def seq_len2_var(x: Variable) -> Optional[Variable]:
+    """Inner (level-2) [B, S] lengths companion of a padded-nested batch."""
+    b = x.block
+    while b is not None:
+        if x.name in getattr(b, "seq_len2_map", {}):
+            return b.var_or_none(b.seq_len2_map[x.name])
+        b = b.parent_block
+    return x.block.var_or_none(x.name + "@LEN2")
+
+
 # ---------------------------------------------------------------------------
 # core layers
 # ---------------------------------------------------------------------------
@@ -67,8 +77,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
     out = helper.append_activation(pre_act)
     first = inputs[0]
-    if num_flatten_dims >= 2 and seq_len_var(first) is not None:
-        _alias_len(out, seq_len_var(first))
+    if num_flatten_dims >= 2:
+        _propagate_lod(out, first)
     return out
 
 
@@ -98,8 +108,7 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         {"is_sparse": is_sparse, "is_distributed": is_distributed,
          "padding_idx": padding_idx},
     )
-    if seq_len_var(input) is not None:
-        _alias_len(out, seq_len_var(input))
+    _propagate_lod(out, input)
     return out
 
 
@@ -789,25 +798,61 @@ def _alias_len(var, seq_len):
     var.block.seq_len_map[var.name] = seq_len.name
 
 
+def _propagate_lod(out, x):
+    """Carry BOTH length companions and the lod_level through a
+    shape-preserving layer (embedding/fc/elementwise...): without this,
+    a nested ids -> embedding -> sequence_pool pipeline would silently
+    fall back to the level-1 path with outer lengths applied to the
+    sentence axis."""
+    sl = seq_len_var(x)
+    if sl is not None:
+        _alias_len(out, sl)
+    if getattr(x, "lod_level", 0) == 2:
+        sl2 = seq_len2_var(x)
+        if sl2 is not None:
+            out.block.seq_len2_map[out.name] = sl2.name
+            out.lod_level = 2
+
+
 # ---------------------------------------------------------------------------
 # sequence layers (padded contract; reference sequence_* op family)
 # ---------------------------------------------------------------------------
 
 def _seq_op(op_type, input, attrs=None, out_shape=None, pool=False, name=None):
+    """Sequence-op layer shim.  Nested (lod_level 2) inputs route their
+    inner [B, S] lengths through the op's "SeqLen2" slot (the op flattens
+    to [B*S, W, ...] internally — ops/sequence_ops.py _nestable); pooling
+    then REMOVES the inner level, so the result is a level-1 sequence
+    whose companion is the OUTER lengths."""
     helper = LayerHelper(op_type, name=name)
+    sl = seq_len_var(input)
+    sl2 = seq_len2_var(input)
+    nested = getattr(input, "lod_level", 0) == 2 and sl2 is not None
+    if nested and pool and out_shape is None:
+        out_shape = tuple(input.shape[:2]) + tuple(input.shape[3:])
     out = helper.create_variable_for_type_inference(
         input.dtype, shape=out_shape if out_shape is not None else input.shape)
     ins = {"X": [input]}
-    sl = seq_len_var(input)
-    if sl is not None:
+    if sl is not None and not nested:
         ins["SeqLen"] = [sl]
+    if sl2 is not None:
+        ins["SeqLen2"] = [sl2]
     helper.append_op(op_type, ins, {"Out": [out]}, attrs or {})
-    if not pool and sl is not None:
+    if nested:
+        out.lod_level = 1 if pool else 2
+        if sl is not None:
+            _alias_len(out, sl)       # outer lengths survive either way
+        if not pool and sl2 is not None:
+            out.block.seq_len2_map[out.name] = sl2.name
+    elif not pool and sl is not None:
         _alias_len(out, sl)
     return out
 
 
 def sequence_pool(input, pool_type, name=None):
+    if getattr(input, "lod_level", 0) == 2:
+        return _seq_op("sequence_pool", input,
+                       {"pooltype": pool_type.upper()}, pool=True, name=name)
     out_shape = (input.shape[0],) + tuple(input.shape[2:])
     return _seq_op("sequence_pool", input, {"pooltype": pool_type.upper()},
                    out_shape=out_shape, pool=True, name=name)
@@ -822,12 +867,14 @@ def sequence_reverse(x, name=None):
 
 
 def sequence_first_step(input):
-    out_shape = (input.shape[0],) + tuple(input.shape[2:])
+    out_shape = (None if getattr(input, "lod_level", 0) == 2
+                 else (input.shape[0],) + tuple(input.shape[2:]))
     return _seq_op("sequence_first_step", input, out_shape=out_shape, pool=True)
 
 
 def sequence_last_step(input):
-    out_shape = (input.shape[0],) + tuple(input.shape[2:])
+    out_shape = (None if getattr(input, "lod_level", 0) == 2
+                 else (input.shape[0],) + tuple(input.shape[2:]))
     return _seq_op("sequence_last_step", input, out_shape=out_shape, pool=True)
 
 

@@ -365,3 +365,53 @@ def _lod_reset(ctx, ins, attrs):
         new_len = jnp.asarray(
             [tl[i + 1] - tl[i] for i in range(len(tl) - 1)], jnp.int64)
     return {"Out": [x], "OutLen": [new_len.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# nested (lod_level 2) support: [B, S, W, ...] + inner lengths [B, S]
+# ---------------------------------------------------------------------------
+# General level-2 sequences (reference lod_tensor.h:58 nested LoD — e.g.
+# paragraph -> sentence -> word) reduce to level-1 ops on the flattened
+# sentence axis: the padded-nested layout [B, S, W, ...] with inner
+# lengths [B, S] IS [B*S, W, ...] with lengths [B*S].  Ops that operate
+# on the innermost level take the optional "SeqLen2" slot and run their
+# level-1 lowering over the flattened view; pooling removes the inner
+# level (out [B, S, ...], outer @LEN becomes the companion — the layer
+# wires that).  Sentence slots past a sample's outer length have
+# length 0 and pool to zeros, masked downstream by the outer lengths.
+
+_NESTED_INNER_OPS = ("sequence_pool", "sequence_softmax", "sequence_reverse",
+                     "sequence_first_step", "sequence_last_step",
+                     "sequence_pad", "sequence_unpad")
+
+
+def _nestable(fn):
+    def wrapped(ctx, ins, attrs):
+        if not ins.get("SeqLen2"):
+            return fn(ctx, ins, attrs)
+        x = ins["X"][0]
+        B, S = x.shape[0], x.shape[1]
+        lens2 = ins["SeqLen2"][0].reshape(-1)
+        sub = {k: v for k, v in ins.items() if k != "SeqLen2"}
+        sub["X"] = [x.reshape((B * S,) + x.shape[2:])]
+        sub["SeqLen"] = [lens2]
+        out = fn(ctx, sub, attrs)
+        o = out["Out"][0]
+        out["Out"] = [o.reshape((B, S) + o.shape[1:])]
+        for slot in ("Length", "OutLen"):
+            if slot in out:
+                out[slot] = [out[slot][0].reshape(B, S)]
+        return out
+    return wrapped
+
+
+def _enable_nested():
+    from ..core.registry import _REGISTRY
+
+    for t in _NESTED_INNER_OPS:
+        opdef = _REGISTRY[t]
+        opdef.lower = _nestable(opdef.lower)
+        opdef.no_grad_slots.add("SeqLen2")
+
+
+_enable_nested()

@@ -132,3 +132,105 @@ def test_ifelse_row_wise():
 
     (out,) = _run(build, {"x": x})
     np.testing.assert_allclose(out, [[10.0], [20.0], [-3.0], [-4.0]])
+
+
+# ---------------------------------------------------------------------------
+# general nested LoD (level 2) — reference lod_tensor.h:58 nesting
+# ---------------------------------------------------------------------------
+
+def _nested_corpus():
+    """2 samples: sample0 = 2 sentences (3, 1 words), sample1 = 1 sentence
+    (2 words); word vectors are 2-d."""
+    words = np.arange(12, dtype=np.float32).reshape(6, 2) + 1.0
+    outer = [2, 1]
+    inner = [3, 1, 2]
+    return words, outer, inner
+
+
+def test_nested_lodtensor_apis():
+    import paddle_tpu as fluid
+
+    words, outer, inner = _nested_corpus()
+    lt = fluid.create_lod_tensor(words, [outer, inner], None)
+    assert lt.recursive_sequence_lengths() == [outer, inner]
+    assert lt.lod() == [[0, 2, 3], [0, 3, 4, 6]]
+    B, S, W = lt.data.shape[:3]
+    assert B == 2 and S >= 2 and W >= 3
+    # sample0/sentence0 holds words 0..2, sample1/sentence0 words 4..5
+    np.testing.assert_allclose(lt.data[0, 0, :3], words[:3])
+    np.testing.assert_allclose(lt.data[1, 0, :2], words[4:])
+    assert lt.inner_lens[0, 0] == 3 and lt.inner_lens[1, 0] == 2
+
+
+def test_nested_feed_double_pool():
+    """words -> sentence vectors (inner sum pool, removes level 2) ->
+    document vector (outer sum pool): the hierarchical workload nested
+    LoD exists for, end to end through the executor."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    words, outer, inner = _nested_corpus()
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        d = fluid.layers.data("doc", [2], lod_level=2)
+        sent = fluid.layers.sequence_pool(d, "sum")    # [B, S, 2], level 1
+        assert sent.lod_level == 1
+        doc = fluid.layers.sequence_pool(sent, "sum")  # [B, 2]
+    lt = fluid.create_lod_tensor(words, [outer, inner], None)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        s_out, d_out = exe.run(prog, feed={"doc": lt},
+                               fetch_list=[sent.name, doc.name], sync=True)
+    # sentence sums: s0 = words[0:3].sum, s1 = words[3:4].sum; s1_0 = words[4:6].sum
+    np.testing.assert_allclose(s_out[0, 0], words[:3].sum(0))
+    np.testing.assert_allclose(s_out[0, 1], words[3])
+    np.testing.assert_allclose(s_out[1, 0], words[4:].sum(0))
+    # doc sums ignore empty sentence slots (they pooled to zero)
+    np.testing.assert_allclose(d_out[0], words[:4].sum(0))
+    np.testing.assert_allclose(d_out[1], words[4:].sum(0))
+
+
+def test_nested_sequence_softmax_masks_inner():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    scores = np.array([[1.0], [2.0], [3.0], [4.0], [5.0], [6.0]], np.float32)
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        d = fluid.layers.data("s", [1], lod_level=2)
+        sm = fluid.layers.sequence_softmax(d)
+        assert sm.lod_level == 2
+    lt = fluid.create_lod_tensor(scores, [[2, 1], [3, 1, 2]], None)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(prog, feed={"s": lt}, fetch_list=[sm.name], sync=True)
+    # softmax normalizes WITHIN each sentence
+    ref0 = np.exp(scores[:3, 0] - scores[:3, 0].max())
+    np.testing.assert_allclose(out[0, 0, :3, 0], ref0 / ref0.sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[0, 1, 0, 0], 1.0, rtol=1e-6)  # single word
+    ref2 = np.exp(scores[4:, 0] - scores[4:, 0].max())
+    np.testing.assert_allclose(out[1, 0, :2, 0], ref2 / ref2.sum(),
+                               rtol=1e-5)
+    # padding slots carry zero probability
+    np.testing.assert_allclose(out[0, 0, 3:, 0], 0.0)
+
+
+def test_deep_nesting_rejected_loudly():
+    import pytest
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.program import Program, program_guard
+
+    with pytest.raises(ValueError, match="level-1 and level-2"):
+        fluid.create_lod_tensor([[1.0]], [[1], [1], [1]], None)
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        with pytest.raises(NotImplementedError, match="lod_level=3"):
+            fluid.layers.data("x", [1], lod_level=3)

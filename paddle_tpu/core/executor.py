@@ -621,7 +621,10 @@ class Executor:
                 continue
             _, sub, seg_fetches, reads = seg
             sub_feed = {n: v for n, v in feed.items() if n in reads}
-            vals = self.run(sub, feed=sub_feed, fetch_list=seg_fetches,
+            # keyword form: ParallelExecutor.run's positional signature
+            # differs (reference parity), but both accept program=/scope=
+            vals = self.run(program=sub, feed=sub_feed,
+                            fetch_list=seg_fetches,
                             scope=scope, return_numpy=False)
             for n, v in zip(seg_fetches, vals):
                 fetched[n] = v
@@ -636,7 +639,8 @@ class Executor:
                     f"fetch target {n!r} was not produced by any program "
                     f"segment and is not in the scope")
             if return_numpy and not isinstance(v, SelectedRows):
-                v = np.asarray(v)
+                v = self._fetch_to_numpy(v)  # PE: process_allgather of
+                # non-addressable multi-host shards; plain Executor: asarray
             out.append(v)
         return out
 

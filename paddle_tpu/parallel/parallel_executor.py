@@ -108,12 +108,24 @@ class ParallelExecutor(Executor):
 
     # -- public API (reference parallel_executor.py:169 signature) ---------
     def run(self, fetch_list=None, feed=None, feed_dict=None,
-            return_numpy: bool = True, **kwargs):
+            return_numpy: bool = True, program=None, scope=None, **kwargs):
+        # ``program``/``scope`` kwargs: Executor._run_segmented (host-op
+        # programs — send/recv/pserver IO) re-enters run() per device
+        # segment, so the trainer-mesh + remote-pserver topology runs
+        # each compute segment over THIS executor's mesh
         feed = feed if feed is not None else (feed_dict or {})
-        feed, true_batch = self._maybe_pad_partial_batch(feed)
+        if program is None:
+            # padding policy belongs to the CONFIGURED program; segmented
+            # re-entries (program=sub) receive already-padded feeds and a
+            # foreign program must not inherit this one's batch policy
+            feed, true_batch = self._maybe_pad_partial_batch(feed)
+        else:
+            true_batch = None
         outs = super().run(
-            program=self._program, feed=feed, fetch_list=fetch_list,
-            scope=self._scope, return_numpy=return_numpy)
+            program=program if program is not None else self._program,
+            feed=feed, fetch_list=fetch_list,
+            scope=scope if scope is not None else self._scope,
+            return_numpy=return_numpy)
         if true_batch is not None:
             # Slice off padding rows only from batch-aligned fetches: a
             # var whose program-declared leading dim is symbolic (-1 =

@@ -114,3 +114,40 @@ def retry_flaky(times=2):
             raise last
         return wrapper
     return deco
+
+
+def build_tp(lr=0.1):
+    """Named-param MLP for the multihost x tensor-parallel test: fc1
+    column-parallel, fc2 row-parallel over the ``mp`` mesh axis
+    (the Megatron layout transformer.tp_sharding_rules uses)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 16, act="tanh",
+                            param_attr=fluid.ParamAttr(name="mh.fc1.w"))
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="mh.fc2.w"))
+        diff = fluid.layers.elementwise_sub(pred, y)
+        loss = fluid.layers.mean(fluid.layers.square(diff))
+        fluid.optimizer.SGD(lr).minimize(loss)
+    return prog, startup, loss
+
+
+TP_RULES = [(r"mh\.fc1\.w", (None, "mp")),
+            (r"mh\.fc2\.w", ("mp", None))]
+
+
+def run_local_tp(n_steps):
+    from paddle_tpu.core.executor import Executor, Scope
+
+    prog, startup, loss = build_tp()
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    losses = []
+    for x, y in batches(n_steps):
+        (lv,) = exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss],
+                        scope=scope, sync=True)
+        losses.append(float(lv))
+    return losses, param_values(prog, scope)

@@ -38,12 +38,26 @@ def main():
 
     tp = t.get_trainer_program()
     exe.run(startup, scope=scope)
+    runner = exe
+    if os.environ.get("DIST_TRAINER_MESH") == "1":
+        # trainer-mesh + remote-pserver topology (the kube_gen_job.py
+        # deployment): each trainer runs its compute segments over a
+        # LOCAL device mesh (dp over the virtual CPU devices) while the
+        # send/recv host ops sync grads with the remote pservers
+        import jax
+        runner = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=tp, scope=scope,
+            places=jax.devices())
     n_steps = int(os.environ.get("DIST_STEPS", "5"))
     bs_half = 4
     for x, y in batches(n_steps):
         half = slice(trainer_id * bs_half, (trainer_id + 1) * bs_half)
-        exe.run(tp, feed={"x": x[half], "y": y[half]}, fetch_list=[loss],
-                scope=scope)
+        if runner is exe:
+            exe.run(tp, feed={"x": x[half], "y": y[half]},
+                    fetch_list=[loss], scope=scope)
+        else:
+            runner.run(feed={"x": x[half], "y": y[half]},
+                       fetch_list=[loss])
     out = os.environ.get("DIST_OUT")
     if out:
         np.savez(out, **param_values(prog, scope))

@@ -23,20 +23,34 @@ def main():
     tid, n = init_from_env()
     assert n == int(os.environ["PADDLE_TRAINERS_NUM"]), (tid, n)
 
-    from dist_model import batches, build, param_values
+    from dist_model import TP_RULES, batches, build, build_tp, param_values
 
-    prog, startup, loss = build()
-    scope = Scope()
-    Executor().run(startup, scope=scope)
+    mode = os.environ.get("MH_MODE", "dp")
+    if mode == "tp":
+        # multihost x tensor parallel: global dp=4 x mp=2 mesh across
+        # processes, fc weights Megatron-sharded over mp
+        from paddle_tpu.parallel import BuildStrategy
 
-    pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=prog,
-                                scope=scope)
-    assert pe.mesh.size == 8, pe.mesh  # global mesh spans both processes
+        prog, startup, loss = build_tp()
+        scope = Scope()
+        Executor().run(startup, scope=scope)
+        bs = BuildStrategy(mesh_shape={"dp": 4, "mp": 2},
+                           sharding_rules=TP_RULES)
+        pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=prog,
+                                    build_strategy=bs, scope=scope)
+    else:
+        prog, startup, loss = build()
+        scope = Scope()
+        Executor().run(startup, scope=scope)
+        pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=prog,
+                                    scope=scope)
+    assert pe.mesh.size == 8, pe.mesh  # global mesh spans all processes
 
     losses = []
+    # each process contributes its slice of the 8-row global batch
     for x, y in batches(int(os.environ.get("DIST_STEPS", "5"))):
-        half = slice(tid * 4, (tid + 1) * 4)  # this trainer's batch shard
-        (lv,) = pe.run(feed={"x": x[half], "y": y[half]}, fetch_list=[loss])
+        sl = slice(tid * (8 // n), (tid + 1) * (8 // n))
+        (lv,) = pe.run(feed={"x": x[sl], "y": y[sl]}, fetch_list=[loss])
         losses.append(float(lv))
 
     out = os.environ.get("DIST_OUT")

@@ -138,9 +138,17 @@ def test_async_pserver_trains():
 
 @pytest.mark.slow
 @retry_flaky()
-def test_dist_subprocess_matches_local():
+@pytest.mark.parametrize("trainer_mesh", [False, True],
+                         ids=["plain", "mesh_trainers"])
+@retry_flaky()
+def test_dist_subprocess_matches_local(trainer_mesh):
     """The test_dist_base.py pattern: 2 pservers + 2 trainers as real
-    localhost processes; trainer params must match the local run."""
+    localhost processes; trainer params must match the local run.
+
+    ``mesh_trainers``: the kube_gen_job.py deployment shape — each
+    trainer runs its compute segments over a LOCAL 4-device dp mesh
+    (ParallelExecutor) while send/recv host ops sync grads with the
+    remote pservers (trainer-mesh + remote-pserver topology)."""
     endpoints = [f"127.0.0.1:{p}" for p in free_ports(2)]
     here = os.path.dirname(os.path.abspath(__file__))
     env_base = {
@@ -153,6 +161,9 @@ def test_dist_subprocess_matches_local():
             [os.path.dirname(here), here,
              os.environ.get("PYTHONPATH", "")]),
     }
+    if trainer_mesh:
+        env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env_base["DIST_TRAINER_MESH"] = "1"
     with tempfile.TemporaryDirectory() as tmp:
         procs = []
         for i, ep in enumerate(endpoints):

@@ -203,11 +203,18 @@ class ParallelExecutor(Executor):
         for i, op in enumerate(blk.ops):
             if op.type == "fill_constant" and loss_grad in op.output_arg_names() \
                     and (op.attr(OP_ROLE_ATTR, 0) & OpRole.Loss):
+                if op.attr("@loss_seed_scaled@", False):
+                    # already rewritten: segmented host-op execution clones
+                    # sub-programs from the PREPARED program and re-enters
+                    # run(); without this idempotence guard kOne would
+                    # scale the seed dp^2 times
+                    break
                 if gs == GradientScaleStrategy.kOne:
                     # reference kOne: per-device seeds of 1 summed over the
                     # world → seed scaled by dp degree here
                     op.set_attr("value",
                                 float(op.attr("value", 1.0)) * self.mesh.shape[self._dp_axis])
+                    op.set_attr("@loss_seed_scaled@", True)
                 elif gs == GradientScaleStrategy.kCustomized:
                     if loss_grad not in feed:
                         raise RuntimeError(

@@ -42,10 +42,13 @@ def param_values(prog, scope):
     return {n: np.asarray(scope.find_var(n)) for n in names}
 
 
-def run_local(n_steps, optimizer="sgd", decay=False):
+def run_local(n_steps, optimizer="sgd", decay=False, build_fn=None):
     from paddle_tpu.core.executor import Executor, Scope
 
-    prog, startup, loss = build(optimizer=optimizer, decay=decay)
+    if build_fn is None:
+        prog, startup, loss = build(optimizer=optimizer, decay=decay)
+    else:
+        prog, startup, loss = build_fn()
     scope = Scope()
     exe = Executor()
     exe.run(startup, scope=scope)
@@ -139,15 +142,4 @@ TP_RULES = [(r"mh\.fc1\.w", (None, "mp")),
 
 
 def run_local_tp(n_steps):
-    from paddle_tpu.core.executor import Executor, Scope
-
-    prog, startup, loss = build_tp()
-    scope = Scope()
-    exe = Executor()
-    exe.run(startup, scope=scope)
-    losses = []
-    for x, y in batches(n_steps):
-        (lv,) = exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss],
-                        scope=scope, sync=True)
-        losses.append(float(lv))
-    return losses, param_values(prog, scope)
+    return run_local(n_steps, build_fn=build_tp)

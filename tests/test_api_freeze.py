@@ -1,0 +1,33 @@
+"""Public-API freeze gate (reference tools/diff_api.py +
+print_signatures.py capability): the live surface must match
+tools/api_spec.txt; intentional changes regenerate the spec with
+``python tools/print_signatures.py > tools/api_spec.txt``."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_public_api_matches_frozen_spec():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import print_signatures
+        got = sorted(set(print_signatures.iter_api()))
+    finally:
+        sys.path.pop(0)
+    spec = open(os.path.join(REPO, "tools", "api_spec.txt")).read()
+    want = spec.splitlines()
+    added = sorted(set(got) - set(want))
+    removed = sorted(set(want) - set(got))
+    assert not added and not removed, (
+        f"public API drift — {len(added)} added, {len(removed)} "
+        f"removed/changed.\nAdded: {added[:10]}\nRemoved: {removed[:10]}\n"
+        "If intentional: python tools/print_signatures.py > "
+        "tools/api_spec.txt")
+
+
+def test_spec_is_nontrivial():
+    spec = open(os.path.join(REPO, "tools", "api_spec.txt")).read()
+    lines = [l for l in spec.splitlines() if l.strip()]
+    # the layer DSL alone is ~110 functions; a truncated spec must fail
+    assert len(lines) > 400, f"suspiciously small api spec: {len(lines)}"

@@ -207,3 +207,44 @@ def test_partial_batch_inference_pads_to_dp():
     assert res.shape == (13, 3)
     np.testing.assert_allclose(np.asarray(res), np.asarray(ref),
                                rtol=2e-5, atol=1e-6)
+
+
+def test_kone_seed_scaling_is_idempotent():
+    """Segmented host-op execution re-prepares cloned sub-programs; the
+    kOne loss-grad seed must scale by dp exactly once, not dp^2
+    (regression: the @loss_seed_scaled@ idempotence guard)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.backward import grad_var_name
+    from paddle_tpu.core.executor import Scope
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.parallel import BuildStrategy, GradientScaleStrategy
+    from paddle_tpu.parallel.parallel_executor import ParallelExecutor
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = Scope()
+    pe = ParallelExecutor(
+        loss_name=loss.name, main_program=prog, scope=scope,
+        build_strategy=BuildStrategy(
+            gradient_scale_strategy=GradientScaleStrategy.kOne))
+    dp = pe.mesh.shape["dp"]
+
+    def seed_value(p):
+        lg = grad_var_name(loss.name)
+        for op in p.global_block.ops:
+            if op.type == "fill_constant" and lg in op.output_arg_names():
+                return float(op.attr("value", 1.0))
+        raise AssertionError("no loss-grad seed op")
+
+    once = pe._prepare_program(prog, {})
+    assert seed_value(once) == dp * seed_value(prog)
+    # re-preparing a CLONE of the prepared program (what _run_segmented
+    # does) must not scale again
+    again = pe._prepare_program(once.clone(), {})
+    assert seed_value(again) == dp * seed_value(prog)

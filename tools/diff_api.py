@@ -1,0 +1,42 @@
+"""Compare the live public API against the frozen spec.
+
+Reference role: ``tools/diff_api.py`` — CI fails when the public surface
+drifts without the spec being updated on purpose.
+
+Usage: python tools/diff_api.py [spec_path]
+Exit 0 when identical; exit 1 with a readable diff otherwise.  To accept
+an intentional change: python tools/print_signatures.py > tools/api_spec.txt
+"""
+from __future__ import annotations
+
+import difflib
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    spec_path = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(HERE, "api_spec.txt")
+    sys.path.insert(0, os.path.dirname(HERE))  # repo root: paddle_tpu
+    sys.path.insert(0, HERE)                   # tools/: print_signatures
+    from print_signatures import iter_api  # noqa: E402
+
+    want = open(spec_path).read().splitlines()
+    got = sorted(set(iter_api()))
+    if want == got:
+        print("API surface matches the frozen spec "
+              f"({len(got)} records)")
+        return 0
+    diff = list(difflib.unified_diff(want, got, "api_spec.txt", "live API",
+                                     lineterm=""))
+    print("\n".join(diff[:200]))
+    print(f"\nAPI drift: {sum(1 for l in diff if l.startswith('+') and not l.startswith('+++'))} added, "
+          f"{sum(1 for l in diff if l.startswith('-') and not l.startswith('---'))} removed/changed.")
+    print("If intentional: python tools/print_signatures.py > tools/api_spec.txt")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

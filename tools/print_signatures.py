@@ -1,0 +1,87 @@
+"""Dump the public API surface of paddle_tpu as stable one-line records.
+
+Reference role: ``tools/print_signatures.py`` (clean-room — same gate
+capability, fresh implementation): every public function/class signature
+prints as ``<qualified name> (args..., defaults...)`` so a checked-in
+spec (``tools/api_spec.txt``) can freeze the surface and
+``tools/diff_api.py`` / ``tests/test_api_freeze.py`` can fail CI on
+accidental drift.
+
+Usage: python tools/print_signatures.py [> tools/api_spec.txt]
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import sys
+
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.layers.learning_rate_scheduler",
+    "paddle_tpu.layers.detection",
+    "paddle_tpu.layers.metric_op",
+    "paddle_tpu.nets",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.metrics",
+    "paddle_tpu.io",
+    "paddle_tpu.profiler",
+    "paddle_tpu.lod_tensor",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.data_feeder",
+    "paddle_tpu.param_attr",
+]
+
+
+def _sig(obj) -> str:
+    try:
+        sig = inspect.signature(obj)
+    except (TypeError, ValueError):
+        return "(signature unavailable)"
+    parts = []
+    for p in sig.parameters.values():
+        if p.default is inspect.Parameter.empty:
+            parts.append(p.name)
+        else:
+            parts.append(f"{p.name}={p.default!r}")
+    return "(" + ", ".join(parts) + ")"
+
+
+def iter_api():
+    for modname in MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(names):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            if inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                yield f"{modname}.{name}.__init__ {_sig(obj.__init__)}"
+                for m_name, m in sorted(vars(obj).items()):
+                    if m_name.startswith("_"):
+                        continue
+                    if callable(m):
+                        yield f"{modname}.{name}.{m_name} {_sig(m)}"
+            elif callable(obj):
+                yield f"{modname}.{name} {_sig(obj)}"
+
+
+def main():
+    for line in sorted(set(iter_api())):
+        print(line)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    main()

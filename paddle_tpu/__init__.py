@@ -22,12 +22,16 @@ from __future__ import annotations
 
 # register all op lowerings first
 from . import ops  # noqa: F401
+from . import average  # noqa: F401
 
 from . import clip  # noqa: F401
 from . import data  # noqa: F401
 from . import initializer  # noqa: F401
 from . import contrib  # noqa: F401
 from . import debugger  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import net_drawer  # noqa: F401
+from .core import backward  # noqa: F401
 from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import metrics  # noqa: F401

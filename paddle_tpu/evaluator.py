@@ -1,0 +1,220 @@
+"""fluid.evaluator — program-state evaluators
+(reference python/paddle/fluid/evaluator.py:44; deprecated there in
+favor of fluid.metrics but still public API, so kept for parity).
+
+An Evaluator owns persistable state vars accumulated by ops it appends
+to the MAIN program (the executor writes persistable outputs back to the
+scope — the same mechanism optimizer ops use), a ``reset`` program that
+zero-fills them, and an ``eval`` program that reads the states and
+computes the final metric.  State vars get zero initializers in the
+startup program too, so running startup is enough to start accumulating.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from . import layers
+from .core import unique_name
+from .core.program import Program, program_guard
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+def _clone_var(block, var):
+    return block.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                            persistable=True)
+
+
+class Evaluator:
+    """Base: state creation + reset program (evaluator.py:44)."""
+
+    def __init__(self, name, **kwargs):
+        warnings.warn(
+            f"The {self.__class__.__name__} is deprecated, please use "
+            f"fluid.metrics.{self.__class__.__name__} instead.", Warning)
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        """Zero the accumulated states (start of an epoch)."""
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(main_program=reset_program):
+            for var in self.states:
+                g_var = _clone_var(reset_program.global_block, var)
+                layers.fill_constant(shape=g_var.shape, value=0.0,
+                                     dtype=g_var.dtype, out=g_var)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.create_global_variable(
+            name=unique_name.generate(f"{self.helper.name}_{suffix}"),
+            persistable=True, dtype=dtype, shape=tuple(shape))
+        self.helper.set_variable_initializer(state, ConstantInitializer(0.0))
+        self.states.append(state)
+        return state
+
+    def _accumulate(self, state, batch_value):
+        """state += batch_value, appended to the main program (the
+        executor's persistable-write mechanism carries it across runs)."""
+        value = batch_value
+        if tuple(value.shape or ()) != tuple(state.shape or ()):
+            value = layers.reshape(value, list(state.shape))
+        if value.dtype != state.dtype:
+            value = layers.cast(value, state.dtype)
+        self.helper.append_op("elementwise_add",
+                              {"X": [state], "Y": [value]},
+                              {"Out": [state]}, {})
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulate chunk_eval counters; eval() -> (precision, recall, f1)
+    over the whole pass (evaluator.py:126)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+         num_correct_chunks) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self.num_infer_chunks = self._create_state("num_infer", "int64", (1,))
+        self.num_label_chunks = self._create_state("num_label", "int64", (1,))
+        self.num_correct_chunks = self._create_state(
+            "num_correct", "int64", (1,))
+        self._accumulate(self.num_infer_chunks, num_infer_chunks)
+        self._accumulate(self.num_label_chunks, num_label_chunks)
+        self._accumulate(self.num_correct_chunks, num_correct_chunks)
+        self.metrics = [precision, recall, f1_score]
+
+    def eval(self, executor, eval_program=None):
+        from .core.executor import global_scope
+
+        scope = global_scope()
+
+        def _scalar(v):
+            return float(np.asarray(scope.find_var(v.name)).ravel()[0])
+
+        infer = _scalar(self.num_infer_chunks)
+        label = _scalar(self.num_label_chunks)
+        correct = _scalar(self.num_correct_chunks)
+        precision = correct / infer if infer else 0.0
+        recall = correct / label if label else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if correct else 0.0)
+        return np.float32(precision), np.float32(recall), np.float32(f1)
+
+
+class EditDistance(Evaluator):
+    """Accumulate edit distances; eval() -> (avg_distance,
+    avg_instance_error) over the whole pass (evaluator.py:217)."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__("edit_distance")
+        distances, seq_num = layers.edit_distance(input=input, label=label)
+        self.total_distance = self._create_state(
+            "total_distance", "float32", (1,))
+        self.seq_num = self._create_state("seq_num", "int64", (1,))
+        self.instance_error = self._create_state(
+            "instance_error", "int64", (1,))
+        self._accumulate(self.total_distance,
+                         layers.reduce_sum(distances))
+        self._accumulate(self.seq_num, seq_num)
+        wrong = layers.reduce_sum(
+            layers.cast(layers.less_than(
+                layers.fill_constant((1,), "float32", 0.0), distances),
+                "int64"))
+        self._accumulate(self.instance_error, wrong)
+        self.metrics = [distances]
+
+    def eval(self, executor, eval_program=None):
+        from .core.executor import global_scope
+
+        scope = global_scope()
+
+        def _scalar(v):
+            return float(np.asarray(scope.find_var(v.name)).ravel()[0])
+
+        total = _scalar(self.total_distance)
+        n = _scalar(self.seq_num)
+        err = _scalar(self.instance_error)
+        if n == 0:
+            raise ValueError("no sequences accumulated in EditDistance")
+        return np.float32(total / n), np.float32(err / n)
+
+
+class DetectionMAP(Evaluator):
+    """Accumulative mean average precision: the detection_map op's
+    PosCount/TruePos/FalsePos state slots carry per-class score-bin
+    counts across batches (evaluator.py:298; detection_map_op.cc
+    accumulative mode)."""
+
+    BINS = 1000
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__("map_eval")
+        if class_num is None:
+            raise ValueError("DetectionMAP requires class_num")
+        # reference packs gt as (label, [difficult,] box); our in-graph op
+        # takes the padded [B, Mg, 6] = (label, x1, y1, x2, y2, difficult)
+        if gt_difficult is not None:
+            label6 = layers.concat([gt_label, gt_box, gt_difficult], axis=-1)
+        else:
+            zeros = layers.fill_constant_batch_size_like(
+                gt_label, list(gt_label.shape), "float32", 0.0)
+            label6 = layers.concat([gt_label, gt_box, zeros], axis=-1)
+        self.pos_count = self._create_state(
+            "pos_count", "float32", (class_num,))
+        self.true_pos = self._create_state(
+            "true_pos", "float32", (class_num, self.BINS))
+        self.false_pos = self._create_state(
+            "false_pos", "float32", (class_num, self.BINS))
+        # persistable: the executor's persistable-write mechanism is what
+        # makes the last MAP value readable from the scope in eval()
+        accum_map = self.helper.create_global_variable(
+            shape=(1,), dtype="float32", persistable=True,
+            name=unique_name.generate(f"{self.helper.name}_map"))
+        self.helper.set_variable_initializer(
+            accum_map, ConstantInitializer(0.0))
+        from .layers.nn import seq_len_var
+
+        ins = {"DetectRes": [input], "Label": [label6],
+               "PosCount": [self.pos_count], "TruePos": [self.true_pos],
+               "FalsePos": [self.false_pos]}
+        # lengths belong to the FED gt var; the derived concat output has
+        # no @LEN companion
+        sl = seq_len_var(gt_label)
+        if sl is not None:
+            ins["GtLen"] = [sl]
+        self.helper.append_op(
+            "detection_map", ins,
+            {"MAP": [accum_map], "AccumPosCount": [self.pos_count],
+             "AccumTruePos": [self.true_pos],
+             "AccumFalsePos": [self.false_pos]},
+            {"class_num": class_num, "background_label": background_label,
+             "overlap_threshold": overlap_threshold,
+             "evaluate_difficult": evaluate_difficult,
+             "ap_version": ap_version})
+        self.cur_map = accum_map
+        self.metrics = [accum_map]
+
+    def eval(self, executor, eval_program=None):
+        """The op's MAP output already reflects the accumulated states;
+        return the last computed value from the scope."""
+        from .core.executor import global_scope
+
+        v = global_scope().find_var(self.cur_map.name)
+        if v is None:
+            raise ValueError("DetectionMAP.eval before any batch ran")
+        return np.asarray(v)

@@ -1,0 +1,17 @@
+"""fluid.net_drawer — program graph drawing CLI shim
+(reference python/paddle/fluid/net_drawer.py: graphviz rendering of a
+serialized program; the rendering engine here is
+``debugger.draw_block_graphviz``)."""
+from __future__ import annotations
+
+from .debugger import draw_block_graphviz
+
+__all__ = ["draw_graph"]
+
+
+def draw_graph(startup_program, main_program, path="network.dot",
+               **kwargs):
+    """Emit a graphviz dot file for the main program's global block
+    (reference net_drawer.draw_graph CLI contract)."""
+    draw_block_graphviz(main_program.global_block, path=path, **kwargs)
+    return path

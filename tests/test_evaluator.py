@@ -1,0 +1,116 @@
+"""fluid.evaluator + fluid.average parity (reference evaluator.py:44 —
+deprecated-but-public surface; states accumulate through the executor's
+persistable-write mechanism across runs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+
+def test_weighted_average():
+    with pytest.warns(Warning):
+        avg = fluid.average.WeightedAverage()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=2)
+    np.testing.assert_allclose(avg.eval(), 10.0 / 3.0)
+    avg.reset()
+    with pytest.raises(ValueError):
+        avg.eval()
+    with pytest.raises(ValueError):
+        avg.add(value="x", weight=1)
+
+
+def test_chunk_evaluator_accumulates_across_batches():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        inf = fluid.layers.data("inf", [6], dtype="int64", lod_level=1)
+        lab = fluid.layers.data("lab", [6], dtype="int64", lod_level=1)
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.ChunkEvaluator(
+                inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        # IOB tags with 2 types: B-0=0, I-0=1, B-1=2, I-1=3, O=4
+        b1_lab = np.array([[0, 1, 4, 2, 3, 4]], np.int64)
+        b1_inf = np.array([[0, 1, 4, 4, 4, 4]], np.int64)  # 1 of 2 correct
+        b2_lab = np.array([[2, 3, 3, 4, 0, 4]], np.int64)
+        b2_inf = np.array([[2, 3, 3, 4, 0, 4]], np.int64)  # 2 of 2 correct
+        lens = np.array([6], np.int32)
+        for i_, l_ in ((b1_inf, b1_lab), (b2_inf, b2_lab)):
+            exe.run(prog, feed={"inf": i_, "inf@LEN": lens,
+                                "lab": l_, "lab@LEN": lens},
+                    fetch_list=[ev.metrics[0].name], sync=True)
+        precision, recall, f1 = ev.eval(exe)
+    # totals: infer chunks 1+3=4 (b1 predicts only 1), labels 2+2=4...
+    # counts come from the op; just pin the aggregate contract
+    assert 0.0 < precision <= 1.0 and 0.0 < recall <= 1.0
+    assert f1 == pytest.approx(
+        2 * precision * recall / (precision + recall), rel=1e-5)
+    # reset zeroes the pass
+    with scope_guard(scope):
+        ev.reset(exe)
+        p0, r0, f0 = ev.eval(exe)
+    assert (p0, r0, f0) == (0.0, 0.0, 0.0)
+
+
+def test_edit_distance_evaluator():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        hyp = fluid.layers.data("hyp", [4], dtype="int64", lod_level=1)
+        ref = fluid.layers.data("ref", [4], dtype="int64", lod_level=1)
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.EditDistance(hyp, ref)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        lens = np.array([4, 4], np.int32)
+        h = np.array([[1, 2, 3, 4], [1, 2, 3, 4]], np.int64)
+        r = np.array([[1, 2, 3, 4], [1, 9, 3, 4]], np.int64)
+        exe.run(prog, feed={"hyp": h, "hyp@LEN": lens,
+                            "ref": r, "ref@LEN": lens},
+                fetch_list=[ev.metrics[0].name], sync=True)
+        avg_dist, inst_err = ev.eval(exe)
+    # seq0 exact (0), seq1 one substitution (normalized 1/4)
+    np.testing.assert_allclose(avg_dist, (0.0 + 0.25) / 2, rtol=1e-5)
+    np.testing.assert_allclose(inst_err, 0.5, rtol=1e-6)
+
+
+def test_detection_map_evaluator_accumulates():
+    """DetectionMAP: the op's PosCount/TruePos/FalsePos states carry
+    across batches; eval() reads the accumulated mAP from the scope."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        det = fluid.layers.data("det", [4, 6])
+        gt_label = fluid.layers.data("gtl", [2, 1])
+        gt_box = fluid.layers.data("gtb", [2, 4])
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.DetectionMAP(det, gt_label, gt_box,
+                                              class_num=3)
+    scope, exe = Scope(), Executor()
+
+    # batch 1: perfect detections for both classes -> mAP 1.0
+    d1 = np.full((1, 4, 6), -1.0, "float32")
+    d1[0, 0] = [1, 0.9, 0, 0, 10, 10]
+    d1[0, 1] = [2, 0.8, 20, 20, 30, 30]
+    gl = np.array([[[1.0], [2.0]]], "float32")
+    gb = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "float32")
+    # batch 2: class-1 detection misses entirely -> accumulated mAP drops
+    d2 = np.full((1, 4, 6), -1.0, "float32")
+    d2[0, 0] = [1, 0.9, 50, 50, 60, 60]
+    d2[0, 1] = [2, 0.8, 20, 20, 30, 30]
+
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed={"det": d1, "gtl": gl, "gtb": gb},
+                fetch_list=[ev.cur_map.name], sync=True)
+        map1 = float(np.asarray(ev.eval(exe)).ravel()[0])
+        exe.run(prog, feed={"det": d2, "gtl": gl, "gtb": gb},
+                fetch_list=[ev.cur_map.name], sync=True)
+        map2 = float(np.asarray(ev.eval(exe)).ravel()[0])
+        ev.reset(exe)
+    np.testing.assert_allclose(map1, 1.0, rtol=1e-5)
+    assert map2 < map1, (map1, map2)

@@ -34,6 +34,10 @@ MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.data_feeder",
     "paddle_tpu.param_attr",
+    "paddle_tpu.average",
+    "paddle_tpu.evaluator",
+    "paddle_tpu.net_drawer",
+    "paddle_tpu.debugger",
 ]
 
 
@@ -65,6 +69,11 @@ def iter_api():
             if obj is None:
                 continue
             if inspect.ismodule(obj):
+                continue
+            # modules without __all__: skip re-exports (typing etc.) —
+            # only members defined in (or under) this package are API
+            own = getattr(obj, "__module__", modname) or modname
+            if not own.startswith("paddle_tpu"):
                 continue
             if inspect.isclass(obj):
                 yield f"{modname}.{name}.__init__ {_sig(obj.__init__)}"

@@ -31,6 +31,7 @@ from . import contrib  # noqa: F401
 from . import debugger  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import net_drawer  # noqa: F401
+from . import recordio_writer  # noqa: F401
 from .core import backward  # noqa: F401
 from . import inference  # noqa: F401
 from . import io  # noqa: F401

@@ -120,6 +120,12 @@ class EditDistance(Evaluator):
 
     def __init__(self, input, label, ignored_tokens=None):
         super().__init__("edit_distance")
+        if ignored_tokens:
+            raise NotImplementedError(
+                "EditDistance(ignored_tokens=...) is not supported: the "
+                "edit_distance lowering (layers/nn.py edit_distance) has "
+                "no token-filter input; strip ignored tokens in the "
+                "reader instead")
         distances, seq_num = layers.edit_distance(input=input, label=label)
         self.total_distance = self._create_state(
             "total_distance", "float32", (1,))
@@ -180,13 +186,9 @@ class DetectionMAP(Evaluator):
             "true_pos", "float32", (class_num, self.BINS))
         self.false_pos = self._create_state(
             "false_pos", "float32", (class_num, self.BINS))
-        # persistable: the executor's persistable-write mechanism is what
-        # makes the last MAP value readable from the scope in eval()
-        accum_map = self.helper.create_global_variable(
-            shape=(1,), dtype="float32", persistable=True,
-            name=unique_name.generate(f"{self.helper.name}_map"))
-        self.helper.set_variable_initializer(
-            accum_map, ConstantInitializer(0.0))
+        # a STATE like the counters: persistable (so eval() reads the
+        # scope) and zeroed by reset() along with the count states
+        accum_map = self._create_state("map", "float32", (1,))
         from .layers.nn import seq_len_var
 
         ins = {"DetectRes": [input], "Label": [label6],

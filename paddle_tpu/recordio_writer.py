@@ -1,0 +1,81 @@
+"""fluid.recordio_writer — convert Python readers to RecordIO files
+(reference python/paddle/fluid/recordio_writer.py:20; the storage engine
+is the native C RecordIO in ``native/paddle_tpu_native.cc``, format
+magic/CRC/compressor compatible with the reference's recordio/ spec).
+
+Each RECORD is one feed dict (a batch fed through the DataFeeder,
+including any ``@LEN`` sequence-length companions), so
+``data/recordio_utils.reader_creator`` round-trips what this writes.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .data.native import RecordIOWriter
+
+__all__ = ["convert_reader_to_recordio_file",
+           "convert_reader_to_recordio_files"]
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder,
+                                    compressor=1, max_num_records=1000,
+                                    feed_order=None):
+    """Feed every batch from ``reader_creator`` through ``feeder`` and
+    append it as one record; returns the number of records written.
+    ``compressor``: 0 = none, 1 = zlib (the snappy slot of the reference
+    enum; zlib is the compressor the native library ships)."""
+    if feed_order is None:
+        feed_order = [v.name for v in feeder.feed_vars]
+    counter = 0
+    w = RecordIOWriter(filename, compressor=compressor,
+                       max_chunk_records=max_num_records)
+    try:
+        for batch in reader_creator():
+            w.write(pickle.dumps(_record(feeder, batch, feed_order),
+                                 protocol=pickle.HIGHEST_PROTOCOL))
+            counter += 1
+    finally:
+        w.close()
+    return counter
+
+
+def _record(feeder, batch, feed_order):
+    """One record = the feed dict restricted to feed_order PLUS any
+    ``@LEN`` sequence-length companions the feeder produced — dropping
+    them would turn zero-padding into real tokens on read-back."""
+    fd = feeder.feed(batch)
+    keep = list(feed_order) + [n + "@LEN" for n in feed_order
+                               if n + "@LEN" in fd]
+    return {n: fd[n] for n in keep}
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, feeder, compressor=1,
+                                     max_num_records=1000, feed_order=None):
+    """Same as :func:`convert_reader_to_recordio_file` but splits the
+    stream into files of at most ``batch_per_file`` records each."""
+    if feed_order is None:
+        feed_order = [v.name for v in feeder.feed_vars]
+    f_name, f_ext = os.path.splitext(filename)
+    assert batch_per_file > 0
+    counter = 0
+    file_idx = 0
+    w = None
+    try:
+        for batch in reader_creator():
+            if w is None:
+                w = RecordIOWriter(f"{f_name}-{file_idx:05d}{f_ext}",
+                                   compressor=compressor,
+                                   max_chunk_records=max_num_records)
+                file_idx += 1
+            w.write(pickle.dumps(_record(feeder, batch, feed_order),
+                                 protocol=pickle.HIGHEST_PROTOCOL))
+            counter += 1
+            if counter % batch_per_file == 0:
+                w.close()
+                w = None
+    finally:
+        if w is not None:
+            w.close()
+    return counter

@@ -38,6 +38,7 @@ MODULES = [
     "paddle_tpu.evaluator",
     "paddle_tpu.net_drawer",
     "paddle_tpu.debugger",
+    "paddle_tpu.recordio_writer",
 ]
 
 
@@ -61,20 +62,21 @@ def iter_api():
             mod = importlib.import_module(modname)
         except ImportError:
             continue
-        names = getattr(mod, "__all__", None)
-        if names is None:
-            names = [n for n in dir(mod) if not n.startswith("_")]
+        declared = getattr(mod, "__all__", None)
+        names = declared if declared is not None else \
+            [n for n in dir(mod) if not n.startswith("_")]
         for name in sorted(names):
             obj = getattr(mod, name, None)
             if obj is None:
                 continue
             if inspect.ismodule(obj):
                 continue
-            # modules without __all__: skip re-exports (typing etc.) —
-            # only members defined in (or under) this package are API
-            own = getattr(obj, "__module__", modname) or modname
-            if not own.startswith("paddle_tpu"):
-                continue
+            if declared is None:
+                # dir() fallback only: skip re-exports (typing etc.) —
+                # an explicit __all__ may deliberately re-export
+                own = getattr(obj, "__module__", modname) or modname
+                if not own.startswith("paddle_tpu"):
+                    continue
             if inspect.isclass(obj):
                 yield f"{modname}.{name}.__init__ {_sig(obj.__init__)}"
                 for m_name, m in sorted(vars(obj).items()):

@@ -235,8 +235,7 @@ class LazyFetch(np.lib.mixins.NDArrayOperatorsMixin):
         return bool(self._val())
 
     def __format__(self, spec):
-        return format(self._val(), spec) if self.ndim == 0 else \
-            format(np.asarray(self._val()), spec)
+        return format(self._val(), spec)
 
     def __repr__(self):
         return repr(self._val())

@@ -31,8 +31,6 @@ ring home (see ``ring_attention``).
 from __future__ import annotations
 
 import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -127,11 +125,11 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _append_lane(x, col=None):
-    """Append one lane to the minor dim: ones when ``col`` is None."""
-    if col is None:
-        col = jnp.ones(x.shape[:-1] + (1,), x.dtype)
-    return jnp.concatenate([x, col.astype(x.dtype)], axis=-1)
+def _append_ones_lane(x):
+    """Append a ones lane to the minor dim (the fwd kernel's softmax
+    denominator rides it — see _flash_fwd_kernel)."""
+    return jnp.concatenate(
+        [x, jnp.ones(x.shape[:-1] + (1,), x.dtype)], axis=-1)
 
 
 def _tile_scores(q, k_ref, mask_ref, qi, kb, *, causal,
@@ -406,7 +404,7 @@ def _pallas_fwd(q, k, v, kv_mask, causal, sm_scale, dropout_rate=0.0,
     ones_lane = _lane_pack_ok(D, dropout_rate)
     D_v = D + 1 if ones_lane else D
     if ones_lane:
-        vf = _append_lane(vf)
+        vf = _append_ones_lane(vf)
 
     kv_map, mask_map = _fwd_maps(causal, has_mask, block_q, block_k, num_kb)
     kernel = functools.partial(

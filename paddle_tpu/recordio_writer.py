@@ -28,15 +28,12 @@ def convert_reader_to_recordio_file(filename, reader_creator, feeder,
     if feed_order is None:
         feed_order = [v.name for v in feeder.feed_vars]
     counter = 0
-    w = RecordIOWriter(filename, compressor=compressor,
-                       max_chunk_records=max_num_records)
-    try:
+    with RecordIOWriter(filename, compressor=compressor,
+                    max_chunk_records=max_num_records) as w:
         for batch in reader_creator():
             w.write(pickle.dumps(_record(feeder, batch, feed_order),
                                  protocol=pickle.HIGHEST_PROTOCOL))
             counter += 1
-    finally:
-        w.close()
     return counter
 
 

@@ -234,3 +234,32 @@ def test_deep_nesting_rejected_loudly():
     with program_guard(prog, startup), unique_name.guard():
         with pytest.raises(NotImplementedError, match="lod_level=3"):
             fluid.layers.data("x", [1], lod_level=3)
+
+
+def test_nested_sequence_expand_outer_level():
+    """sequence_expand with a NESTED y: x [B, D] expands along y's outer
+    (sentence) level to [B, S, D], carrying the outer lengths — the
+    ref_level=0 semantics of the reference's nested expand."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    words, outer, inner = _nested_corpus()
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        y = fluid.layers.data("y", [2], lod_level=2)
+        x = fluid.layers.data("x", [2])
+        ex = fluid.layers.sequence_expand(x, y)
+    lt = fluid.create_lod_tensor(words, [outer, inner], None)
+    xv = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(prog, feed={"y": lt, "x": xv},
+                       fetch_list=[ex.name], sync=True)
+    S = lt.data.shape[1]
+    assert out.shape == (2, S, 2)
+    np.testing.assert_allclose(out[0, 0], xv[0])
+    np.testing.assert_allclose(out[0, 1], xv[0])
+    np.testing.assert_allclose(out[1, 0], xv[1])

@@ -15,21 +15,25 @@ from dist_model import free_ports, run_local
 N_STEPS = 5
 
 
+def _assert_trainers_match(tmp, n_procs, local_losses, local_params):
+    """Every process observes the same global-batch losses and ends with
+    the same replicated params as the single-process run."""
+    for tid in range(n_procs):
+        data = np.load(os.path.join(tmp, f"trainer{tid}.npz"))
+        np.testing.assert_allclose(data["losses"], local_losses,
+                                   rtol=2e-4, atol=1e-5)
+        for name, want in local_params.items():
+            np.testing.assert_allclose(data[name], want, rtol=2e-4,
+                                       atol=2e-5,
+                                       err_msg=f"trainer {tid} {name}")
+
+
 @pytest.mark.slow
 def test_two_process_mesh_matches_local():
     with tempfile.TemporaryDirectory() as tmp:
         _launch_world(2, 4, "dp", tmp)
         local_losses, local_params = run_local(N_STEPS)
-        for tid in range(2):
-            data = np.load(os.path.join(tmp, f"trainer{tid}.npz"))
-            # every process observes the same global-batch losses …
-            np.testing.assert_allclose(data["losses"], local_losses,
-                                       rtol=2e-4, atol=1e-5)
-            # … and ends with the same replicated params
-            for name, want in local_params.items():
-                np.testing.assert_allclose(data[name], want, rtol=2e-4,
-                                           atol=2e-5,
-                                           err_msg=f"trainer {tid} {name}")
+        _assert_trainers_match(tmp, 2, local_losses, local_params)
 
 
 def _launch_world(n_procs, dev_per_proc, mode, tmp):
@@ -75,14 +79,7 @@ def test_four_process_mesh_matches_local():
     with tempfile.TemporaryDirectory() as tmp:
         _launch_world(4, 2, "dp", tmp)
         local_losses, local_params = run_local(N_STEPS)
-        for tid in range(4):
-            data = np.load(os.path.join(tmp, f"trainer{tid}.npz"))
-            np.testing.assert_allclose(data["losses"], local_losses,
-                                       rtol=2e-4, atol=1e-5)
-            for name, want in local_params.items():
-                np.testing.assert_allclose(data[name], want, rtol=2e-4,
-                                           atol=2e-5,
-                                           err_msg=f"trainer {tid} {name}")
+        _assert_trainers_match(tmp, 4, local_losses, local_params)
 
 
 @pytest.mark.slow
@@ -95,11 +92,4 @@ def test_multihost_tensor_parallel_matches_local():
     with tempfile.TemporaryDirectory() as tmp:
         _launch_world(2, 4, "tp", tmp)
         local_losses, local_params = run_local_tp(N_STEPS)
-        for tid in range(2):
-            data = np.load(os.path.join(tmp, f"trainer{tid}.npz"))
-            np.testing.assert_allclose(data["losses"], local_losses,
-                                       rtol=2e-4, atol=1e-5)
-            for name, want in local_params.items():
-                np.testing.assert_allclose(data[name], want, rtol=2e-4,
-                                           atol=2e-5,
-                                           err_msg=f"trainer {tid} {name}")
+        _assert_trainers_match(tmp, 2, local_losses, local_params)

@@ -303,3 +303,60 @@ def test_predictor_mode_lowers_training_false():
         (o,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
     # downgrade_in_infer test branch: deterministic x*(1-p), no mask draw
     np.testing.assert_allclose(np.asarray(o), xv * 0.5, rtol=1e-6)
+
+
+def test_predictor_clones_serve_concurrently(tmp_path):
+    """Clone-per-thread serving with async lazy fetches: N threads run
+    clones of one predictor concurrently; every thread's outputs must
+    match the single-threaded result (LazyFetch's class-global pending
+    list is shared across threads — this pins its thread-safety)."""
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.inference.predictor import (AnalysisConfig,
+                                                create_predictor)
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 11
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        h = fluid.layers.fc(x, 16, act="relu")
+        out = fluid.layers.fc(h, 4, act="softmax")
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                      main_program=prog)
+
+    pred = create_predictor(AnalysisConfig(str(tmp_path)))
+    rng = np.random.RandomState(0)
+    feeds = [rng.randn(4, 8).astype("float32") for _ in range(8)]
+    want = [np.asarray(pred.run({"x": f})[0]).copy() for f in feeds]
+
+    results = {}
+    errors = []
+
+    def serve(tid):
+        try:
+            clone = pred.clone()
+            got = []
+            for f in feeds:
+                got.append(np.asarray(clone.run({"x": f})[0]).copy())
+            results[tid] = got
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=serve, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert len(results) == 4
+    for tid, got in results.items():
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-6, atol=1e-6,
+                                       err_msg=f"thread {tid}")

@@ -1,0 +1,66 @@
+"""tools/fluid_benchmark.py — the reference's unified benchmark driver
+CLI (benchmark/fluid/fluid_benchmark.py role): local, --parallel, and
+pserver update methods end to end."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dist_model import free_ports, retry_flaky
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "fluid_benchmark.py")
+
+
+def _env(extra=None):
+    return {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": os.pathsep.join(
+            [REPO, os.environ.get("PYTHONPATH", "")]),
+        **(extra or {}),
+    }
+
+
+def _args(*extra):
+    return [sys.executable, TOOL, "--model", "mnist", "--device", "CPU",
+            "--batch_size", "8", "--iterations", "4",
+            "--skip_batch_num", "1", *extra]
+
+
+@pytest.mark.parametrize("mode", ["local", "parallel"])
+def test_benchmark_driver_local_modes(mode):
+    args = _args() if mode == "local" else _args("--parallel")
+    r = subprocess.run(args, env=_env(), capture_output=True, text=True,
+                       timeout=240)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "Speed:" in r.stdout and "examples/sec" in r.stdout
+
+
+@pytest.mark.slow
+@retry_flaky()
+def test_benchmark_driver_pserver_mode():
+    (port,) = free_ports(1)
+    ep = f"127.0.0.1:{port}"
+    base = {"PADDLE_PSERVER_ENDPOINTS": ep, "PADDLE_TRAINERS_NUM": "1"}
+    args = _args("--update_method", "pserver")
+    ps = subprocess.Popen(
+        args, env=_env({**base, "PADDLE_TRAINING_ROLE": "PSERVER",
+                        "PADDLE_CURRENT_ENDPOINT": ep}),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    tr = subprocess.Popen(
+        args, env=_env({**base, "PADDLE_TRAINING_ROLE": "TRAINER",
+                        "PADDLE_TRAINER_ID": "0"}),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        to, te = tr.communicate(timeout=240)
+        po, pe = ps.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        tr.kill()
+        ps.kill()
+        raise
+    assert tr.returncode == 0, te.decode()[-800:]
+    assert ps.returncode == 0, pe.decode()[-800:]
+    assert "Speed:" in to.decode()

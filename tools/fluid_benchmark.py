@@ -1,0 +1,257 @@
+"""Unified training benchmark driver (reference
+benchmark/fluid/fluid_benchmark.py:310 + args.py — same CLI contract,
+clean-room implementation over the paddle_tpu stack).
+
+    python tools/fluid_benchmark.py --model mnist --batch_size 64 \\
+        --iterations 20 [--parallel] [--update_method local|pserver|nccl2]
+
+- ``local``: single Executor, or ParallelExecutor over all local devices
+  with ``--parallel``.
+- ``pserver``: the DistributeTranspiler path; role/topology from the
+  PADDLE_* env vars (PADDLE_TRAINING_ROLE, PADDLE_PSERVER_ENDPOINTS,
+  PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM) — the reference
+  ``dist_transpile:63`` contract.
+- ``nccl2``: every process joins one global mesh via jax.distributed
+  (PADDLE_TRAINER_ENDPOINTS), ParallelExecutor runs the same program
+  everywhere — the reference ``append_nccl2_prepare:31`` role.
+
+Feeds are synthetic at the requested batch size (the reference's
+--use_fake_data mode); throughput prints per iteration window with the
+first ``--skip_batch_num`` iterations excluded, matching the reference's
+reporting.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mnist(args):
+    from paddle_tpu.models import mnist
+
+    feeds, loss, _ = mnist.build(lr=args.learning_rate)
+    rng = np.random.RandomState(7)
+
+    def feed(i):
+        return {"pixel": rng.randn(args.batch_size, 1, 28, 28)
+                .astype("float32"),
+                "label": rng.randint(0, 10, (args.batch_size, 1))
+                .astype("int64")}
+    return feed, loss
+
+
+def _resnet(args):
+    from paddle_tpu.models import resnet
+
+    layout = "NHWC" if args.data_format == "NHWC" else "NCHW"
+    feeds, loss, _ = resnet.build(dtype="float32", lr=args.learning_rate,
+                                  layout=layout)
+    rng = np.random.RandomState(7)
+
+    def feed(i):
+        return {"data": rng.randn(args.batch_size, 3, 224, 224)
+                .astype("float32"),
+                "label": rng.randint(0, 1000, (args.batch_size, 1))
+                .astype("int64")}
+    return feed, loss
+
+
+def _vgg(args):
+    from paddle_tpu.models import vgg
+
+    feeds, loss, _ = vgg.build(lr=args.learning_rate)
+    rng = np.random.RandomState(7)
+
+    def feed(i):
+        return {"data": rng.randn(args.batch_size, 3, 32, 32)
+                .astype("float32"),
+                "label": rng.randint(0, 10, (args.batch_size, 1))
+                .astype("int64")}
+    return feed, loss
+
+
+def _stacked_lstm(args):
+    from paddle_tpu.models import stacked_lstm
+
+    feeds, loss, _ = stacked_lstm.build(lr=args.learning_rate)
+    rng = np.random.RandomState(7)
+    T = 128
+
+    def feed(i):
+        return {"words": rng.randint(0, 30000, (args.batch_size, T, 1))
+                .astype("int64"),
+                "words@LEN": np.full((args.batch_size,), T, "int64"),
+                "label": rng.randint(0, 2, (args.batch_size, 1))
+                .astype("int64")}
+    return feed, loss
+
+
+def _transformer(args):
+    from paddle_tpu.models import transformer
+
+    T, V = 256, 32000
+    feeds, loss, _ = transformer.build(src_vocab=V, tgt_vocab=V, max_len=T,
+                                       dropout=0.1)
+    rng = np.random.RandomState(7)
+    mask = np.ones((args.batch_size, T), "float32")
+
+    def feed(i):
+        ids = lambda: rng.randint(0, V, (args.batch_size, T)).astype("int64")
+        return {"src_ids": ids(), "tgt_ids": ids(), "lbl_ids": ids(),
+                "src_mask": mask, "tgt_mask": mask}
+    return feed, loss
+
+
+def _deepfm(args):
+    from paddle_tpu.models import deepfm
+
+    rows = int(1e6)
+    feeds, loss, _ = deepfm.build(sparse_dim=rows, lr=args.learning_rate)
+    rng = np.random.RandomState(7)
+
+    def feed(i):
+        return {"dense": rng.randn(args.batch_size, 13).astype("float32"),
+                "sparse": rng.randint(0, rows, (args.batch_size, 26))
+                .astype("int64"),
+                "label": rng.randint(0, 2, (args.batch_size, 1))
+                .astype("float32")}
+    return feed, loss
+
+
+BENCHMARK_MODELS = {
+    "mnist": _mnist,
+    "resnet": _resnet,
+    "vgg": _vgg,
+    "stacked_lstm": _stacked_lstm,
+    "transformer": _transformer,
+    "deepfm": _deepfm,
+}
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("fluid_benchmark")
+    p.add_argument("--model", choices=sorted(BENCHMARK_MODELS), default="resnet")
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--learning_rate", type=float, default=0.001)
+    p.add_argument("--skip_batch_num", type=int, default=5)
+    p.add_argument("--iterations", type=int, default=80)
+    p.add_argument("--pass_num", type=int, default=1)
+    p.add_argument("--data_format", choices=["NCHW", "NHWC"], default="NCHW")
+    p.add_argument("--device", choices=["CPU", "GPU", "TPU"], default="TPU",
+                   help="GPU accepted for reference-CLI parity; JAX owns "
+                        "actual placement")
+    p.add_argument("--parallel", action="store_true",
+                   help="ParallelExecutor over all local devices")
+    p.add_argument("--update_method", default="local",
+                   choices=["local", "pserver", "nccl2"])
+    p.add_argument("--no_random", action="store_true")
+    p.add_argument("--async_mode", action="store_true",
+                   help="pserver update_method only: async (no batch "
+                        "barriers) instead of the default sync mode")
+    a = p.parse_args(argv)
+    if a.iterations < 1:
+        p.error("--iterations must be >= 1")
+    a.sync_mode = not a.async_mode
+    return a
+
+
+def dist_transpile(trainer_id, args, train_prog, startup_prog):
+    """reference fluid_benchmark.py dist_transpile:63 — env-driven."""
+    import paddle_tpu as fluid
+
+    pserver_eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=trainer_id, program=train_prog,
+                pservers=pserver_eps, trainers=trainers,
+                sync_mode=args.sync_mode, startup_program=startup_prog)
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER")
+    if role == "PSERVER":
+        ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+        return t.get_pserver_program(ep), t.get_startup_program(ep), role
+    return t.get_trainer_program(), startup_prog, role
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    for k, v in sorted(vars(args).items()):
+        print(f"{k}: {v}")
+
+    import jax
+
+    if args.device == "CPU":
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.core import unique_name
+
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if args.update_method == "nccl2":
+        from paddle_tpu.parallel import init_from_env
+
+        trainer_id, _ = init_from_env()
+
+    train_prog, startup_prog = Program(), Program()
+    if args.no_random:
+        train_prog.random_seed = 1
+    with program_guard(train_prog, startup_prog), unique_name.guard():
+        feed_fn, loss = BENCHMARK_MODELS[args.model](args)
+
+    scope = Scope()
+    with scope_guard(scope):
+        if args.update_method == "pserver":
+            prog, startup, role = dist_transpile(trainer_id, args,
+                                                 train_prog, startup_prog)
+            exe = Executor()
+            exe.run(startup)
+            if role == "PSERVER":
+                exe.run(prog)          # serves until trainers complete
+                return
+            run = lambda fd: exe.run(prog, feed=fd, fetch_list=[loss])
+        elif args.parallel or args.update_method == "nccl2":
+            exe = Executor()
+            exe.run(startup_prog)
+            pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                        main_program=train_prog, scope=scope)
+            run = lambda fd: pe.run(feed=fd, fetch_list=[loss])
+        else:
+            exe = Executor()
+            exe.run(startup_prog)
+            run = lambda fd: exe.run(train_prog, feed=fd, fetch_list=[loss])
+
+        # the timing window must open at least once even when skip >=
+        # iterations (then the last iteration is the measured one)
+        skip = min(args.skip_batch_num, args.iterations - 1)
+        for pass_id in range(args.pass_num):
+            last = None
+            t0 = None
+            for i in range(args.iterations):
+                if i == skip:
+                    if last is not None:
+                        float(np.asarray(last))  # sync before the window
+                    t0 = time.perf_counter()
+                (last,) = run(feed_fn(i))
+            loss_v = float(np.asarray(last))     # syncs the async queue
+            counted = args.iterations - skip
+            dt = time.perf_counter() - t0
+            eps = args.batch_size * counted / dt if dt > 0 else float("nan")
+            print(f"Pass: {pass_id}, Loss: {loss_v:.6f}, "
+                  f"Speed: {eps:.2f} examples/sec")
+        if args.update_method == "pserver":
+            from paddle_tpu.distributed import notify_complete
+
+            notify_complete(
+                os.environ["PADDLE_PSERVER_ENDPOINTS"].split(","),
+                trainer_id=trainer_id)
+
+
+if __name__ == "__main__":
+    main()

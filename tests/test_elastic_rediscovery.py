@@ -14,7 +14,7 @@ import time
 import numpy as np
 import pytest
 
-from dist_model import free_ports
+from dist_model import free_ports, retry_flaky
 from paddle_tpu.distributed.registry import (RegistryServer, RegistryService,
                                              register, resolve)
 from paddle_tpu.distributed import transport
@@ -39,6 +39,7 @@ def test_registry_set_get_ttl():
 
 
 @pytest.mark.slow
+@retry_flaky()
 def test_pserver_killed_and_restarted_on_new_port():
     here = os.path.dirname(os.path.abspath(__file__))
     (ps_port, new_port) = free_ports(2)

@@ -73,12 +73,17 @@ def test_pserver_killed_and_restarted_on_new_port():
     with tempfile.TemporaryDirectory() as tmp:
         ckpt = os.path.join(tmp, "shards")
         progress = os.path.join(tmp, "progress.json")
+        procs = []  # EVERY child registers here; the finally reaps all —
+        # a leaked pserver (e.g. ps2 on a trainer timeout) would contend
+        # on the registry and poison later attempts/tests
         ps1 = start_ps(ckpt=ckpt)
+        procs.append(ps1)
         trainer = subprocess.Popen(
             [sys.executable, runner],
             env={**env_base, "PADDLE_TRAINING_ROLE": "TRAINER",
                  "DIST_STEPS": "30", "ELASTIC_PROGRESS": progress},
             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(trainer)
         try:
             # let training make real progress, then kill the pserver hard
             deadline = time.monotonic() + 120
@@ -97,6 +102,7 @@ def test_pserver_killed_and_restarted_on_new_port():
                 assert time.monotonic() < deadline, "no shard checkpoint"
                 time.sleep(0.1)
             ps2 = start_ps(bind=f"127.0.0.1:{new_port}", ckpt=ckpt)
+            procs.append(ps2)
             out, err = trainer.communicate(timeout=240)
             if trainer.returncode != 0:
                 ps2.kill()
@@ -120,6 +126,7 @@ def test_pserver_killed_and_restarted_on_new_port():
                 ps2.communicate()
         finally:
             registry.stop()
-            for p in (ps1, trainer):
+            for p in procs:
                 if p.poll() is None:
                     p.kill()
+                    p.communicate()

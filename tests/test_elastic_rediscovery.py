@@ -93,7 +93,14 @@ def test_pserver_killed_and_restarted_on_new_port():
                     break
                 time.sleep(0.2)
             else:
-                pytest.fail("trainer made no progress")
+                for p in (ps1, trainer):
+                    p.kill()
+                _, t_err = trainer.communicate()
+                _, p_err = ps1.communicate()
+                pytest.fail(
+                    "trainer made no progress;\n--- trainer stderr ---\n"
+                    + t_err.decode()[-1200:]
+                    + "\n--- ps1 stderr ---\n" + p_err.decode()[-800:])
             ps1.kill()
             ps1.wait()
             # a checkpoint must exist for the replacement to restore

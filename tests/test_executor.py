@@ -296,3 +296,30 @@ def test_async_run_persistable_fetch_is_eager():
             ws.append(np.asarray(w).copy())
         # reads of earlier fetched params stay valid despite donation
         assert not np.allclose(ws[0], ws[-1])
+
+
+def test_async_run_pending_backstop():
+    """More than _MAX_PENDING unread fetches trigger the in-constructor
+    flush (regression: the backstop once called a deleted method), and
+    every value is still correct afterwards."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import (Executor, LazyFetch, Scope,
+                                          scope_guard)
+    from paddle_tpu.core.program import Program, program_guard
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [2])
+        out = fluid.layers.scale(x, scale=3.0)
+    scope, exe = Scope(), Executor()
+    n = LazyFetch._MAX_PENDING + 40
+    fetched = []
+    with scope_guard(scope):
+        exe.run(startup)
+        for i in range(n):
+            xb = np.full((1, 2), float(i), "float32")
+            (o,) = exe.run(prog, feed={"x": xb}, fetch_list=[out.name])
+            fetched.append(o)
+    for i, o in enumerate(fetched):
+        np.testing.assert_allclose(np.asarray(o), 3.0 * i)

@@ -1,5 +1,19 @@
 """Benchmark: all five BASELINE configs on one chip, one JSON line.
 
+Tunnel-robust harness (round 5): the parent process NEVER imports jax.
+It (1) probes the TPU tunnel in a kill-able subprocess and records the
+measured RTT in the artifact, (2) runs the configs in a worker
+subprocess that prints one flushed partial JSON line per completed
+config (an external timeout therefore loses at most the in-flight
+config, not the finished ones), (3) enforces a total wall-clock budget
+(PADDLE_TPU_BENCH_BUDGET_S, default 1200 s) and a per-config deadline —
+a hung config is killed, marked {"error": "timeout"}, and the worker is
+restarted on the remaining configs, (4) always prints the final
+combined JSON line itself, with explicit {"skipped": "budget"} /
+{"skipped": "tunnel probe failed"} markers for anything not run.
+Role analogue: the reference benchmark driver emits numbers as it goes
+(benchmark/fluid/fluid_benchmark.py:295 print_train_time), not at exit.
+
 Primary metric (the BASELINE.json headline): ResNet-50 train images/sec/
 chip (bf16, batch 256) vs an A100 mixed-precision baseline (~2,500
 img/s).  The ``configs`` field carries the other four:
@@ -523,6 +537,75 @@ def bench_flash_attention_long():
     return out
 
 
+def bench_ring_shard():
+    """Per-shard-pair Pallas workload at the ring path's shard shapes
+    (VERDICT r4 #7): with seq-parallel degree sp over global S=16384,
+    each device holds S/sp=4096 queries and, per ring hop, runs flash
+    against one 4096-key shard — causal-masked on the diagonal hop
+    (kv_index == q_index), full unmasked on off-diagonal hops where
+    kv_index < q_index.  Measuring both hop kinds on the real chip
+    gives the sp-scaling story a per-shard rate: a full ring step is
+    1 diagonal + (sp-1 on average /2...) — we report each hop's rate
+    and the implied per-device rate for sp=4.  Correctness of the
+    ring composition itself is pinned by the CPU-mesh parity tests
+    (tests/test_attention.py); this entry is the missing perf anchor."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from paddle_tpu.kernels.attention import flash_attention
+
+    S, B, H, D, K = 4096, 1, 4, 128, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+
+    out = {"shard_len": S, "heads": H, "head_dim": D}
+    for tag, causal in [("diagonal_hop_causal", True),
+                        ("offdiag_hop_full", False)]:
+        def loss(q, k, v, causal=causal):
+            return (flash_attention(q, k, v, None, causal, None)
+                    .astype(jnp.float32) ** 2).sum()
+
+        grad = jax.grad(loss, (0, 1, 2))
+
+        def multi(q, k, v):
+            def body(carry, _):
+                q, k, v = carry
+                dq, dk, dv = grad(q, k, v)
+                eps = jnp.bfloat16(1e-8)
+                return (q + dq * eps, k + dk * eps, v + dv * eps), None
+            (q, k, v), _ = lax.scan(body, (q, k, v), None, length=K)
+            return q
+
+        step = jax.jit(multi)
+        r = step(q, k, v)
+        float(np.asarray(r[0, 0, 0, 0]))
+
+        def timed(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = step(q, k, v)
+            float(np.asarray(r[0, 0, 0, 0]))
+            return time.perf_counter() - t0
+
+        dt = two_point_fit(timed) / K
+        frac = 0.5 if causal else 1.0  # causal computes half the scores
+        flops = 3.5 * 2 * B * H * S * S * D * frac
+        out[tag] = {"pair_ms": round(dt * 1e3, 2),
+                    "tflops": round(flops / dt / 1e12, 1)}
+
+    # implied per-device ring step at sp=4 (1 diagonal + 1.5 avg
+    # off-diagonal hops under causal load balance): tokens/s per device
+    d_ms = out["diagonal_hop_causal"]["pair_ms"]
+    o_ms = out["offdiag_hop_full"]["pair_ms"]
+    step_ms = d_ms + 1.5 * o_ms
+    out["implied_sp4_tokens_per_sec_per_device"] = round(
+        B * S / (step_ms * 1e-3), 1)
+    return out
+
+
 A100_RESNET50_IMG_S = 2500.0
 A100_TRANSFORMER_TOK_S = 50000.0
 
@@ -554,20 +637,246 @@ def bench_scaling():
     raise RuntimeError(f"scaling child failed: {out.stderr[-500:]}")
 
 
-def main():
-    configs = {}
-    for name, fn in [("resnet50", bench_resnet50),
-                     ("transformer_seq256", bench_transformer),
-                     ("stacked_lstm", bench_stacked_lstm),
-                     ("deepfm", bench_deepfm),
-                     ("mnist", bench_mnist),
-                     ("flash_attention_seq8k", bench_flash_attention_long),
-                     ("resnet50_datapath", bench_resnet50_datapath),
-                     ("scaling_dp8", bench_scaling)]:
+# Ordered so the headline + the claims under review land first if the
+# budget runs out.  (name, fn, per-config deadline seconds, needs_tpu)
+CONFIG_TABLE = [
+    ("resnet50", bench_resnet50, 480, True),
+    ("deepfm", bench_deepfm, 420, True),
+    ("mnist", bench_mnist, 300, True),
+    ("flash_attention_seq8k", bench_flash_attention_long, 600, True),
+    ("ring_shard_s4096", bench_ring_shard, 420, True),
+    ("transformer_seq256", bench_transformer, 420, True),
+    ("stacked_lstm", bench_stacked_lstm, 300, True),
+    ("resnet50_datapath", bench_resnet50_datapath, 420, True),
+    ("scaling_dp8", bench_scaling, 900, False),
+]
+
+
+def _config_table():
+    """The real table, or a test-injected one (file exporting
+    CONFIG_TABLE) so tests/test_bench_driver.py can exercise the
+    orchestrator's timeout/restart/budget paths without a TPU."""
+    import importlib.util
+    import os
+
+    path = os.environ.get("PADDLE_TPU_BENCH_TEST_TABLE")
+    if not path:
+        return CONFIG_TABLE
+    spec = importlib.util.spec_from_file_location("bench_test_table", path)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m.CONFIG_TABLE
+
+
+def _probe_main():
+    """Child: one tiny put + readback against the default backend, so a
+    sick tunnel is diagnosable (and kill-able) from outside."""
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the env var alone is not honored once the axon plugin
+        # registers; pin the config like tests/conftest.py does
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    t0 = time.perf_counter()
+    d = jax.device_put(np.ones((8, 128), np.float32))
+    float(np.asarray(d)[0, 0])
+    init_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    d = jax.device_put(np.ones((8, 128), np.float32))
+    float(np.asarray(d)[0, 0])
+    rtt_s = time.perf_counter() - t0
+    print("PROBE=" + json.dumps({
+        "ok": True, "backend_init_s": round(init_s, 2),
+        "rtt_ms": round(rtt_s * 1e3, 1),
+        "platform": jax.devices()[0].platform}), flush=True)
+
+
+def _worker_main(names):
+    """Child: run the named configs in order, one flushed line each."""
+    fns = dict((n, f) for n, f, _, _ in _config_table())
+    for name in names:
+        print("BENCHSTART=" + name, flush=True)
         try:
-            configs[name] = fn()
-        except Exception as e:  # a broken config must not hide the rest
-            configs[name] = {"error": repr(e)[:200]}
+            result = fns[name]()
+        except Exception as e:  # broken config must not hide the rest
+            result = {"error": repr(e)[:200]}
+        print("BENCHRESULT=" + json.dumps({"name": name, "result": result}),
+              flush=True)
+
+
+def _run_streaming(cmd, handle_line, deadline_for, kill_grace=5.0):
+    """Run cmd, dispatching stdout lines to handle_line.  deadline_for()
+    returns the absolute monotonic deadline for the current wait (it can
+    move as configs complete).  Returns (rc, timed_out)."""
+    import queue
+    import subprocess
+    import threading
+
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    q = queue.Queue()
+
+    def pump():
+        for line in p.stdout:
+            q.put(line)
+        q.put(None)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    timed_out = False
+    while True:
+        timeout = deadline_for() - time.monotonic()
+        if timeout <= 0:
+            timed_out = True
+            break
+        try:
+            line = q.get(timeout=min(timeout, 5.0))
+        except queue.Empty:
+            continue
+        if line is None:
+            break
+        handle_line(line.rstrip("\n"))
+    if timed_out:
+        # drain lines that raced the deadline (a result printed just
+        # before expiry must not be recorded as a timeout)
+        while True:
+            try:
+                line = q.get_nowait()
+            except queue.Empty:
+                break
+            if line is None:
+                break
+            handle_line(line.rstrip("\n"))
+        p.kill()
+    p.wait(timeout=kill_grace if timed_out else None)
+    return p.returncode, timed_out
+
+
+def _probe(budget_deadline):
+    import os
+    import sys
+
+    probe_timeout = float(os.environ.get(
+        "PADDLE_TPU_BENCH_PROBE_TIMEOUT_S", "240"))
+    deadline = min(time.monotonic() + probe_timeout, budget_deadline)
+    result = {}
+
+    def on_line(line):
+        if line.startswith("PROBE="):
+            result.update(json.loads(line[len("PROBE="):]))
+
+    rc, timed_out = _run_streaming(
+        [sys.executable, __file__, "--probe"], on_line, lambda: deadline)
+    if not result:
+        result = {"ok": False,
+                  "error": "timeout" if timed_out else f"rc={rc}"}
+    return result
+
+
+def main():
+    import os
+    import sys
+
+    t_start = time.monotonic()
+    budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "1200"))
+    budget_deadline = t_start + budget
+
+    def emit_partial(name, result):
+        print(json.dumps({"partial": True, "config": name,
+                          "result": result}), flush=True)
+
+    probe = _probe(budget_deadline)
+    print(json.dumps({"partial": True, "config": "_tunnel_probe",
+                      "result": probe}), flush=True)
+
+    configs = {}
+    pending = [(n, dl, tpu) for n, _, dl, tpu in _config_table()]
+    if not probe.get("ok"):
+        # dead tunnel: don't even try the TPU configs; the CPU-mesh
+        # scaling entry still runs so the artifact is never empty
+        for name, _, tpu in pending:
+            if tpu:
+                configs[name] = {"skipped": "tunnel probe failed"}
+                emit_partial(name, configs[name])
+        pending = [p for p in pending if not p[2]]
+
+    timeouts_in_a_row = 0
+    while pending:
+        remaining_budget = budget_deadline - time.monotonic()
+        if remaining_budget < 60:
+            for name, _, _ in pending:
+                configs[name] = {"skipped": "budget"}
+                emit_partial(name, configs[name])
+            break
+        if timeouts_in_a_row >= 2:
+            # tunnel went sick mid-run: stop burning budget on TPU
+            # configs, keep anything CPU-only
+            for name, _, tpu in list(pending):
+                if tpu:
+                    configs[name] = {"skipped":
+                                     "2 consecutive config timeouts"}
+                    emit_partial(name, configs[name])
+            pending = [p for p in pending if not p[2]]
+            timeouts_in_a_row = 0
+            continue
+
+        names = [n for n, _, _ in pending]
+        caps = dict((n, dl) for n, dl, _ in pending)
+        state = {"current": None, "started": time.monotonic(),
+                 "n_results": 0}
+
+        def on_line(line):
+            if line.startswith("BENCHSTART="):
+                state["current"] = line[len("BENCHSTART="):]
+                state["started"] = time.monotonic()
+            elif line.startswith("BENCHRESULT="):
+                rec = json.loads(line[len("BENCHRESULT="):])
+                configs[rec["name"]] = rec["result"]
+                emit_partial(rec["name"], rec["result"])
+                state["current"] = None
+                # restart the between-configs clock: deadline_for must
+                # not judge the NEXT config by the finished one's start
+                state["started"] = time.monotonic()
+                state["n_results"] += 1
+
+        def deadline_for():
+            cap = caps.get(state["current"], 300) if state["current"] \
+                else 120  # startup/import window
+            return min(state["started"] + cap, budget_deadline)
+
+        n_done_before = len(configs)
+        rc, timed_out = _run_streaming(
+            [sys.executable, __file__, "--worker", ",".join(names)],
+            on_line, deadline_for)
+        if state["n_results"]:
+            timeouts_in_a_row = 0  # "consecutive" means no success between
+        if timed_out and state["current"]:
+            configs[state["current"]] = {"error": "timeout", "after_s":
+                                         round(time.monotonic()
+                                               - state["started"], 1)}
+            emit_partial(state["current"], configs[state["current"]])
+            timeouts_in_a_row += 1
+        elif timed_out:
+            timeouts_in_a_row += 1
+        pending = [p for p in pending if p[0] not in configs]
+        if not timed_out and rc == 0:
+            break  # worker finished the whole list
+        if not timed_out and rc != 0 and state["current"]:
+            # worker crashed mid-config (not via the per-config except:
+            # e.g. a segfault); record it and continue with the rest
+            configs[state["current"]] = {"error": f"worker rc={rc}"}
+            emit_partial(state["current"], configs[state["current"]])
+            pending = [p for p in pending if p[0] not in configs]
+        elif not timed_out and rc != 0 and len(configs) == n_done_before:
+            # crashed before reaching any config and made no progress —
+            # don't crash-loop until the budget runs out
+            for name, _, _ in pending:
+                configs[name] = {"error": f"worker rc={rc} at startup"}
+                emit_partial(name, configs[name])
+            break
 
     primary = configs.get("resnet50", {}).get("images_per_sec", 0.0)
     tfm = configs.get("transformer_seq256", {})
@@ -579,9 +888,18 @@ def main():
         "value": primary,
         "unit": "images/sec",
         "vs_baseline": round(primary / A100_RESNET50_IMG_S, 3),
+        "tunnel_probe": probe,
+        "elapsed_s": round(time.monotonic() - t_start, 1),
         "configs": configs,
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        _probe_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _worker_main(sys.argv[2].split(","))
+    else:
+        main()

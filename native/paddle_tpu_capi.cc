@@ -31,8 +31,11 @@ void set_err(const char* where) {
     if (value != nullptr) {
       PyObject* s = PyObject_Str(value);
       if (s != nullptr) {
-        g_err += ": ";
-        g_err += PyUnicode_AsUTF8(s);
+        const char* msg = PyUnicode_AsUTF8(s);  // may fail -> NULL
+        if (msg != nullptr) {
+          g_err += ": ";
+          g_err += msg;
+        }
         Py_DECREF(s);
       }
     }
@@ -152,7 +155,13 @@ const char* pt_predictor_input_name(pt_predictor* p, int i) {
     return nullptr;
   }
   // borrowed via thread-local storage (valid until next name lookup)
-  g_name = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  const char* nm = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  if (nm == nullptr) {
+    Py_DECREF(names);
+    set_err("pt_predictor_input_name: non-utf8 name");
+    return nullptr;
+  }
+  g_name = nm;
   Py_DECREF(names);
   return g_name.c_str();
 }
@@ -196,23 +205,33 @@ int pt_predictor_run(pt_predictor* p, const pt_tensor* inputs, int n_in,
   }
   int n = static_cast<int>(PyList_Size(outs));
   int written = 0;
+  // On any mid-loop failure the caller cannot know how many output
+  // buffers were already allocated, so free them here before returning.
+  auto fail = [&](const std::string& msg) {
+    for (int j = 0; j < written; ++j) pt_tensor_free(&outputs[j]);
+    Py_DECREF(outs);
+    g_err = msg;
+    return -1;
+  };
   for (int i = 0; i < n && i < n_out; ++i) {
     PyObject* tup = PyList_GetItem(outs, i);  // (dtype, shape, bytes)
     const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+    if (dt == nullptr) {
+      PyErr_Clear();
+      return fail("pt_predictor_run: output dtype marshal");
+    }
     PyObject* shape = PyTuple_GetItem(tup, 1);
     PyObject* data = PyTuple_GetItem(tup, 2);
     pt_tensor* o = &outputs[i];
     std::memset(o, 0, sizeof(*o));
     if (dtype_from_name(dt, &o->dtype) != 0) {
-      Py_DECREF(outs);
-      g_err = std::string("pt_predictor_run: unsupported output dtype ") + dt;
-      return -1;
+      return fail(std::string("pt_predictor_run: unsupported output dtype ")
+                  + dt);
     }
     int ndim = static_cast<int>(PyTuple_Size(shape));
     if (ndim > 8) {
-      Py_DECREF(outs);
-      g_err = "pt_predictor_run: output rank > 8 unsupported by pt_tensor";
-      return -1;
+      return fail("pt_predictor_run: output rank > 8 unsupported by "
+                  "pt_tensor");
     }
     o->ndim = ndim;
     for (int d = 0; d < o->ndim; ++d) {
@@ -221,16 +240,14 @@ int pt_predictor_run(pt_predictor* p, const pt_tensor* inputs, int n_in,
     char* buf = nullptr;
     Py_ssize_t len = 0;
     if (PyBytes_AsStringAndSize(data, &buf, &len) != 0) {
-      Py_DECREF(outs);
-      set_err("pt_predictor_run: output bytes marshal");
-      return -1;
+      PyErr_Clear();
+      return fail("pt_predictor_run: output bytes marshal");
     }
     o->nbytes = static_cast<size_t>(len);
     o->data = std::malloc(o->nbytes ? o->nbytes : 1);
     if (o->data == nullptr) {
-      Py_DECREF(outs);
-      g_err = "pt_predictor_run: out of memory";
-      return -1;
+      o->nbytes = 0;
+      return fail("pt_predictor_run: out of memory");
     }
     std::memcpy(o->data, buf, o->nbytes);
     o->name = nullptr;

@@ -34,9 +34,14 @@ class WeightedAverage:
 
     def add(self, value, weight):
         if not _is_number_or_matrix(value):
-            raise ValueError(
-                "The 'value' must be a number(int, float) or a numpy "
-                "ndarray.")
+            # Accept anything exposing __array__ — notably the LazyFetch
+            # objects Executor.run returns by default (reading one here
+            # flushes the pending batch, same as any other consumption).
+            value = np.asarray(value)
+            if value.dtype.kind not in "biufc":
+                raise ValueError(
+                    "The 'value' must be a number(int, float), a numpy "
+                    "ndarray, or expose __array__.")
         if not _is_number(weight):
             raise ValueError("The 'weight' must be a number(int, float).")
         if self.numerator is None or self.denominator is None:

@@ -38,7 +38,12 @@ class DataFeeder:
         out = {}
         for i, var in enumerate(self.feed_vars):
             col = [row[i] for row in rows]
-            if var.lod_level >= 1:
+            if var.lod_level >= 2:
+                data, outer, inner = self._pad_nested(col, var)
+                out[var.name] = data
+                out[var.name + "@LEN"] = outer
+                out[var.name + "@LEN2"] = inner
+            elif var.lod_level == 1:
                 data, lens = self._pad(col, var)
                 out[var.name] = data
                 out[var.name + "@LEN"] = lens
@@ -50,6 +55,33 @@ class DataFeeder:
                     arr = arr[..., None]  # reference-style trailing label dim
                 out[var.name] = arr
         return out
+
+    def _pad_nested(self, col, var: Variable):
+        """Level-2 feed: each example is a list of sentences, each
+        sentence a list/array of word rows.  Produces the nested padded
+        contract ([B,S,W,...] + @LEN outer [B] + @LEN2 inner [B,S]) via
+        the same builder create_lod_tensor uses, so DataFeeder and the
+        LoDTensor feed path stay bit-identical."""
+        from .lod_tensor import _create_nested
+
+        if var.lod_level > 2:
+            raise NotImplementedError(
+                "DataFeeder supports lod_level <= 2 (the nested padded "
+                "contract; see lod_tensor.py)")
+        outer = [len(ex) for ex in col]
+        flat = [np.asarray(s) for ex in col for s in ex]
+        inner = [len(s) for s in flat]
+        # zero-word sentences are legal (they pool to 0 downstream); give
+        # them the word-row feature shape so concatenation lines up
+        feat = next((s.shape[1:] for s in flat if len(s)), ())
+        flat = [s if len(s) else np.zeros((0,) + feat) for s in flat]
+        lt = _create_nested(flat, [outer, inner])
+        data = lt.data.astype(np_dtype(var.dtype), copy=False)
+        want = var.shape
+        if want is not None and len(want) == data.ndim + 1 and want[-1] == 1:
+            data = data[..., None]  # reference-style trailing word dim
+        return (data, lt.seq_lens.astype(np.int64),
+                lt.inner_lens.astype(np.int64))
 
     def _pad(self, col, var: Variable):
         seqs = [np.asarray(s) for s in col]

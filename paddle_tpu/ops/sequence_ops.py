@@ -65,6 +65,13 @@ def _sequence_pool(ctx, ins, attrs):
         out = x[:, 0]
     else:
         raise ValueError(f"unknown pooltype {ptype}")
+    if ptype in ("MAX", "LAST", "FIRST") and seq_len is not None:
+        # Length-0 slots (legal in the nested level-2 contract, where
+        # padding sentences flatten to empty inner rows) must pool to 0
+        # like the masked-sum family — not finfo.min (MAX) or padding
+        # reads (LAST/FIRST) that would leak into the outer pool.
+        alive = (lens > 0).reshape((-1,) + (1,) * (x.ndim - 2))
+        out = jnp.where(alive, out, jnp.zeros((), x.dtype))
     return {"Out": [out]}
 
 

@@ -42,8 +42,9 @@ def _record(feeder, batch, feed_order):
     ``@LEN`` sequence-length companions the feeder produced — dropping
     them would turn zero-padding into real tokens on read-back."""
     fd = feeder.feed(batch)
-    keep = list(feed_order) + [n + "@LEN" for n in feed_order
-                               if n + "@LEN" in fd]
+    keep = list(feed_order) + [n + suf for n in feed_order
+                               for suf in ("@LEN", "@LEN2")
+                               if n + suf in fd]
     return {n: fd[n] for n in keep}
 
 

@@ -114,3 +114,25 @@ def test_detection_map_evaluator_accumulates():
         ev.reset(exe)
     np.testing.assert_allclose(map1, 1.0, rtol=1e-5)
     assert map2 < map1, (map1, map2)
+
+
+def test_weighted_average_accepts_lazy_fetch():
+    """ADVICE r4: the canonical avg.add(value=exe.run(...)[0], weight=n)
+    flow must work with the async executor's LazyFetch returns."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [3])
+        m = fluid.layers.mean(x)
+    scope, exe = Scope(), Executor()
+    import warnings
+    with scope_guard(scope):
+        exe.run(startup)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            avg = fluid.average.WeightedAverage()
+        for k in range(2):
+            v, = exe.run(prog, feed={"x": np.full((2, 3), float(k + 1),
+                                                  np.float32)},
+                         fetch_list=[m.name])  # LazyFetch by default
+            avg.add(value=v, weight=2)
+    np.testing.assert_allclose(avg.eval(), 1.5)

@@ -263,3 +263,64 @@ def test_nested_sequence_expand_outer_level():
     np.testing.assert_allclose(out[0, 0], xv[0])
     np.testing.assert_allclose(out[0, 1], xv[0])
     np.testing.assert_allclose(out[1, 0], xv[1])
+
+
+def test_nested_max_pool_zero_length_slot_pools_to_zero():
+    """ADVICE r4: an in-range sentence slot with inner length 0 must pool
+    to 0 under MAX/LAST/FIRST (not finfo.min / padding reads) so it
+    cannot leak a sentinel into the outer pool."""
+    import paddle_tpu as fluid
+
+    # sample0: 2 sentences, the second has ZERO words (legal per
+    # create_lod_tensor); sample1: 1 sentence of 2 words
+    words = np.arange(10, dtype=np.float32).reshape(5, 2) - 4.0
+    outer, inner = [2, 1], [3, 0, 2]
+    lt = fluid.create_lod_tensor(words, [outer, inner], None)
+
+    for ptype, expect_s0 in [
+            ("max", words[:3].max(0)),
+            ("last", words[2]),
+            ("first", words[0])]:
+        def build(ptype=ptype):
+            d = fluid.layers.data("doc", [2], lod_level=2)
+            sent = fluid.layers.sequence_pool(d, ptype)
+            outer_max = fluid.layers.sequence_pool(sent, "max")
+            return [sent, outer_max]
+
+        s_out, o_out = _run(build, {"doc": lt})
+        np.testing.assert_allclose(s_out[0, 0], expect_s0)
+        # the empty sentence slot pooled to 0, not finfo.min/padding
+        np.testing.assert_allclose(s_out[0, 1], np.zeros(2))
+        # and the outer max over sample0 sees {pool(s0), 0}
+        np.testing.assert_allclose(
+            o_out[0], np.maximum(expect_s0, 0.0))
+
+
+def test_datafeeder_level2_emits_nested_contract():
+    """ADVICE r4: DataFeeder must feed lod_level=2 vars (nested padding
+    + @LEN/@LEN2), matching the create_lod_tensor contract."""
+    import paddle_tpu as fluid
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        d = fluid.layers.data("doc", [2], lod_level=2)
+        feeder = fluid.DataFeeder(feed_list=[d], program=prog)
+
+    # two examples: [[s0(3 words), s1(1 word)], [s0(2 words)]]
+    words, outer, inner = _nested_corpus()
+    ex0 = [words[:3], words[3:4]]
+    ex1 = [words[4:6]]
+    fd = feeder.feed([(ex0,), (ex1,)])
+    assert set(fd) == {"doc", "doc@LEN", "doc@LEN2"}
+    np.testing.assert_array_equal(fd["doc@LEN"], outer)
+    assert fd["doc"].ndim == 4  # [B, S, W, 2]
+    # inner lens match, including zero padding slots
+    assert fd["doc@LEN2"][0, 0] == 3 and fd["doc@LEN2"][0, 1] == 1
+    assert fd["doc@LEN2"][1, 0] == 2 and fd["doc@LEN2"][1, 1] == 0
+    # bit-identical to the LoDTensor path
+    lt = fluid.create_lod_tensor(words, [outer, inner], None)
+    np.testing.assert_allclose(fd["doc"], lt.data)
+
+    # zero-word sentences are legal and survive the feeder
+    fd0 = feeder.feed([(([words[:2], []]),)])
+    assert fd0["doc@LEN2"][0, 0] == 2 and fd0["doc@LEN2"][0, 1] == 0

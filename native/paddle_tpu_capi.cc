@@ -67,7 +67,8 @@ int dtype_from_name(const char* n, pt_dtype* out) {
   return -1;
 }
 
-PyObject* g_bridge = nullptr;  // paddle_tpu.inference.capi_bridge
+PyObject* g_bridge = nullptr;        // paddle_tpu.inference.capi_bridge
+PyObject* g_train_bridge = nullptr;  // paddle_tpu.train.capi_bridge
 
 struct Gil {
   PyGILState_STATE st;
@@ -75,9 +76,89 @@ struct Gil {
   ~Gil() { PyGILState_Release(st); }
 };
 
+// Build the bridge wire list [(name, dtype, shape, bytes), ...] from
+// borrowed input tensors.  Returns NULL with g_err set on failure.
+// Caller holds the GIL.
+PyObject* marshal_inputs(const char* where, const pt_tensor* inputs,
+                         int n_in) {
+  PyObject* ins = PyList_New(n_in);
+  if (ins == nullptr) {
+    PyErr_Clear();
+    g_err = std::string(where) + ": input list alloc";
+    return nullptr;
+  }
+  for (int i = 0; i < n_in; ++i) {
+    const pt_tensor& t = inputs[i];
+    PyObject* shape = PyTuple_New(t.ndim);
+    for (int d = 0; d < t.ndim; ++d) {
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
+    }
+    PyObject* tup = Py_BuildValue(
+        "(ssOy#)", t.name, dtype_name(t.dtype), shape,
+        static_cast<const char*>(t.data), (Py_ssize_t)t.nbytes);
+    Py_DECREF(shape);
+    if (tup == nullptr) {
+      Py_DECREF(ins);
+      g_err = std::string(where) + ": input marshal";
+      PyErr_Clear();
+      return nullptr;
+    }
+    PyList_SET_ITEM(ins, i, tup);
+  }
+  return ins;
+}
+
+// Fill one owned output tensor from a bridge (dtype, shape, bytes)
+// tuple.  Returns 0, or -1 with g_err set (no buffer left allocated).
+// Caller holds the GIL.
+int fill_output(const char* where, PyObject* tup, pt_tensor* o) {
+  std::memset(o, 0, sizeof(*o));
+  const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+  if (dt == nullptr) {
+    PyErr_Clear();
+    g_err = std::string(where) + ": output dtype marshal";
+    return -1;
+  }
+  if (dtype_from_name(dt, &o->dtype) != 0) {
+    g_err = std::string(where) + ": unsupported output dtype " + dt;
+    return -1;
+  }
+  PyObject* shape = PyTuple_GetItem(tup, 1);
+  int ndim = static_cast<int>(PyTuple_Size(shape));
+  if (ndim > 8) {
+    g_err = std::string(where) + ": output rank > 8 unsupported";
+    return -1;
+  }
+  o->ndim = ndim;
+  for (int d = 0; d < ndim; ++d) {
+    o->shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(PyTuple_GetItem(tup, 2), &buf, &len) != 0) {
+    PyErr_Clear();
+    g_err = std::string(where) + ": output bytes marshal";
+    return -1;
+  }
+  o->nbytes = static_cast<size_t>(len);
+  o->data = std::malloc(o->nbytes ? o->nbytes : 1);
+  if (o->data == nullptr) {
+    o->nbytes = 0;
+    g_err = std::string(where) + ": out of memory";
+    return -1;
+  }
+  std::memcpy(o->data, buf, o->nbytes);
+  o->name = nullptr;
+  return 0;
+}
+
 }  // namespace
 
 struct pt_predictor {
+  long handle;
+};
+
+struct pt_trainer {
   long handle;
 };
 
@@ -178,24 +259,8 @@ int pt_predictor_num_outputs(pt_predictor* p) {
 int pt_predictor_run(pt_predictor* p, const pt_tensor* inputs, int n_in,
                      pt_tensor* outputs, int n_out) {
   Gil gil;
-  PyObject* ins = PyList_New(n_in);
-  for (int i = 0; i < n_in; ++i) {
-    const pt_tensor& t = inputs[i];
-    PyObject* shape = PyTuple_New(t.ndim);
-    for (int d = 0; d < t.ndim; ++d) {
-      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
-    }
-    PyObject* tup = Py_BuildValue(
-        "(ssOy#)", t.name, dtype_name(t.dtype), shape,
-        static_cast<const char*>(t.data), (Py_ssize_t)t.nbytes);
-    Py_DECREF(shape);
-    if (tup == nullptr) {
-      Py_DECREF(ins);
-      set_err("pt_predictor_run: input marshal");
-      return -1;
-    }
-    PyList_SET_ITEM(ins, i, tup);
-  }
+  PyObject* ins = marshal_inputs("pt_predictor_run", inputs, n_in);
+  if (ins == nullptr) return -1;
   PyObject* outs = PyObject_CallMethod(g_bridge, "run", "lO",
                                        p->handle, ins);
   Py_DECREF(ins);
@@ -205,52 +270,14 @@ int pt_predictor_run(pt_predictor* p, const pt_tensor* inputs, int n_in,
   }
   int n = static_cast<int>(PyList_Size(outs));
   int written = 0;
-  // On any mid-loop failure the caller cannot know how many output
-  // buffers were already allocated, so free them here before returning.
-  auto fail = [&](const std::string& msg) {
-    for (int j = 0; j < written; ++j) pt_tensor_free(&outputs[j]);
-    Py_DECREF(outs);
-    g_err = msg;
-    return -1;
-  };
   for (int i = 0; i < n && i < n_out; ++i) {
-    PyObject* tup = PyList_GetItem(outs, i);  // (dtype, shape, bytes)
-    const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
-    if (dt == nullptr) {
-      PyErr_Clear();
-      return fail("pt_predictor_run: output dtype marshal");
+    if (fill_output("pt_predictor_run", PyList_GetItem(outs, i),
+                    &outputs[i]) != 0) {
+      // the caller cannot know how many slots were written — free them
+      for (int j = 0; j < written; ++j) pt_tensor_free(&outputs[j]);
+      Py_DECREF(outs);
+      return -1;
     }
-    PyObject* shape = PyTuple_GetItem(tup, 1);
-    PyObject* data = PyTuple_GetItem(tup, 2);
-    pt_tensor* o = &outputs[i];
-    std::memset(o, 0, sizeof(*o));
-    if (dtype_from_name(dt, &o->dtype) != 0) {
-      return fail(std::string("pt_predictor_run: unsupported output dtype ")
-                  + dt);
-    }
-    int ndim = static_cast<int>(PyTuple_Size(shape));
-    if (ndim > 8) {
-      return fail("pt_predictor_run: output rank > 8 unsupported by "
-                  "pt_tensor");
-    }
-    o->ndim = ndim;
-    for (int d = 0; d < o->ndim; ++d) {
-      o->shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
-    }
-    char* buf = nullptr;
-    Py_ssize_t len = 0;
-    if (PyBytes_AsStringAndSize(data, &buf, &len) != 0) {
-      PyErr_Clear();
-      return fail("pt_predictor_run: output bytes marshal");
-    }
-    o->nbytes = static_cast<size_t>(len);
-    o->data = std::malloc(o->nbytes ? o->nbytes : 1);
-    if (o->data == nullptr) {
-      o->nbytes = 0;
-      return fail("pt_predictor_run: out of memory");
-    }
-    std::memcpy(o->data, buf, o->nbytes);
-    o->name = nullptr;
     ++written;
   }
   Py_DECREF(outs);
@@ -274,6 +301,108 @@ void pt_predictor_destroy(pt_predictor* p) {
     PyErr_Clear();
   }
   delete p;
+}
+
+/* ------------------------- trainer surface ------------------------- */
+
+static int pt_train_init(void) {
+  if (pt_init() != 0) return -1;  // interpreter + shared machinery
+  if (g_train_bridge != nullptr) return 0;
+  Gil gil;
+  if (g_train_bridge == nullptr) {
+    PyObject* mod = PyImport_ImportModule("paddle_tpu.train.capi_bridge");
+    if (mod == nullptr) {
+      set_err("pt_trainer: import paddle_tpu.train.capi_bridge failed");
+      return -1;
+    }
+    g_train_bridge = mod;  // process-lifetime reference
+  }
+  return 0;
+}
+
+pt_trainer* pt_trainer_create(const char* model_dir) {
+  if (pt_train_init() != 0) return nullptr;
+  Gil gil;
+  PyObject* h = PyObject_CallMethod(g_train_bridge, "create", "s",
+                                    model_dir);
+  if (h == nullptr) {
+    set_err("pt_trainer_create");
+    return nullptr;
+  }
+  pt_trainer* t = new pt_trainer{PyLong_AsLong(h)};
+  Py_DECREF(h);
+  return t;
+}
+
+int pt_trainer_num_inputs(pt_trainer* t) {
+  Gil gil;
+  PyObject* names = PyObject_CallMethod(g_train_bridge, "feed_names", "l",
+                                        t->handle);
+  if (names == nullptr) { set_err("pt_trainer_num_inputs"); return -1; }
+  int n = static_cast<int>(PyList_Size(names));
+  Py_DECREF(names);
+  return n;
+}
+
+const char* pt_trainer_input_name(pt_trainer* t, int i) {
+  Gil gil;
+  PyObject* names = PyObject_CallMethod(g_train_bridge, "feed_names", "l",
+                                        t->handle);
+  if (names == nullptr || i < 0 || i >= PyList_Size(names)) {
+    Py_XDECREF(names);
+    set_err("pt_trainer_input_name: index out of range");
+    return nullptr;
+  }
+  const char* nm = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  if (nm == nullptr) {
+    Py_DECREF(names);
+    set_err("pt_trainer_input_name: non-utf8 name");
+    return nullptr;
+  }
+  g_name = nm;
+  Py_DECREF(names);
+  return g_name.c_str();
+}
+
+int pt_trainer_step(pt_trainer* t, const pt_tensor* inputs, int n_in,
+                    pt_tensor* loss_out) {
+  Gil gil;
+  PyObject* ins = marshal_inputs("pt_trainer_step", inputs, n_in);
+  if (ins == nullptr) return -1;
+  PyObject* tup = PyObject_CallMethod(g_train_bridge, "step", "lO",
+                                      t->handle, ins);
+  Py_DECREF(ins);
+  if (tup == nullptr) {
+    set_err("pt_trainer_step");
+    return -1;
+  }
+  int rc = fill_output("pt_trainer_step", tup, loss_out);
+  Py_DECREF(tup);
+  return rc;
+}
+
+int pt_trainer_save(pt_trainer* t, const char* dirname) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_train_bridge, "save", "ls",
+                                    t->handle, dirname);
+  if (r == nullptr) {
+    set_err("pt_trainer_save");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+void pt_trainer_destroy(pt_trainer* t) {
+  if (t == nullptr) return;
+  if (g_train_bridge != nullptr && Py_IsInitialized()) {
+    Gil gil;
+    PyObject* r = PyObject_CallMethod(g_train_bridge, "destroy", "l",
+                                      t->handle);
+    Py_XDECREF(r);
+    PyErr_Clear();
+  }
+  delete t;
 }
 
 const char* pt_last_error(void) { return g_err.c_str(); }

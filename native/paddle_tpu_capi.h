@@ -73,6 +73,34 @@ const char* pt_predictor_input_name(pt_predictor* p, int i);
 void pt_tensor_free(pt_tensor* t);
 void pt_predictor_destroy(pt_predictor* p);
 
+/* ------------------------------------------------------------------ *
+ * Native TRAINING (reference role: paddle/fluid/train/demo/
+ * demo_trainer.cc — load a saved train program, run steps, read loss).
+ * Model directories come from fluid.io.save_train_model (full main +
+ * startup programs + persistable state); pt_trainer_save writes the
+ * same layout, so checkpoints round-trip between C and Python.
+ * ------------------------------------------------------------------ */
+typedef struct pt_trainer pt_trainer;
+
+/* Load a save_train_model directory.  NULL on failure (pt_last_error). */
+pt_trainer* pt_trainer_create(const char* model_dir);
+
+/* Feed introspection (same contract as the predictor's). */
+int pt_trainer_num_inputs(pt_trainer* t);
+const char* pt_trainer_input_name(pt_trainer* t, int i);
+
+/* Run ONE optimizer step on a batch.  inputs: n_in borrowed tensors.
+ * loss_out: filled with a malloc'd scalar/vector loss tensor (free with
+ * pt_tensor_free).  Returns 0 on success, -1 on error. */
+int pt_trainer_step(pt_trainer* t, const pt_tensor* inputs, int n_in,
+                    pt_tensor* loss_out);
+
+/* Checkpoint: save programs + all persistable state (params, optimizer
+ * moments, LR counters) into dirname.  Returns 0 on success. */
+int pt_trainer_save(pt_trainer* t, const char* dirname);
+
+void pt_trainer_destroy(pt_trainer* t);
+
 /* Last error message for this thread (borrowed; valid until next call). */
 const char* pt_last_error(void);
 

@@ -21,6 +21,7 @@ Here the same capability is built TPU-first:
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import ps_ops  # noqa: F401  (registers the host ops)
 from . import transport
+from .transport import wait_server_ready
 from .master import MasterClient, TaskMaster, serve_master, task_reader  # noqa: F401
 
 
@@ -33,4 +34,4 @@ def notify_complete(endpoints, trainer_id: int = 0) -> None:
 
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
-           "notify_complete"]
+           "notify_complete", "wait_server_ready"]

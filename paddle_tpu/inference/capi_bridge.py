@@ -24,9 +24,32 @@ _DTYPES = {
     "uint8": np.uint8,
 }
 
-_handles = {}
-_next = [1]
-_lock = threading.Lock()
+class HandleRegistry:
+    """Thread-safe int-handle table; shared by the C-API bridges (this
+    one and paddle_tpu/train/capi_bridge.py)."""
+
+    def __init__(self):
+        self._handles = {}
+        self._next = 1
+        self._lock = threading.Lock()
+
+    def add(self, obj) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._handles[h] = obj
+            return h
+
+    def get(self, h: int):
+        with self._lock:
+            return self._handles[h]
+
+    def pop(self, h: int) -> None:
+        with self._lock:
+            self._handles.pop(h, None)
+
+
+_registry = HandleRegistry()
 
 
 def _np_dtype(name: str):
@@ -51,39 +74,25 @@ def create(model_dir: str) -> int:
     from .predictor import AnalysisConfig, create_predictor
 
     pred = create_predictor(AnalysisConfig(model_dir))
-    with _lock:
-        h = _next[0]
-        _next[0] += 1
-        _handles[h] = pred
-    return h
+    return _registry.add(pred)
 
 
 def clone(handle: int) -> int:
-    with _lock:
-        pred = _handles[handle]
-    c = pred.clone()
-    with _lock:
-        h = _next[0]
-        _next[0] += 1
-        _handles[h] = c
-    return h
+    return _registry.add(_registry.get(handle).clone())
 
 
 def feed_names(handle: int) -> List[str]:
-    with _lock:
-        return _handles[handle].feed_names
+    return _registry.get(handle).feed_names
 
 
 def fetch_count(handle: int) -> int:
-    with _lock:
-        return len(_handles[handle].fetch_names)
+    return len(_registry.get(handle).fetch_names)
 
 
 def run(handle: int,
         inputs: List[Tuple[str, str, tuple, bytes]]
         ) -> List[Tuple[str, tuple, bytes]]:
-    with _lock:
-        pred = _handles[handle]
+    pred = _registry.get(handle)
     feed = {}
     for name, dtype, shape, data in inputs:
         feed[name] = np.frombuffer(data, dtype=_np_dtype(dtype)).reshape(shape)
@@ -97,5 +106,4 @@ def run(handle: int,
 
 
 def destroy(handle: int) -> None:
-    with _lock:
-        _handles.pop(handle, None)
+    _registry.pop(handle)

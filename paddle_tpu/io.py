@@ -113,6 +113,54 @@ def save_inference_model(dirname, feeded_var_names: Sequence[str],
     return [v.name for v in target_vars]
 
 
+TRAIN_MODEL_FILENAME = "__train_model__"
+
+
+def save_train_model(dirname, feeded_var_names: Sequence[str], loss,
+                     executor, main_program=None, startup_program=None):
+    """Save a TRAINABLE model: the full (unpruned) main program with its
+    backward + optimizer ops, the startup program, and the current
+    persistable state — everything a native (no-Python-authored) trainer
+    needs to run train steps and checkpoints.  Role analogue of the
+    reference's train-from-saved-ProgramDesc flow
+    (paddle/fluid/train/demo/demo_trainer.cc:1 loads main/startup
+    ProgramDescs; test_train_recognize_digits.cc trains from them)."""
+    from .core.program import default_startup_program
+
+    program = main_program or default_main_program()
+    startup = startup_program or default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "main": program.to_dict(),
+        "startup": startup.to_dict(),
+        # to_dict covers blocks only; the seed must survive the
+        # round-trip or a resumed dropout stream diverges
+        "random_seed": program.random_seed,
+        "startup_random_seed": startup.random_seed,
+        "feed_var_names": list(feeded_var_names),
+        "loss_name": loss if isinstance(loss, str) else loss.name,
+    }
+    import json
+    with open(os.path.join(dirname, TRAIN_MODEL_FILENAME), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, program)
+
+
+def load_train_model(dirname, executor):
+    """Load a save_train_model directory: returns (main_program,
+    startup_program, feed_names, loss_name).  The caller runs the
+    startup program and then load_persistables to restore state (the
+    native trainer bridge does both)."""
+    import json
+    with open(os.path.join(dirname, TRAIN_MODEL_FILENAME)) as f:
+        meta = json.load(f)
+    main = Program.from_dict(meta["main"])
+    startup = Program.from_dict(meta["startup"])
+    main.random_seed = meta.get("random_seed", 0)
+    startup.random_seed = meta.get("startup_random_seed", 0)
+    return main, startup, meta["feed_var_names"], meta["loss_name"]
+
+
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     import json

@@ -1,0 +1,4 @@
+"""Native training support: the marshal bridge behind the C trainer API
+(reference role: paddle/fluid/train/ — train from a saved ProgramDesc
+without authoring Python)."""
+from . import capi_bridge  # noqa: F401

@@ -37,6 +37,9 @@ def main():
         return
 
     tp = t.get_trainer_program()
+    # deterministic startup: every pserver is bound and listening before
+    # the first send (ready-files when PADDLE_READY_DIR is set)
+    fluid.distributed.wait_server_ready(endpoints)
     exe.run(startup, scope=scope)
     runner = exe
     if os.environ.get("DIST_TRAINER_MESH") == "1":

@@ -1,0 +1,164 @@
+"""Native C TRAINING API end-to-end (VERDICT r4 #2): build the capi lib
++ pure-C train smoke, save a trainable mnist model from Python, train it
+from C (loss must decrease over 20 steps), checkpoint from C, and resume
+the C-written checkpoint in Python — proving the save_train_model layout
+round-trips both ways.  Reference capability:
+paddle/fluid/train/demo/demo_trainer.cc:1 and
+paddle/fluid/train/test_train_recognize_digits.cc (train without
+authoring Python)."""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+
+def _save_train_mnist(tmpdir):
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.models import mnist
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 3
+    with program_guard(prog, startup), unique_name.guard():
+        images = fluid.layers.data("pixel", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        predict = mnist.cnn_model(images)
+        cost = fluid.layers.mean(fluid.layers.cross_entropy(predict, label))
+        fluid.optimizer.Adam(1e-3).minimize(cost)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_train_model(tmpdir, ["pixel", "label"], cost, exe,
+                                  main_program=prog,
+                                  startup_program=startup)
+    return cost.name
+
+
+@pytest.mark.skipif(shutil.which("make") is None or shutil.which("cc") is None,
+                    reason="no C toolchain")
+def test_capi_train_end_to_end(tmp_path):
+    model_dir = str(tmp_path / "mnist_train")
+    ckpt_dir = str(tmp_path / "mnist_ckpt")
+    loss_name = _save_train_mnist(model_dir)
+
+    r = subprocess.run(["make", "libpaddle_tpu_capi.so", "test_capi_train"],
+                       cwd=NATIVE, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-800:]
+
+    env = dict(os.environ)
+    site = os.path.dirname(os.path.dirname(np.__file__))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, site, env.get("PYTHONPATH", "")])
+    env["PT_CAPI_JAX_PLATFORM"] = "cpu"
+    r = subprocess.run([os.path.join(NATIVE, "test_capi_train"),
+                        model_dir, ckpt_dir],
+                       capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout[-600:], r.stderr[-800:])
+    assert "OK: mnist train via C API" in r.stdout
+
+    # the C-written checkpoint must resume in Python: trained params
+    # (not init) and a loss near where C left off on the same batch
+    from paddle_tpu import io
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+
+    last_c_loss = float(
+        [l for l in r.stdout.splitlines() if l.startswith("step ")][-1]
+        .split()[-1])
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        main, startup, feeds, loss = io.load_train_model(ckpt_dir, exe)
+        assert feeds == ["pixel", "label"] and loss == loss_name
+        exe.run(startup)
+        io.load_persistables(exe, ckpt_dir, main)
+        # regenerate the C smoke's deterministic batch (same LCG)
+        state = 12345
+        vals = []
+        for _ in range(16 * 28 * 28):
+            state = (state * 1664525 + 1013904223) % (1 << 32)
+            vals.append((state >> 8) / float(1 << 24) * 2.0 - 1.0)
+        pixels = np.asarray(vals, np.float32).reshape(16, 1, 28, 28)
+        labels = (np.arange(16) % 10).astype(np.int64)[:, None]
+        l, = exe.run(main, feed={"pixel": pixels, "label": labels},
+                     fetch_list=[loss], sync=True)
+    # one more step from the checkpoint: loss continues from C's level
+    # (well below the ~2.3 random-init cross-entropy)
+    assert float(np.asarray(l)) < last_c_loss + 0.5, (
+        float(np.asarray(l)), last_c_loss)
+
+
+def test_save_load_train_model_roundtrip(tmp_path):
+    """Python-only round-trip: resumed training continues from the same
+    state (loss trajectory matches a never-interrupted run)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    def build():
+        x = fluid.layers.data("x", [6])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 16, act="tanh")
+        p = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+        return loss
+
+    rng = np.random.RandomState(0)
+    batches = [rng.randn(8, 6).astype("float32") for _ in range(6)]
+
+    def feed(i):
+        return {"x": batches[i],
+                "y": batches[i].sum(1, keepdims=True).astype("float32")}
+
+    # uninterrupted run: 6 steps
+    prog, startup = Program(), Program()
+    prog.random_seed = 5
+    with program_guard(prog, startup), unique_name.guard():
+        loss = build()
+    scope, exe = Scope(), Executor()
+    ref = []
+    with scope_guard(scope):
+        exe.run(startup)
+        for i in range(6):
+            l, = exe.run(prog, feed=feed(i), fetch_list=[loss.name],
+                         sync=True)
+            ref.append(float(np.asarray(l)))
+
+    # interrupted run: 3 steps, save, reload elsewhere, 3 more steps
+    prog, startup = Program(), Program()
+    prog.random_seed = 5
+    with program_guard(prog, startup), unique_name.guard():
+        loss = build()
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        got = []
+        for i in range(3):
+            l, = exe.run(prog, feed=feed(i), fetch_list=[loss.name],
+                         sync=True)
+            got.append(float(np.asarray(l)))
+        fluid.io.save_train_model(str(tmp_path / "ckpt"), ["x", "y"],
+                                  loss, exe, main_program=prog,
+                                  startup_program=startup)
+
+    scope2, exe2 = Scope(), Executor()
+    with scope_guard(scope2):
+        main2, startup2, feeds2, loss2 = fluid.io.load_train_model(
+            str(tmp_path / "ckpt"), exe2)
+        exe2.run(startup2)
+        fluid.io.load_persistables(exe2, str(tmp_path / "ckpt"), main2)
+        for i in range(3, 6):
+            l, = exe2.run(main2, feed=feed(i), fetch_list=[loss2],
+                          sync=True)
+            got.append(float(np.asarray(l)))
+
+    np.testing.assert_allclose(got, ref, rtol=2e-4)

@@ -1,7 +1,9 @@
 """Distributed lookup table: sharded sparse embedding across pservers with
 remote prefetch (reference _distributed_lookup_table rewrite +
 prefetch_op.cc:27 + lookup_sparse_table semantics)."""
+import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -117,24 +119,47 @@ def test_dist_table_matches_local_sparse(optimizer):
 
     # program construction is single-threaded (process-global program/
     # unique_name state); only execution is concurrent
-    threads = []
+    ps_threads, tr_threads = [], []
     for i in range(2):
         t, _, _, _ = transpile(0)
-        threads.append(threading.Thread(
+        ps_threads.append(threading.Thread(
             target=ps, args=(t.get_startup_program(endpoints[i]),
                              t.get_pserver_program(endpoints[i])),
             daemon=True))
     for tid in range(2):
         t, prog, startup, loss = transpile(tid)
-        threads.append(threading.Thread(
+        tr_threads.append(threading.Thread(
             target=tr, args=(t, prog, t.get_trainer_startup_program(),
                              t.get_trainer_program(), loss, tid),
             daemon=True))
-    for th in threads:
-        th.start()
-    for th in threads:
-        th.join(timeout=180)
-        assert not th.is_alive(), "distributed table run timed out"
+    # deterministic startup: trainers launch only once both pservers
+    # announce readiness (ready-files; VERDICT r4 #5)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as ready_dir:
+        os.environ["PADDLE_READY_DIR"] = ready_dir
+        try:
+            for th in ps_threads:
+                th.start()
+            deadline = time.monotonic() + 120
+            while True:
+                if errors:  # a pserver died during bring-up — fail fast
+                    raise AssertionError(f"pserver bring-up failed: "
+                                         f"{errors}")
+                try:
+                    fluid.distributed.wait_server_ready(endpoints,
+                                                        timeout=0.5)
+                    break
+                except TimeoutError:
+                    if time.monotonic() > deadline:
+                        raise
+            for th in tr_threads:
+                th.start()
+            for th in tr_threads + ps_threads:
+                th.join(timeout=180)
+                assert not th.is_alive(), "distributed table run timed out"
+        finally:
+            os.environ.pop("PADDLE_READY_DIR", None)
     assert not errors, errors
 
     want = run_local(optimizer=optimizer)

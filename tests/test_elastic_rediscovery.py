@@ -73,11 +73,15 @@ def test_pserver_killed_and_restarted_on_new_port():
     with tempfile.TemporaryDirectory() as tmp:
         ckpt = os.path.join(tmp, "shards")
         progress = os.path.join(tmp, "progress.json")
+        env_base["PADDLE_READY_DIR"] = os.path.join(tmp, "ready")
         procs = []  # EVERY child registers here; the finally reaps all —
         # a leaked pserver (e.g. ps2 on a trainer timeout) would contend
         # on the registry and poison later attempts/tests
         ps1 = start_ps(ckpt=ckpt)
         procs.append(ps1)
+        # deterministic start: ps1 is listening before the trainer spawns
+        transport.wait_server_ready([logical_ep], timeout=240,
+                                    ready_dir=env_base["PADDLE_READY_DIR"])
         trainer = subprocess.Popen(
             [sys.executable, runner],
             env={**env_base, "PADDLE_TRAINING_ROLE": "TRAINER",

@@ -309,3 +309,38 @@ def test_deepfm_large_table_trains():
             losses.append(float(l))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_dense_grad_and_mask_single_scatter():
+    """VERDICT r4 #4: the masked-dense lazy update derives grad AND
+    touched-mask from ONE scatter-add (the count rides along as a
+    trailing column) — scatter-op count is the flat-cost binding term on
+    the tunneled chip, so this is pinned structurally."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.selected_rows import (SelectedRows,
+                                               dense_grad_and_mask)
+
+    rows = jnp.asarray(np.array([3, 1, 3, 7], np.int32))
+    vals = jnp.asarray(np.arange(16, dtype=np.float32).reshape(4, 4))
+    sr = SelectedRows(rows, vals, height=10)
+
+    def f(rows, vals):
+        return dense_grad_and_mask(SelectedRows(rows, vals, height=10))
+
+    jaxpr = jax.make_jaxpr(f)(rows, vals)
+    n_scatter = sum(str(eqn.primitive).startswith("scatter")
+                    for eqn in jaxpr.jaxpr.eqns)
+    assert n_scatter == 1, jaxpr
+
+    # and the semantics are unchanged: duplicates sum, mask is exact
+    gd, t = f(rows, vals)
+    want = np.zeros((10, 4), np.float32)
+    for r, v in zip(np.asarray(rows), np.asarray(vals)):
+        want[r] += v
+    np.testing.assert_allclose(np.asarray(gd), want)
+    np.testing.assert_array_equal(
+        np.asarray(t).ravel(),
+        [False, True, False, True, False, False, False, True, False,
+         False])

@@ -215,6 +215,10 @@ def main(argv=None):
             if role == "PSERVER":
                 exe.run(prog)          # serves until trainers complete
                 return
+            from paddle_tpu.distributed import wait_server_ready
+
+            wait_server_ready(os.environ["PADDLE_PSERVER_ENDPOINTS"]
+                              .split(","))
             run = lambda fd: exe.run(prog, feed=fd, fetch_list=[loss])
         elif args.parallel or args.update_method == "nccl2":
             exe = Executor()

@@ -39,6 +39,13 @@ MODULES = [
     "paddle_tpu.net_drawer",
     "paddle_tpu.debugger",
     "paddle_tpu.recordio_writer",
+    # distributed/parallel/inference surfaces (VERDICT r4 #6): these
+    # public classes churn the most — freeze them too
+    "paddle_tpu.distributed",
+    "paddle_tpu.parallel",
+    "paddle_tpu.inference",
+    "paddle_tpu.contrib.trainer",
+    "paddle_tpu.contrib.inferencer",
 ]
 
 

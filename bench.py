@@ -785,12 +785,14 @@ def main():
     budget_deadline = t_start + budget
 
     def emit_partial(name, result):
+        # partials go to STDERR: stdout stays exactly ONE JSON line (the
+        # driver contract), while a timeout-killed run still leaves the
+        # finished configs readable in the captured stderr tail
         print(json.dumps({"partial": True, "config": name,
-                          "result": result}), flush=True)
+                          "result": result}), file=sys.stderr, flush=True)
 
     probe = _probe(budget_deadline)
-    print(json.dumps({"partial": True, "config": "_tunnel_probe",
-                      "result": probe}), flush=True)
+    emit_partial("_tunnel_probe", probe)
 
     configs = {}
     pending = [(n, dl, tpu) for n, _, dl, tpu in _config_table()]

@@ -51,12 +51,16 @@ def _run_bench(tmp_path, table_src, env_extra, timeout=180):
     env.update(env_extra)
     out = subprocess.run([sys.executable, BENCH], env=env, cwd=REPO,
                          capture_output=True, text=True, timeout=timeout)
-    lines = [json.loads(l) for l in out.stdout.splitlines()
-             if l.startswith("{")]
-    assert lines, f"no JSON lines:\n{out.stdout}\n{out.stderr}"
-    partials = [l for l in lines if l.get("partial")]
-    finals = [l for l in lines if "metric" in l]
-    assert len(finals) == 1, out.stdout
+    # contract: stdout is EXACTLY one JSON line (the driver parses it);
+    # incremental partials stream to stderr
+    stdout_lines = out.stdout.strip().splitlines()
+    assert len(stdout_lines) == 1, (
+        f"stdout not one line:\n{out.stdout}\nstderr:\n{out.stderr}")
+    finals = [json.loads(stdout_lines[0])]
+    assert "metric" in finals[0], out.stdout
+    partials = [json.loads(l) for l in out.stderr.splitlines()
+                if l.startswith('{"partial"')]
+    assert partials, f"no partial lines on stderr:\n{out.stderr}"
     return partials, finals[0]
 
 

@@ -162,3 +162,32 @@ def test_save_load_train_model_roundtrip(tmp_path):
             got.append(float(np.asarray(l)))
 
     np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+
+def test_save_train_model_roundtrips_random_seed(tmp_path):
+    """Program.to_dict covers blocks only; save_train_model must carry
+    the seed too or a resumed dropout stream diverges from the save-time
+    contract (r5 review finding)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 5
+    startup.random_seed = 7
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        h = fluid.layers.dropout(fluid.layers.fc(x, 8), 0.5)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_train_model(str(tmp_path), ["x"], loss, exe,
+                                  main_program=prog,
+                                  startup_program=startup)
+    main2, startup2, _, _ = fluid.io.load_train_model(str(tmp_path),
+                                                      Executor())
+    assert main2.random_seed == 5
+    assert startup2.random_seed == 7

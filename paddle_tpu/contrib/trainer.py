@@ -187,3 +187,16 @@ class Trainer:
             _io.save_inference_model(param_path, list(feeded_var_names),
                                      targets, self.exe,
                                      main_program=self.train_program)
+
+    def save_train_model(self, dirname: str,
+                         feeded_var_names: Sequence[str]) -> None:
+        """Export the TRAINABLE model (full programs + optimizer state)
+        in the fluid.io.save_train_model layout, so training can be
+        continued by the native C trainer (pt_trainer_*) or another
+        Python process — the deployment handoff the reference's
+        fluid/train demo consumes."""
+        with scope_guard(self.scope):
+            _io.save_train_model(dirname, list(feeded_var_names),
+                                 self.loss, self.exe,
+                                 main_program=self.train_program,
+                                 startup_program=self.startup_program)

@@ -46,6 +46,23 @@ def ctr_deepfm(dense_input, sparse_ids, sparse_field_count, sparse_dim,
     return logit
 
 
+def tp_sharding_rules():
+    """Model-parallel PartitionSpecs for ParallelExecutor
+    (BuildStrategy.sharding_rules): both CTR tables row-sharded over the
+    ``mp`` mesh axis — the mesh-native analogue of the pserver path's
+    sharded distributed lookup table, for tables too large for one
+    chip's HBM.  GSPMD inserts the cross-shard gathers; the lazy
+    optimizer state (Adam moments) inherits the same row sharding."""
+    return [
+        # trailing .* catches the optimizer accumulators
+        # (ctr.sparse_emb_moment1_0, ...) so Adam state shards with its
+        # table; scalar accumulators fail the divisibility guard and
+        # stay replicated
+        (r"ctr\.sparse_emb.*", ("mp", None)),
+        (r"ctr\.sparse_w1.*", ("mp", None)),
+    ]
+
+
 def build(dense_dim=13, sparse_fields=26, sparse_dim=int(1e5), embed_dim=10,
           lr=1e-4, with_optimizer=True):
     dense = fluid.layers.data("dense", [dense_dim])

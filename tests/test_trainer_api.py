@@ -168,3 +168,45 @@ def test_py_reader_tensor_provider_mode():
     got = [float(exe.run(prog, feed=fd, fetch_list=[s], scope=scope)[0])
            for fd in reader.start()]
     assert got == [0.0, 1.0, 2.0]
+
+
+def test_trainer_save_train_model_handoff(tmp_path):
+    """Trainer.save_train_model exports the native-trainable layout:
+    another process (Python here; the C trainer in test_capi_train.py)
+    loads it and CONTINUES training from the same state."""
+    from paddle_tpu.contrib.trainer import Trainer
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+
+    t = Trainer(train_func=_train_func, optimizer_func=_opt_func)
+
+    def handler(event):
+        pass
+
+    t.train(num_epochs=1, event_handler=handler, reader=_reader,
+            feed_order=["x", "y"])
+    out = str(tmp_path / "handoff")
+    t.save_train_model(out, ["x", "y"])
+    trained = {p.name: np.asarray(t.scope.find_var(p.name))
+               for p in t.train_program.all_parameters()}
+
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        main, startup, feeds, loss = fluid.io.load_train_model(out, exe)
+        assert feeds == ["x", "y"]
+        exe.run(startup)
+        fluid.io.load_persistables(exe, out, main)
+        # the restore is bit-exact: loaded params == the Trainer's
+        # trained state, not a re-init
+        for name, want in trained.items():
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_var(name)), want, err_msg=name)
+        rng = np.random.RandomState(1)
+        w = rng.randn(4, 1).astype("float32")
+        losses = []
+        for _ in range(6):
+            x = rng.randn(16, 4).astype("float32")
+            l, = exe.run(main, feed={"x": x, "y": (x @ w).astype("float32")},
+                         fetch_list=[loss], sync=True)
+            losses.append(float(np.asarray(l)))
+    # and continued training keeps optimizing (no blowup)
+    assert losses[-1] < losses[0] * 1.5

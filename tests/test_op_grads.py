@@ -216,18 +216,22 @@ def test_gru_grad():
                rtol=5e-3, atol=5e-4)
 
 
-def test_sequence_pool_grad():
-    lens = np.array([2, 3], dtype=np.int32)
-
+def _seq_pool_build(pooltype):
     def build(v):
         from paddle_tpu.layer_helper import LayerHelper
         helper = LayerHelper("sp_test")
         out = helper.create_variable_for_type_inference("float64", shape=(2, 4))
         helper.append_op("sequence_pool",
                          {"X": [v["x"]], "SeqLen": [v["len"]]},
-                         {"Out": [out]}, {"pooltype": "AVERAGE"})
+                         {"Out": [out]}, {"pooltype": pooltype})
         return out
-    check_grad(build, {"x": f64(2, 3, 4), "len": lens}, wrt=["x"])
+    return build
+
+
+def test_sequence_pool_grad():
+    lens = np.array([2, 3], dtype=np.int32)
+    check_grad(_seq_pool_build("AVERAGE"), {"x": f64(2, 3, 4), "len": lens},
+               wrt=["x"])
 
 
 def test_scale_clip_grad():
@@ -295,3 +299,14 @@ def test_calc_gradient_matches_numeric():
         num.reshape(-1)[i] = (f(xp.reshape(xv.shape))
                               - f(xm.reshape(xv.shape))) / (2 * eps)
     np.testing.assert_allclose(np.asarray(analytic), num, rtol=1e-5)
+
+
+def test_sequence_pool_max_zero_length_slot_grad():
+    """MAX pooling with a zero-length row (legal in the nested level-2
+    contract) routes zero gradient to that row and exact max-gradients
+    elsewhere — the r5 alive-mask must not break autodiff."""
+    lens = np.array([3, 0], dtype=np.int32)
+    # well-separated values keep the max unique (no subgradient kinks)
+    x = f64(2, 3, 4)
+    x += np.arange(3)[None, :, None] * 2.0
+    check_grad(_seq_pool_build("MAX"), {"x": x, "len": lens}, wrt=["x"])

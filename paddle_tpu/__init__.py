@@ -53,9 +53,11 @@ from .core.program import (  # noqa: F401
     Variable,
     default_main_program,
     default_startup_program,
+    name_scope,
     program_guard,
 )
 from .core import unique_name  # noqa: F401
+from . import executor, framework  # noqa: F401  (fluid.framework idioms)
 from .data_feeder import DataFeeder  # noqa: F401
 from .distributed import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .contrib import (  # noqa: F401

@@ -139,6 +139,48 @@ class Variable:
         )
 
 
+class _NameScope:
+    """Hierarchical debug-name prefixes with sibling dedup (reference
+    framework.py:53 NameScope — second ``with name_scope("fc")`` at the
+    same level becomes ``fc_1``)."""
+
+    def __init__(self, name: str = "", parent: "_NameScope" = None):
+        self._children: Dict[str, int] = {}
+        self._name = name
+        self._parent = parent
+
+    def child(self, prefix: str) -> "_NameScope":
+        n = self._children.get(prefix, 0)
+        self._children[prefix] = n + 1
+        return _NameScope(prefix if n == 0 else f"{prefix}_{n}", self)
+
+
+_name_scope = _NameScope()
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    """Prefix ops created in this block with a hierarchical debug name
+    (reference framework.py:80 — visualization/debugging only; carried
+    on each op as the ``op_namescope`` attr)."""
+    assert prefix, "name_scope prefix cannot be empty"
+    global _name_scope
+    _name_scope = _name_scope.child(prefix)
+    try:
+        yield
+    finally:
+        _name_scope = _name_scope._parent
+
+
+def _full_name_scope() -> str:
+    parts = []
+    s = _name_scope
+    while s is not None and s._name:
+        parts.append(s._name)
+        s = s._parent
+    return "/".join(reversed(parts))
+
+
 class Operator:
     """One node: type + name-keyed input/output var-name lists + typed attrs
     (reference OpDesc, framework.proto:42; python Operator, framework.py:494).
@@ -285,12 +327,21 @@ class Block:
     # -- ops ---------------------------------------------------------------
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs, outputs, attrs)
+        # stamp the debug name_scope at CREATION time only — never in
+        # Operator.__init__, which from_dict/clone also route through
+        # (deserialization must restore attrs verbatim)
+        ns = _full_name_scope()
+        if ns:
+            op.attrs.setdefault("op_namescope", f"/{ns}/")
         self.ops.append(op)
         self.program._version += 1
         return op
 
     def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs, outputs, attrs)
+        ns = _full_name_scope()
+        if ns:
+            op.attrs.setdefault("op_namescope", f"/{ns}/")
         self.ops.insert(0, op)
         self.program._version += 1
         return op

@@ -70,3 +70,37 @@ def test_op_roles():
     assert OpRole.Optimize in roles
     sgd_ops = [op for op in prog.global_block.ops if op.type == "sgd"]
     assert len(sgd_ops) == 2  # w and b
+
+
+def test_name_scope_hierarchy_and_compat_modules():
+    """fluid.name_scope stamps hierarchical op_namescope attrs with
+    sibling dedup (reference framework.py:80), and the fluid.framework /
+    fluid.executor module spellings resolve to the same objects."""
+    import paddle_tpu as fluid
+
+    assert fluid.framework.Program is fluid.Program
+    assert fluid.framework.name_scope is fluid.name_scope
+    assert fluid.executor.Executor is fluid.Executor
+    assert fluid.executor.global_scope is fluid.global_scope
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        with fluid.name_scope("enc"):
+            h = fluid.layers.fc(x, 8)
+            with fluid.name_scope("attn"):
+                h = fluid.layers.fc(h, 8)
+        with fluid.name_scope("enc"):  # sibling: dedups to enc_1
+            h = fluid.layers.fc(h, 4)
+        fluid.layers.fc(h, 2)          # outside any scope: no attr
+    ns = [op.attrs.get("op_namescope") for op in prog.global_block.ops]
+    assert "/enc/" in ns and "/enc/attn/" in ns and "/enc_1/" in ns, ns
+    assert None in ns
+    # the attr survives program serialization (it is a plain string) —
+    # and deserializing INSIDE an active scope must restore verbatim,
+    # not stamp the caller's scope onto unscoped ops (clone-under-scope
+    # is a common fluid idiom)
+    with fluid.name_scope("outer"):
+        clone = Program.from_dict(prog.to_dict())
+    ns2 = [op.attrs.get("op_namescope") for op in clone.global_block.ops]
+    assert ns2 == ns

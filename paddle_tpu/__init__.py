@@ -47,6 +47,13 @@ from . import profiler  # noqa: F401
 from .lod_tensor import (  # noqa: F401
     LoDTensor, create_lod_tensor, create_random_int_lodtensor)
 Tensor = LoDTensor  # reference __init__.py:51 alias
+LoDTensorArray = list  # reference core type: a list of LoDTensors
+# `from . import annotations` would silently resolve to the _Feature
+# bound by `from __future__ import annotations` above (the import system
+# short-circuits on an existing attribute) — rebind explicitly
+import importlib as _importlib  # noqa: E402
+annotations = _importlib.import_module(__name__ + ".annotations")
+from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401,E402  (reference fluid.learning_rate_decay spelling)
 from .core.executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .core.program import (  # noqa: F401
     Program,
@@ -81,6 +88,7 @@ from .parallel import (  # noqa: F401
 from . import platform  # noqa: F401
 from .platform import (  # noqa: F401
     CPUPlace,
+    CUDAPinnedPlace,
     CUDAPlace,
     DeviceContext,
     DeviceContextPool,

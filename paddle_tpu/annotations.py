@@ -1,0 +1,32 @@
+"""fluid.annotations (reference python/paddle/fluid/annotations.py:19):
+the ``deprecated`` decorator — warns once per call site with the
+since-version and replacement API."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(since, instead, extra_message=""):
+    """Mark an API deprecated since ``since``; point users at
+    ``instead``."""
+
+    def decorator(func):
+        err_msg = (f"API {func.__name__} is deprecated since {since}. "
+                   f"Please use {instead} instead.")
+        if extra_message:
+            full_msg = err_msg + "\n" + extra_message
+        else:
+            full_msg = err_msg
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(full_msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (err_msg + "\n\n" + (func.__doc__ or ""))
+        return wrapper
+
+    return decorator

@@ -47,8 +47,23 @@ class TPUPlace:
         return f"TPUPlace({self.device_id})"
 
 
+class CUDAPinnedPlace:
+    """Pinned-host tag (place.h:45).  On TPU, host staging buffers are
+    managed by the runtime/PJRT, so this is a compat tag that behaves
+    like CPUPlace for placement decisions."""
+
+    def __eq__(self, other):
+        return isinstance(other, CUDAPinnedPlace)
+
+    def __hash__(self):
+        return hash("pinned")
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
 CUDAPlace = TPUPlace  # reference-compat alias
-Place = Union[CPUPlace, TPUPlace]
+Place = Union[CPUPlace, TPUPlace, CUDAPinnedPlace]
 
 
 def is_tpu_place(p) -> bool:

@@ -148,3 +148,31 @@ def test_tools_kube_gen_job_and_timeline(tmp_path):
     assert r.returncode == 0, r.stderr
     tl = json.load(open(out))
     assert {e.get("pid") for e in tl["traceEvents"]} == {0, 1}
+
+
+def test_reference_top_level_compat_names():
+    """The reference fluid top-level __all__ resolves completely,
+    including the traps: ``fluid.annotations`` must be the module (not
+    the __future__ _Feature the import system short-circuits to), and
+    learning_rate_decay is the scheduler module under its reference
+    spelling."""
+    import warnings
+
+    import paddle_tpu as fluid
+
+    assert callable(fluid.annotations.deprecated)
+
+    @fluid.annotations.deprecated("1.0", "new_api")
+    def legacy():
+        return 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert legacy() == 7
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+    assert fluid.learning_rate_decay.exponential_decay is \
+        fluid.layers.learning_rate_scheduler.exponential_decay
+    assert fluid.LoDTensorArray is list
+    assert fluid.CUDAPinnedPlace() == fluid.CUDAPinnedPlace()
+    assert fluid.CUDAPinnedPlace() != fluid.CPUPlace()

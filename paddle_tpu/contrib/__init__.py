@@ -5,6 +5,7 @@ from .inferencer import Inferencer
 from .mixed_precision import Float16Transpiler, transpile_to_bf16
 from .quantize import QuantizeTranspiler
 from .introspection import memory_usage, op_freq_statistic
+from . import decoder  # noqa: F401  (InitState/StateCell/*Decoder)
 
 __all__ = ["Trainer", "Inferencer", "BeginEpochEvent", "EndEpochEvent",
            "BeginStepEvent", "EndStepEvent", "CheckpointConfig",

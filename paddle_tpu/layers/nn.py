@@ -575,6 +575,14 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
     return out
 
 
+def _tile_rows(x, times):
+    """[B, ...] -> [B*times, ...] repeating each row (beam fan-out;
+    shared by models/machine_translation.py and contrib/decoder.py)."""
+    expanded = expand(unsqueeze(x, [1]),
+                      [1, times] + [1] * (len(x.shape) - 1))
+    return reshape(expanded, [-1] + list(x.shape[1:]))
+
+
 def topk(input, k, name=None):
     helper = LayerHelper("top_k", name=name)
     shp = tuple(input.shape[:-1]) + (k,)

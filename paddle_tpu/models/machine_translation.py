@@ -169,9 +169,4 @@ def _last_state(enc, src_mask):
     return L.sequence_last_step(enc)
 
 
-def _tile_rows(x, times):
-    """[B, ...] → [B*times, ...] repeating each row (beam expansion)."""
-    expanded = L.expand(L.unsqueeze(x, [1]),
-                        [1, times] + [1] * (len(x.shape) - 1))
-    new_shape = [-1] + list(x.shape[1:])
-    return L.reshape(expanded, new_shape)
+from paddle_tpu.layers.nn import _tile_rows  # shared beam fan-out

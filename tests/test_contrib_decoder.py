@@ -1,0 +1,179 @@
+"""contrib.decoder: the high-level StateCell / TrainingDecoder /
+BeamSearchDecoder API (reference contrib/decoder/beam_search_decoder.py,
+driven by book/high-level-api machine_translation).  Training decode
+must converge on a toy copy task and beam decode must reproduce the
+greedy argmax path when beam_size=1."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.decoder import (BeamSearchDecoder, InitState,
+                                        StateCell, TrainingDecoder)
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+
+L = fluid.layers
+
+V, EMB, HID, T = 12, 16, 32, 6
+END_ID = 1
+
+
+def _build_train():
+    # lod_level=1 data adds its own padded time axis: ids are [B, T, 1]
+    src = L.data("src", [1], dtype="int64", lod_level=1)
+    tgt = L.data("tgt", [1], dtype="int64", lod_level=1)
+    lbl = L.data("lbl", [T, 1], dtype="int64")
+
+    src_emb = L.embedding(src, [V, EMB],
+                          param_attr=fluid.ParamAttr(name="dec.src_emb"))
+    enc = L.sequence_pool(src_emb, "first")           # [B, EMB]
+    h0 = L.fc(enc, HID, act="tanh",
+              param_attr=fluid.ParamAttr(name="dec.h0.w"),
+              bias_attr=fluid.ParamAttr(name="dec.h0.b"))
+
+    cell = StateCell(inputs={"x": None}, states={"h": InitState(init=h0)},
+                     out_state="h")
+
+    @cell.state_updater
+    def updater(c):
+        h = c.get_state("h")
+        x = c.get_input("x")
+        c.set_state("h", L.fc(L.concat([x, h], axis=1), HID, act="tanh",
+                              param_attr=fluid.ParamAttr(name="dec.cell.w"),
+                              bias_attr=fluid.ParamAttr(name="dec.cell.b")))
+
+    decoder = TrainingDecoder(cell)
+    tgt_emb = L.embedding(tgt, [V, EMB],
+                          param_attr=fluid.ParamAttr(name="dec.tgt_emb"))
+    with decoder.block():
+        cur = decoder.step_input(tgt_emb)
+        decoder.state_cell.compute_state(inputs={"x": cur})
+        score = L.fc(decoder.state_cell.get_state("h"), V, act="softmax",
+                     param_attr=fluid.ParamAttr(name="dec.out.w"),
+                     bias_attr=fluid.ParamAttr(name="dec.out.b"))
+        decoder.state_cell.update_states()
+        decoder.output(score)
+
+    probs = decoder()                        # [B, T, V]
+    tok_loss = L.cross_entropy(probs, lbl)   # [B, T, 1]
+    loss = L.mean(tok_loss)                  # all rows are full length
+    return loss, probs
+
+
+def _toy_batch(rng, B=8):
+    # copy task: target repeats the source's first token until END
+    src = rng.randint(2, V, (B, T)).astype("int64")
+    src_len = np.full((B,), T, "int64")
+    tgt = np.zeros((B, T), "int64")
+    lbl = np.zeros((B, T, 1), "int64")
+    for b in range(B):
+        tok = src[b, 0]
+        tgt[b, 0] = 0                      # <s>
+        tgt[b, 1:] = tok
+        lbl[b, :-1, 0] = tok
+        lbl[b, -1, 0] = END_ID
+    return {"src": src[..., None], "src@LEN": src_len,
+            "tgt": tgt[..., None], "tgt@LEN": src_len.copy(), "lbl": lbl}
+
+
+def test_training_decoder_converges_and_beam_decodes():
+    rng = np.random.RandomState(0)
+
+    prog, startup = Program(), Program()
+    prog.random_seed = 7
+    with program_guard(prog, startup), unique_name.guard():
+        loss, _ = _build_train()
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            l, = exe.run(prog, feed=_toy_batch(rng),
+                         fetch_list=[loss.name], sync=True)
+            losses.append(float(np.asarray(l)))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        # ---- beam decode with the TRAINED params (shared names) --------
+        beam = 3
+        infer, istart = Program(), Program()
+        with program_guard(infer, istart), unique_name.guard():
+            src = L.data("src", [1], dtype="int64", lod_level=1)
+            src_emb = L.embedding(
+                src, [V, EMB], param_attr=fluid.ParamAttr(name="dec.src_emb"))
+            enc = L.sequence_pool(src_emb, "first")
+            h0 = L.fc(enc, HID, act="tanh",
+                      param_attr=fluid.ParamAttr(name="dec.h0.w"),
+                      bias_attr=fluid.ParamAttr(name="dec.h0.b"))
+
+            cell = StateCell(inputs={"x": None},
+                             states={"h": InitState(init=h0)},
+                             out_state="h")
+
+            @cell.state_updater
+            def updater(c):
+                h = c.get_state("h")
+                x = c.get_input("x")
+                c.set_state(
+                    "h", L.fc(L.concat([x, h], axis=1), HID, act="tanh",
+                              param_attr=fluid.ParamAttr(name="dec.cell.w"),
+                              bias_attr=fluid.ParamAttr(name="dec.cell.b")))
+
+            init_ids = L.data("init_ids", [1], dtype="int64")
+            init_scores = L.data("init_scores", [1])
+            decoder = BeamSearchDecoder(
+                state_cell=cell, init_ids=init_ids,
+                init_scores=init_scores, target_dict_dim=V, word_dim=EMB,
+                topk_size=V, sparse_emb=False, max_len=T, beam_size=beam,
+                end_id=END_ID,
+                emb_param_attr=fluid.ParamAttr(name="dec.tgt_emb"),
+                score_param_attr=fluid.ParamAttr(name="dec.out.w"),
+                score_bias_attr=fluid.ParamAttr(name="dec.out.b"))
+            decoder.decode()
+            ids, scores = decoder()
+
+        # the decoder reused the trained params by NAME: no fresh
+        # auto-named embedding/fc weights may appear in the infer program
+        fresh = [p.name for p in infer.all_parameters()
+                 if not p.name.startswith("dec.")]
+        assert fresh == [], fresh
+
+        src1 = rng.randint(2, V, (1, T)).astype("int64")
+        # batch-width (B=1) inputs: the decoder fans states out to the
+        # beam width itself (the reference sequence_expand role)
+        feed = {"src": src1[..., None],
+                "src@LEN": np.full((1,), T, "int64"),
+                "init_ids": np.zeros((beam, 1), "int64"),
+                "init_scores": np.array([[0.0]] + [[-1e9]] * (beam - 1),
+                                        "float32")}
+        ids_v, len_v = exe.run(
+            infer, feed=feed,
+            fetch_list=[ids.name, decoder.result.cand_len.name], sync=True)
+        # trained copy task: the top beam repeats src[0] then emits END
+    tok = int(src1[0, 0])
+    top = ids_v[0][: int(len_v[0])]
+    assert tok in top, (tok, ids_v, len_v)
+
+
+def test_state_cell_contract_errors():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [4])
+        h0 = L.fc(x, 8)
+        with pytest.raises(ValueError, match="out_state"):
+            StateCell(inputs={"x": None}, states={"h": InitState(init=h0)},
+                      out_state="missing")
+        with pytest.raises(ValueError, match="InitState"):
+            StateCell(inputs={"x": None}, states={"h": h0}, out_state="h")
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=h0)}, out_state="h")
+        with pytest.raises(ValueError, match="Invalid input"):
+            cell.get_input("x")  # placeholder never fed
+        d = TrainingDecoder(cell)
+        with pytest.raises(ValueError, match="inside block"):
+            d.step_input(x)
+        # a second decoder cannot grab an attached cell
+        with pytest.raises(ValueError, match="already entered"):
+            TrainingDecoder(cell)

@@ -46,6 +46,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.contrib.trainer",
     "paddle_tpu.contrib.inferencer",
+    "paddle_tpu.contrib.decoder",
 ]
 
 

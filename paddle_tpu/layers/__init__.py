@@ -30,6 +30,7 @@ from . import nn_extras  # noqa: F401
 from .nn_extras import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401
+    argmin,
     assign,
     create_global_var,
     create_parameter,

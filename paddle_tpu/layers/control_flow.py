@@ -405,11 +405,9 @@ class DynamicRNN(StaticRNN):
 
 
 def less_than(x, y, cond=None):
-    helper = LayerHelper("less_than")
-    if cond is None:
-        cond = helper.create_variable_for_type_inference("bool", shape=x.shape)
-    helper.append_op("less_than", {"X": [x], "Y": [y]}, {"Out": [cond]})
-    return cond
+    from .nn import _cmp_layer
+
+    return _cmp_layer("less_than", x, y, cond)
 
 
 # ---------------------------------------------------------------------------

@@ -16,23 +16,31 @@ from . import tensor
 LR_COUNTER = "@LR_DECAY_COUNTER@"
 
 
-def _decay_step_counter(begin=0):
+def _step_counter(name, begin=0, step=1.0):
+    """Create-or-return a persistable auto-incrementing counter var
+    (one increment prepended per run).  Distinct names give independent
+    counters — the LR schedulers share LR_COUNTER; the public
+    autoincreased_step_counter defaults to its own @STEP_COUNTER@
+    (reference layers/nn.py:~autoincreased_step_counter)."""
     helper = LayerHelper("global_step_counter")
     program = default_main_program()
     gb = program.global_block
-    if gb.has_var(LR_COUNTER):
-        return gb.vars[LR_COUNTER]
+    if gb.has_var(name):
+        return gb.vars[name]
     counter = helper.create_global_variable(
-        shape=(), dtype="float32", persistable=True, name=LR_COUNTER)
-    # the prepended increment runs before any read, so start at begin-1 to
-    # make schedules observe `begin` on the first step (reference
-    # layers/nn.py autoincreased_step_counter semantics)
+        shape=(), dtype="float32", persistable=True, name=name)
+    # the prepended increment runs before any read, so start at
+    # begin-step to make the first run observe `begin`
     helper.set_variable_initializer(
-        counter, ConstantInitializer(float(begin) - 1.0))
+        counter, ConstantInitializer(float(begin) - float(step)))
     with program.op_role_guard(OpRole.LRSched):
-        gb.prepend_op("increment", {"X": [LR_COUNTER]}, {"Out": [LR_COUNTER]},
-                      {"step": 1.0, OP_ROLE_ATTR: OpRole.LRSched})
+        gb.prepend_op("increment", {"X": [name]}, {"Out": [name]},
+                      {"step": float(step), OP_ROLE_ATTR: OpRole.LRSched})
     return counter
+
+
+def _decay_step_counter(begin=0):
+    return _step_counter(LR_COUNTER, begin=begin, step=1.0)
 
 
 def _sched_op(helper, type, ins, attrs=None, shape=()):

@@ -709,6 +709,17 @@ def clip_by_norm(x, max_norm, name=None):
     return _unary("clip_by_norm", x, attrs={"max_norm": max_norm})
 
 
+def _cmp_layer(op_type, x, y, cond=None, name=None):
+    """Shared comparison/logical wrapper (less_than + the r5 equal/
+    logical family)."""
+    helper = LayerHelper(op_type, name=name)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool",
+                                                         shape=x.shape)
+    helper.append_op(op_type, {"X": [x], "Y": [y]}, {"Out": [cond]})
+    return cond
+
+
 def sums(input, out=None):
     helper = LayerHelper("sum")
     out = out or helper.create_variable_for_type_inference(

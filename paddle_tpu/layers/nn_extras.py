@@ -11,9 +11,14 @@ import numpy as np
 
 from ..initializer import ConstantInitializer, NormalInitializer
 from ..layer_helper import LayerHelper
-from .nn import _pair, seq_len_var, _alias_len, _seq_op_with_len
+from .nn import (_pair, seq_len_var, _alias_len, _seq_op_with_len,
+                 _cmp_layer)
 
 __all__ = [
+    "equal", "not_equal", "less_equal", "greater_than",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "is_empty", "isfinite", "has_inf", "has_nan", "sum", "Print",
+    "autoincreased_step_counter", "append_LARS",
     "cos_sim", "hinge_loss", "log_loss", "rank_loss", "margin_rank_loss",
     "modified_huber_loss", "squared_l2_distance", "squared_l2_norm",
     "l1_norm", "bilinear_tensor_product", "minus", "label_smooth",
@@ -731,3 +736,153 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
                       "output_dim_idx": output_dim_idx, "mean": mean,
                       "std": std, "seed": seed, "dtype": dtype})
     return out
+
+
+# -- comparison / logical / guard surface (reference layers/control_flow.py
+# equal + layers/ops auto-gen logical family + isfinite_op family) --------
+
+def equal(x, y, cond=None, name=None):
+    """Elementwise x == y (reference control_flow.py equal)."""
+    return _cmp_layer("equal", x, y, cond, name)
+
+
+def not_equal(x, y, cond=None, name=None):
+    return _cmp_layer("not_equal", x, y, cond, name)
+
+
+def less_equal(x, y, cond=None, name=None):
+    return _cmp_layer("less_equal", x, y, cond, name)
+
+
+def greater_than(x, y, cond=None, name=None):
+    return _cmp_layer("greater_than", x, y, cond, name)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _cmp_layer("logical_and", x, y, out, name)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _cmp_layer("logical_or", x, y, out, name)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _cmp_layer("logical_xor", x, y, out, name)
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool",
+                                                        shape=x.shape)
+    helper.append_op("logical_not", {"X": [x]}, {"Out": [out]})
+    return out
+
+
+def is_empty(x, cond=None, name=None):
+    """[1]-shaped bool: does x have zero elements (is_empty_op.cc —
+    the op emits a 1-element array, matching the reference's [1]
+    output)."""
+    helper = LayerHelper("is_empty", name=name)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(
+            "bool", shape=(1,), stop_gradient=True)
+    helper.append_op("is_empty", {"X": [x]}, {"Out": [cond]})
+    return cond
+
+
+def isfinite(x, name=None):
+    """Scalar bool: every element finite (isfinite_op.cc)."""
+    helper = LayerHelper("isfinite", name=name)
+    out = helper.create_variable_for_type_inference(
+        "bool", shape=(), stop_gradient=True)
+    helper.append_op("isfinite", {"X": [x]}, {"Out": [out]})
+    return out
+
+
+def has_inf(x, name=None):
+    """Scalar bool: any element infinite (overflow-guard family)."""
+    helper = LayerHelper("has_inf", name=name)
+    out = helper.create_variable_for_type_inference(
+        "bool", shape=(), stop_gradient=True)
+    helper.append_op("has_inf", {"X": [x]}, {"Out": [out]})
+    return out
+
+
+def has_nan(x, name=None):
+    helper = LayerHelper("has_nan", name=name)
+    out = helper.create_variable_for_type_inference(
+        "bool", shape=(), stop_gradient=True)
+    helper.append_op("has_nan", {"X": [x]}, {"Out": [out]})
+    return out
+
+
+def sum(x, name=None):  # noqa: A001 — reference layer name
+    """Sum a LIST of same-shaped tensors (sum_op.cc; the reference
+    fluid.layers.sum — delegates to sums(), which also propagates the
+    sequence-length alias).  For one tensor's reduction use
+    ``reduce_sum``."""
+    from .nn import sums
+
+    return sums(list(x) if isinstance(x, (list, tuple)) else [x])
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug-print a tensor each execution and pass it through
+    (reference control_flow.py Print / print_op.cc; lowers to
+    jax.debug.print — the formatting knobs are accepted for API parity,
+    the printed payload is the runtime array)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    shape=input.shape)
+    helper.append_op("print", {"In": [input]}, {"Out": [out]},
+                     {"message": message or "",
+                      "first_n": first_n, "summarize": summarize,
+                      "print_phase": print_phase})
+    if seq_len_var(input) is not None:  # identity op: keep the length
+        _alias_len(out, seq_len_var(input))
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 step counter incremented once per run
+    (reference layers/nn.py autoincreased_step_counter — the global-step
+    the LR schedulers consume)."""
+    from .learning_rate_scheduler import _step_counter
+
+    return _step_counter(counter_name or "@STEP_COUNTER@",
+                         begin=begin, step=step)
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """Layer-wise Adaptive Rate Scaling: per-param LR =
+    lr * ||param|| / (||grad|| + weight_decay * ||param||)
+    (reference layers/nn.py append_LARS)."""
+    from .nn import elementwise_add, elementwise_div, elementwise_mul
+    from .nn import scale as _scale
+
+    def _norm(v):
+        helper = LayerHelper("l2_norm")
+        out = helper.create_variable_for_type_inference(v.dtype, shape=())
+        helper.append_op("squared_l2_norm", {"X": [v]}, {"Out": [out]})
+        return sqrt_layer(out)
+
+    def sqrt_layer(v):
+        helper = LayerHelper("sqrt")
+        out = helper.create_variable_for_type_inference(v.dtype,
+                                                        shape=v.shape)
+        helper.append_op("sqrt", {"X": [v]}, {"Out": [out]})
+        return out
+
+    decayed = []
+    for param, grad in params_grads:
+        p_norm = _norm(param)
+        g_norm = _norm(grad)
+        denom = elementwise_add(g_norm,
+                                _scale(p_norm, scale=float(weight_decay)))
+        ratio = elementwise_div(p_norm, denom)
+        decayed.append(elementwise_mul(ratio, learning_rate, axis=0))
+    return decayed

@@ -293,6 +293,25 @@ def _isfinite(ctx, ins, attrs):
     return {"Out": [ok]}
 
 
+@register("has_inf")
+def _has_inf(ctx, ins, attrs):
+    # reference overflow ops (isfinite_op.cc InfinityFunctor family)
+    return {"Out": [jnp.any(jnp.isinf(ins["X"][0]))]}
+
+
+@register("has_nan")
+def _has_nan(ctx, ins, attrs):
+    return {"Out": [jnp.any(jnp.isnan(ins["X"][0]))]}
+
+
+@register("soft_relu")
+def _soft_relu(ctx, ins, attrs):
+    # activation_op.cc SoftReluFunctor: log(1 + exp(clip(x, -t, t)))
+    t = attrs.get("threshold", 40.0)
+    x = ins["X"][0]
+    return {"Out": [jnp.log1p(jnp.exp(jnp.clip(x, -t, t)))]}
+
+
 @register("sign")
 def _sign(ctx, ins, attrs):
     return {"Out": [jnp.sign(ins["X"][0])]}

@@ -213,3 +213,62 @@ def test_fusion_seqexpand_concat_fc():
     (out,) = run_forward(build, {"s": seq, "r": row, "w": w})
     cat = np.concatenate([seq, np.repeat(row[:, None], T, 1)], -1)
     np.testing.assert_allclose(out, np.maximum(cat @ w, 0), rtol=1e-6)
+
+
+def test_layer_surface_tail_round5():
+    """r5 surface completion: comparison/logical/guard/sum/Print/
+    argmin/soft_relu/append_LARS flat layer names (reference layers
+    __all__ diff)."""
+    import warnings
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    L = fluid.layers
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [4])
+        y = L.data("y", [4])
+        eq = L.equal(x, y)
+        ne = L.not_equal(x, y)
+        lo = L.logical_or(eq, ne)
+        fin = L.isfinite(x)
+        hi = L.has_inf(x)
+        hn = L.has_nan(x)
+        emp = L.is_empty(x)
+        s3 = L.sum([x, y])
+        pr = L.Print(s3, message="dbg")
+        sr = L.soft_relu(x, threshold=20.0)
+        am = L.argmin(x, axis=1)
+        ctr = L.autoincreased_step_counter()
+        w = L.create_parameter([4, 2], "float32", name="lars.w")
+        g = L.reduce_mean(L.fc(x, 2,
+                               param_attr=fluid.ParamAttr(name="lars.w2")))
+        lr = L.fill_constant([1], "float32", 0.1)
+        (dlr,) = L.append_LARS([(w, w)], lr, weight_decay=0.01)
+
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        xv = np.array([[1.0, 2.0, np.nan, 4.0]], np.float32)
+        yv = np.array([[1.0, 0.0, 0.0, 0.0]], np.float32)
+        vals = exe.run(prog, feed={"x": xv, "y": yv},
+                       fetch_list=[eq.name, ne.name, lo.name, fin.name,
+                                   hi.name, hn.name, emp.name, pr.name,
+                                   sr.name, am.name, ctr.name, dlr.name],
+                       sync=True)
+    eqv, nev, lov, finv, hiv, hnv, empv, prv, srv, amv, ctrv, dlrv = \
+        [np.asarray(v) for v in vals]
+    np.testing.assert_array_equal(eqv, ~nev)
+    assert lov.all()
+    assert finv == False and hiv == False and hnv == True  # noqa: E712
+    assert empv == False  # noqa: E712
+    np.testing.assert_allclose(prv, xv + yv)  # Print passes through
+    assert np.isfinite(srv[0, :2]).all()
+    assert amv[0] == np.argmin(xv[0])  # NaN wins like numpy
+    assert ctrv.reshape(()) >= 1
+    # LARS: lr * ||w|| / (||w|| + wd*||w||) = lr / 1.01
+    np.testing.assert_allclose(float(dlrv.reshape(())), 0.1 / 1.01,
+                               rtol=1e-5)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import math
 
+import contextlib
 import numpy as np
 
 
@@ -123,6 +124,60 @@ class NumpyArrayInitializer(Initializer):
         )
 
 
+class BilinearInitializer(Initializer):
+    """Bilinear-upsampling kernel init for conv2d_transpose weights
+    (reference initializer.py BilinearInitializer): with a [C_out, C_in,
+    H, W] weight, every spatial slice becomes the standard bilinear
+    interpolation kernel w[i, j] = (1 - |i/f - c|) * (1 - |j/f - c|),
+    f = ceil(W/2), c = (2f - 1 - f%2) / (2f) — so a stride-f transposed
+    conv initialized this way performs bilinear upsampling."""
+
+    def __call__(self, var, block):
+        shape = [int(d) for d in var.shape]
+        if len(shape) != 4:
+            raise ValueError("BilinearInitializer needs a 4-D weight")
+        H, W = shape[2], shape[3]
+        if H != W:
+            raise ValueError(
+                f"BilinearInitializer needs a square kernel, got "
+                f"{H}x{W} (a rectangular bilinear kernel is not "
+                "well-defined)")
+        f = int(np.ceil(W / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        j = np.arange(W)
+        i = np.arange(H)[:, None]
+        kern = ((1 - np.abs(i / f - c))
+                * (1 - np.abs(j / f - c))).astype("float32")
+        # keep the startup program small: store the [H, W] kernel ONCE
+        # and expand across [C_out, C_in] at lowering (an FCN-style
+        # [21, 21, 64, 64] head would otherwise bake 1.8M duplicated
+        # floats into the op attrs)
+        block.append_op(
+            "assign_value", {}, {"Out": [var.name + "@BILINEAR_KERN"]},
+            {"shape": [1, 1, H, W], "dtype": var.dtype,
+             "values": kern.reshape(-1).tolist()})
+        block.create_var(name=var.name + "@BILINEAR_KERN",
+                         dtype=var.dtype, shape=(1, 1, H, W))
+        block.append_op(
+            "expand", {"X": [var.name + "@BILINEAR_KERN"]},
+            {"Out": [var.name]},
+            {"expand_times": [shape[0], shape[1], 1, 1]})
+
+
+def force_init_on_cpu():
+    """Reference framework hint: whether initializers must run on CPU.
+    The TPU executor stages all initialization through host arrays
+    already, so this is always False (compat shim)."""
+    return False
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """Reference context manager forcing CPU-side init — a no-op here
+    (see force_init_on_cpu)."""
+    yield
+
+
 # reference-compatible aliases (initializer.py tail)
 Constant = ConstantInitializer
 Uniform = UniformInitializer
@@ -130,6 +185,7 @@ Normal = NormalInitializer
 TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
 
 
 def _global_weight_initializer():

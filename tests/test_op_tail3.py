@@ -272,3 +272,41 @@ def test_layer_surface_tail_round5():
     # LARS: lr * ||w|| / (||w|| + wd*||w||) = lr / 1.01
     np.testing.assert_allclose(float(dlrv.reshape(())), 0.1 / 1.01,
                                rtol=1e-5)
+
+
+def test_bilinear_initializer_upsamples():
+    """initializer.Bilinear: conv2d_transpose weight holds the standard
+    bilinear kernel and a ramp upsamples to the interpolated ramp
+    (reference initializer.py BilinearInitializer)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.executor import Executor, Scope, scope_guard
+    from paddle_tpu.core.program import Program, program_guard
+
+    L = fluid.layers
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = L.data("x", [1, 4, 4])
+        up = L.conv2d_transpose(
+            x, 1, filter_size=4, stride=2, padding=1,
+            param_attr=fluid.ParamAttr(
+                name="up.w", initializer=fluid.initializer.Bilinear()),
+            bias_attr=False)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        w = np.asarray(scope.find_var("up.w"))
+        f, c = 2, 0.75
+        want = np.array([[(1 - abs(i / f - c)) * (1 - abs(j / f - c))
+                          for j in range(4)] for i in range(4)], "float32")
+        np.testing.assert_allclose(w[0, 0], want, rtol=1e-6)
+        xv = np.tile(np.arange(4, dtype="float32"), (4, 1))[None, None]
+        out, = exe.run(prog, feed={"x": xv}, fetch_list=[up.name],
+                       sync=True)
+        mid = np.asarray(out)[0, 0, 4, 1:-1]
+        np.testing.assert_allclose(mid, np.arange(6) * 0.5 + 0.25,
+                                   rtol=1e-5)
+
+    assert fluid.initializer.force_init_on_cpu() is False
+    with fluid.initializer.init_on_cpu():
+        pass

@@ -23,7 +23,13 @@ from .control_flow import (  # noqa: F401
     shrink_memory,
     split_lod_tensor,
 )
-from .io import data, get_places  # noqa: F401
+from .io import (  # noqa: F401
+    data,
+    double_buffer,
+    get_places,
+    py_reader,
+    read_file,
+)
 from .detection import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from . import nn_extras  # noqa: F401

@@ -18,7 +18,7 @@ __all__ = [
     "equal", "not_equal", "less_equal", "greater_than",
     "logical_and", "logical_or", "logical_xor", "logical_not",
     "is_empty", "isfinite", "has_inf", "has_nan", "sum", "Print",
-    "autoincreased_step_counter", "append_LARS",
+    "autoincreased_step_counter", "append_LARS", "cumsum",
     "cos_sim", "hinge_loss", "log_loss", "rank_loss", "margin_rank_loss",
     "modified_huber_loss", "squared_l2_distance", "squared_l2_norm",
     "l1_norm", "bilinear_tensor_product", "minus", "label_smooth",
@@ -886,3 +886,13 @@ def append_LARS(params_grads, learning_rate, weight_decay):
         ratio = elementwise_div(p_norm, denom)
         decayed.append(elementwise_mul(ratio, learning_rate, axis=0))
     return decayed
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
+    """Cumulative sum along ``axis`` (cum_op.cc)."""
+    helper = LayerHelper("cumsum", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op("cumsum", {"X": [x]}, {"Out": [out]},
+                     {"axis": axis, "exclusive": exclusive,
+                      "reverse": reverse})
+    return out

@@ -2,9 +2,14 @@
 compositions over the layer DSL, no new ops."""
 from __future__ import annotations
 
+import math
+
 from . import layers
+from .param_attr import ParamAttr
 
 __all__ = [
+    "switch_moe",
+    "moe_sharding_rules",
     "simple_img_conv_pool",
     "sequence_conv_pool",
     "glu",
@@ -104,3 +109,103 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         weights = layers.dropout(weights, dropout_prob=dropout_rate)
     ctx = layers.matmul(weights, v)
     return combine_heads(ctx)
+
+
+def switch_moe(input, num_experts, d_ffn, capacity_factor=1.25,
+              capacity_per_expert=None, name_prefix=None):
+    """Switch-style top-1 mixture-of-experts FFN with expert parallelism
+    (no reference analogue — the TPU-native §7 extension; GShard-pattern
+    dispatch/combine einsums expressed as one-hot matmuls so GSPMD turns
+    them into all-to-alls when the expert weight dim is sharded over an
+    ``ep`` mesh axis via :func:`moe_sharding_rules`).
+
+    input [N, D] -> output [N, D]; each token is routed to its top-1
+    expert (capacity C = ceil(N/E * capacity_factor); overflow tokens
+    drop to zero, the standard Switch contract), runs that expert's
+    2-layer relu FFN, and is scaled by its gate probability (the
+    gradient path that trains the router).
+
+    ``name_prefix=None`` (default) generates a unique prefix per call so
+    stacked MoE layers never share weights; pass an explicit prefix to
+    share weights across programs (train/infer) — and the SAME prefix to
+    :func:`moe_sharding_rules`.
+    """
+    from .core import unique_name
+
+    if name_prefix is None:
+        name_prefix = unique_name.generate("moe")
+    N, D = int(input.shape[0]), int(input.shape[1])
+    E = int(num_experts)
+    if capacity_per_expert is not None:
+        C = int(capacity_per_expert)
+    elif N > 0:
+        C = int(math.ceil(N / E * capacity_factor))
+    else:
+        raise ValueError(
+            "switch_moe needs capacity_per_expert when the token/batch "
+            "dim is dynamic (-1): the dispatch tensor's [E, C] extent "
+            "must be static for XLA")
+
+    gate_probs = layers.softmax(layers.fc(
+        input, E, param_attr=ParamAttr(name=f"{name_prefix}.gate.w"),
+        bias_attr=False))                                   # [N, E]
+    expert_idx = layers.argmax(gate_probs, axis=-1)         # [N]
+    mask = layers.one_hot(
+        layers.unsqueeze(expert_idx, [1]), E)               # [N, E] f32
+    gate = layers.reduce_sum(layers.elementwise_mul(gate_probs, mask),
+                             dim=-1, keep_dim=True)         # [N, 1]
+
+    # position of each token within its expert; tokens past capacity drop
+    pos = layers.elementwise_mul(
+        layers.cumsum(mask, axis=0, exclusive=True), mask)  # [N, E]
+    keep = layers.cast(layers.less_than(
+        pos, layers.fill_constant([1], "float32", float(C))), "float32")
+    mask = layers.elementwise_mul(mask, keep)
+    pos_ids = layers.cast(
+        layers.reduce_sum(layers.elementwise_mul(pos, mask), dim=-1),
+        "int64")                                            # [N]
+    pos_hot = layers.one_hot(
+        layers.unsqueeze(pos_ids, [1]), C)                  # [N, C] f32
+
+    # dispatch [N, E, C] = mask[N,E] x pos_hot[N,C] (outer product)
+    dispatch = layers.elementwise_mul(
+        layers.unsqueeze(mask, [2]),
+        layers.unsqueeze(pos_hot, [1]))                     # [N, E, C]
+    disp_flat = layers.reshape(dispatch, [-1, E * C])
+
+    # expert_in [E, C, D] = dispatch^T @ x — the GSPMD all-to-all site
+    expert_in = layers.reshape(
+        layers.matmul(layers.transpose(disp_flat, [1, 0]), input),
+        [E, C, D])
+
+    w1 = layers.create_parameter([E, D, d_ffn], "float32",
+                                 name=f"{name_prefix}.w1")
+    b1 = layers.create_parameter([E, 1, d_ffn], "float32",
+                                 name=f"{name_prefix}.b1")  # per-expert
+    w2 = layers.create_parameter([E, d_ffn, D], "float32",
+                                 name=f"{name_prefix}.w2")
+    h = layers.relu(layers.elementwise_add(
+        layers.matmul(expert_in, w1), b1))                  # [E, C, F]
+    expert_out = layers.matmul(h, w2)                       # [E, C, D]
+
+    # combine [N, D] = dispatch @ expert_out, scaled by the gate prob
+    out = layers.matmul(disp_flat,
+                        layers.reshape(expert_out, [E * C, D]))
+    return layers.elementwise_mul(out, gate)
+
+
+def moe_sharding_rules(name_prefix="moe"):
+    """PartitionSpecs sharding every expert-batched weight over the
+    ``ep`` mesh axis (use with BuildStrategy.sharding_rules; the
+    dispatch/combine matmuls then carry the tokens across experts via
+    GSPMD-inserted collectives)."""
+    return [
+        # trailing .* shards the Adam moment accumulators with their
+        # expert weights (the deepfm.tp_sharding_rules precedent —
+        # replicated moments would cost 2x the sharded weight bytes on
+        # every device); scalar beta-pow accumulators stay replicated
+        # via the divisibility guard
+        (rf"{name_prefix}\.w1.*", ("ep", None, None)),
+        (rf"{name_prefix}\.b1.*", ("ep", None, None)),
+        (rf"{name_prefix}\.w2.*", ("ep", None, None)),
+    ]

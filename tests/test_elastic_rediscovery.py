@@ -90,7 +90,9 @@ def test_pserver_killed_and_restarted_on_new_port():
         procs.append(trainer)
         try:
             # let training make real progress, then kill the pserver hard
-            deadline = time.monotonic() + 120
+            # generous: on the 1-core host this test shares the core
+            # with everything else; under load 5 steps can take minutes
+            deadline = time.monotonic() + 300
             while time.monotonic() < deadline:
                 if os.path.exists(progress) and \
                         json.load(open(progress))["step"] >= 5:
@@ -114,7 +116,7 @@ def test_pserver_killed_and_restarted_on_new_port():
                 time.sleep(0.1)
             ps2 = start_ps(bind=f"127.0.0.1:{new_port}", ckpt=ckpt)
             procs.append(ps2)
-            out, err = trainer.communicate(timeout=240)
+            out, err = trainer.communicate(timeout=420)
             if trainer.returncode != 0:
                 ps2.kill()
                 _, ps2_err = ps2.communicate()

@@ -670,8 +670,33 @@ def _config_table():
 
 def _probe_main():
     """Child: one tiny put + readback against the default backend, so a
-    sick tunnel is diagnosable (and kill-able) from outside."""
+    sick tunnel is diagnosable (and kill-able) from outside.
+
+    Emits a TCP pre-check of the tunnel endpoint first: the axon plugin
+    retries forever on a dead endpoint instead of failing fast, so
+    distinguishing 'port refused' (endpoint down) from 'connected but
+    hung' (protocol-level sickness) in the artifact tells the reader
+    which infrastructure layer died."""
     import os
+    import socket
+
+    tcp = "skipped"
+    # the environment pins JAX_PLATFORMS=axon globally, so "is the env
+    # var set" is NOT the TPU-vs-CPU signal — only a cpu pin skips the
+    # tunnel check
+    if os.environ.get("JAX_PLATFORMS", "axon") != "cpu":
+        try:
+            port = int(os.environ.get("PADDLE_TPU_TUNNEL_PORT", "8103"))
+        except ValueError:
+            port = 8103  # malformed override must not kill diagnosis
+        try:
+            socket.create_connection(("127.0.0.1", port), 3).close()
+            tcp = "connected"
+        except ConnectionRefusedError:
+            tcp = "refused"
+        except OSError as e:
+            tcp = f"error: {e}"
+        print("PROBETCP=" + tcp, flush=True)
 
     import jax
 
@@ -690,7 +715,7 @@ def _probe_main():
     rtt_s = time.perf_counter() - t0
     print("PROBE=" + json.dumps({
         "ok": True, "backend_init_s": round(init_s, 2),
-        "rtt_ms": round(rtt_s * 1e3, 1),
+        "rtt_ms": round(rtt_s * 1e3, 1), "tunnel_tcp": tcp,
         "platform": jax.devices()[0].platform}), flush=True)
 
 
@@ -763,16 +788,19 @@ def _probe(budget_deadline):
         "PADDLE_TPU_BENCH_PROBE_TIMEOUT_S", "240"))
     deadline = min(time.monotonic() + probe_timeout, budget_deadline)
     result = {}
+    tcp = {}
 
     def on_line(line):
         if line.startswith("PROBE="):
             result.update(json.loads(line[len("PROBE="):]))
+        elif line.startswith("PROBETCP="):
+            tcp["tunnel_tcp"] = line[len("PROBETCP="):]
 
     rc, timed_out = _run_streaming(
         [sys.executable, __file__, "--probe"], on_line, lambda: deadline)
     if not result:
         result = {"ok": False,
-                  "error": "timeout" if timed_out else f"rc={rc}"}
+                  "error": "timeout" if timed_out else f"rc={rc}", **tcp}
     return result
 
 

@@ -72,7 +72,8 @@ def test_profiler_spans_summary_and_chrome_trace(tmp_path, capsys):
 
 def test_debugger_and_net_drawer_dumps(tmp_path):
     prog, startup, loss = _tiny_program()
-    dot = fluid.debugger.draw_block_graphviz(prog.global_block)
+    dot = fluid.debugger.draw_block_graphviz(
+        prog.global_block, path=str(tmp_path / "block.dot"))
     s = str(dot)
     assert "digraph" in s and "fc" in s.lower()
 

@@ -10,7 +10,12 @@ config, not the finished ones), (3) enforces a total wall-clock budget
 a hung config is killed, marked {"error": "timeout"}, and the worker is
 restarted on the remaining configs, (4) always prints the final
 combined JSON line itself, with explicit {"skipped": "budget"} /
-{"skipped": "tunnel probe failed"} markers for anything not run.
+{"skipped": "tunnel probe failed"} markers for anything not run,
+(5) writes a per-config runtime-telemetry artifact (step_stats.json;
+path override PADDLE_TPU_BENCH_STATS_PATH, empty disables):
+compile-cache hits/misses, lowering + XLA compile time and feed/fetch
+bytes from paddle_tpu.observability, so a BENCH_r*.json regression
+carries its own explanation.
 Role analogue: the reference benchmark driver emits numbers as it goes
 (benchmark/fluid/fluid_benchmark.py:295 print_train_time), not at exit.
 
@@ -720,16 +725,35 @@ def _probe_main():
 
 
 def _worker_main(names):
-    """Child: run the named configs in order, one flushed line each."""
+    """Child: run the named configs in order, one flushed line each.
+
+    Per config, the runtime telemetry layer is reset before and exported
+    after (``BENCHSTATS=`` line), so each config's compile-cache
+    hits/misses, lowering/compile time and transfer bytes land in the
+    orchestrator's ``step_stats.json`` artifact — a BENCH_r*.json
+    regression then comes with the telemetry that explains it."""
+    try:
+        from paddle_tpu import observability as _obs
+    except Exception:  # telemetry must never take the bench down
+        _obs = None
     fns = dict((n, f) for n, f, _, _ in _config_table())
     for name in names:
         print("BENCHSTART=" + name, flush=True)
+        if _obs is not None:
+            _obs.reset()
         try:
             result = fns[name]()
         except Exception as e:  # broken config must not hide the rest
             result = {"error": repr(e)[:200]}
         print("BENCHRESULT=" + json.dumps({"name": name, "result": result}),
               flush=True)
+        if _obs is not None:
+            try:
+                print("BENCHSTATS=" + json.dumps(
+                    {"name": name, "telemetry": _obs.export(step_tail=8)}),
+                    flush=True)
+            except Exception:
+                pass
 
 
 def _run_streaming(cmd, handle_line, deadline_for, kill_grace=5.0):
@@ -823,6 +847,7 @@ def main():
     emit_partial("_tunnel_probe", probe)
 
     configs = {}
+    telemetry = {}
     pending = [(n, dl, tpu) for n, _, dl, tpu in _config_table()]
     if not probe.get("ok"):
         # dead tunnel: don't even try the TPU configs; the CPU-mesh
@@ -871,6 +896,15 @@ def main():
                 # not judge the NEXT config by the finished one's start
                 state["started"] = time.monotonic()
                 state["n_results"] += 1
+            elif line.startswith("BENCHSTATS="):
+                # a worker killed at its deadline can truncate this
+                # (multi-KB) line mid-print; telemetry must never take
+                # the bench down
+                try:
+                    rec = json.loads(line[len("BENCHSTATS="):])
+                    telemetry[rec["name"]] = rec["telemetry"]
+                except (ValueError, KeyError):
+                    pass
 
         def deadline_for():
             cap = caps.get(state["current"], 300) if state["current"] \
@@ -908,6 +942,19 @@ def main():
                 emit_partial(name, configs[name])
             break
 
+    # per-config telemetry artifact (cache hits, compile time, transfer
+    # bytes — the numbers that EXPLAIN a BENCH trajectory regression);
+    # PADDLE_TPU_BENCH_STATS_PATH overrides, empty disables
+    stats_path = os.environ.get("PADDLE_TPU_BENCH_STATS_PATH",
+                                "step_stats.json")
+    if stats_path:
+        try:
+            with open(stats_path, "w") as f:
+                json.dump({"configs": telemetry}, f, indent=2,
+                          sort_keys=True)
+        except OSError:
+            stats_path = None
+
     primary = configs.get("resnet50", {}).get("images_per_sec", 0.0)
     tfm = configs.get("transformer_seq256", {})
     if tfm.get("tokens_per_sec"):
@@ -920,6 +967,7 @@ def main():
         "vs_baseline": round(primary / A100_RESNET50_IMG_S, 3),
         "tunnel_probe": probe,
         "elapsed_s": round(time.monotonic() - t_start, 1),
+        "step_stats_path": stats_path or None,
         "configs": configs,
     }), flush=True)
 

@@ -43,6 +43,7 @@ from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from .core.backward import append_backward, calc_gradient  # noqa: F401
 gradients = calc_gradient  # later-fluid alias
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from .lod_tensor import (  # noqa: F401
     LoDTensor, create_lod_tensor, create_random_int_lodtensor)

@@ -24,13 +24,65 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import flags as _flags
 from . import host_ops as _host_ops
 from .lowering import analyze_block, build_block_fn
 from .program import EMPTY_VAR, Program, Variable, default_main_program
 from .selected_rows import SelectedRows
 from .types import np_dtype
+from ..observability import stats as _obs_stats
+from ..observability import step_stats as _obs_step
+from ..observability import trace as _obs_trace
 
 RNG_STATE_VAR = "@RNG_STATE@"
+
+_exec_metrics = None
+
+
+def _em():
+    """Cached executor metric handles: registering through the registry
+    on every run costs a lock + dict round trip per metric; the handles
+    are process-wide and survive ``observability.reset()``, so create
+    them once (hot-path budget: the whole telemetry cost per cached run
+    must stay under 5% of a dispatch)."""
+    global _exec_metrics
+    m = _exec_metrics
+    if m is None:
+        sc = _obs_stats.scope("executor")
+        import types as _t
+        m = _t.SimpleNamespace(
+            steps=sc.counter("steps"),
+            hits=sc.counter("cache_hits"),
+            misses=sc.counter("cache_misses"),
+            shape_recompiles=sc.counter(
+                "shape_recompiles",
+                "compile-cache misses caused by a new feed-shape bucket "
+                "for an already-compiled program"),
+            evictions=sc.counter("cache_evictions"),
+            feed_bytes=sc.counter("feed_bytes"),
+            fetch_bytes=sc.counter("fetch_bytes"),
+            wall=sc.histogram("run_wall_ms"),
+        )
+        _exec_metrics = m
+    return m
+
+
+class _CacheEntry:
+    """One compiled-executable cache slot.  ``meta`` memoizes the
+    telemetry constants of the executable (program_key string, feed and
+    fetch byte totals) so the cached-run record path never re-hashes the
+    big nested cache key or walks array metadata."""
+
+    __slots__ = ("plan", "jitted", "meta")
+
+    def __init__(self, plan, jitted):
+        self.plan = plan
+        self.jitted = jitted
+        self.meta = None
+
+    def __iter__(self):
+        # (plan, jitted) unpacking compatibility for cache introspection
+        return iter((self.plan, self.jitted))
 
 
 class Scope:
@@ -321,6 +373,9 @@ class Executor:
     def __init__(self, place=None, training: bool = True):
         self.place = place
         self._cache: Dict = {}
+        # telemetry: feed signatures seen per (program, fetch, mode) base
+        # key, to distinguish shape-bucket recompiles from first compiles
+        self._seen_shapes: Dict = {}
         # lowering mode: inference executors (the Predictor) pass
         # training=False so ctx.training-gated lowerings (dropout off
         # without an is_test attr, Pallas RNN cells inside the fusion ops
@@ -348,6 +403,9 @@ class Executor:
         if any(_host_ops.is_host_op(op.type) for op in program.global_block.ops):
             return self._run_segmented(program, feed, fetch_names, scope, return_numpy)
 
+        tel = _obs_trace.flags_on()
+        t_run0 = time.perf_counter_ns() if tel else None
+
         feed_names = sorted(feed)
         block = program.global_block
         feed_vals = []
@@ -356,18 +414,32 @@ class Executor:
             feed_vals.append(self._put_feed(_as_device_array(feed[n], var)))
 
         sig = tuple((n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_vals))
+        base = (id(program), program._version, tuple(fetch_names),
+                self._training)
         key = (id(program), program._version, sig, tuple(fetch_names),
                self._training)
         entry = self._cache.get(key) if use_program_cache else None
+        cache_hit = entry is not None
+        lowering_ms = 0.0
         if entry is None:
+            t_low0 = time.perf_counter_ns()
             plan = analyze_block(program, 0, feed_names, fetch_names)
             fn = build_block_fn(program, plan, training=self._training,
                                 mesh=self._mesh())
             jitted = jax.jit(fn, donate_argnums=(1,))
-            entry = (plan, jitted)
+            t_low1 = time.perf_counter_ns()
+            lowering_ms = (t_low1 - t_low0) / 1e6
+            entry = _CacheEntry(plan, jitted)
             if use_program_cache:
                 self._cache[key] = entry
-        plan, jitted = entry
+                self._evict_cache_overflow()
+            if tel:
+                self._note_cache_miss(base, sig)
+                if _obs_trace.enabled():
+                    _obs_trace.emit("executor::lower", t_low0, t_low1)
+        elif tel:
+            _em().hits.inc()
+        plan, jitted = entry.plan, entry.jitted
 
         donated_state = [self._state_val(scope, block, n) for n in plan.donated_reads]
         const_state = [self._state_val(scope, block, n) for n in plan.const_reads]
@@ -376,10 +448,20 @@ class Executor:
             rng = jax.random.PRNGKey(program.random_seed or 0)
         rng = self._put_rng(rng)
 
-        from . import flags as _flags
         t0 = time.perf_counter() if _flags.get_flags("benchmark") else None
 
+        compile_ms = 0.0
+        t_disp0 = time.perf_counter_ns() if tel else None
         fetches, new_state, rng_out = jitted(feed_vals, donated_state, const_state, rng)
+        if tel:
+            t_disp1 = time.perf_counter_ns()
+            if not cache_hit:
+                # first call of a fresh executable: the synchronous part
+                # is jax trace + XLA compile (execution is async), so this
+                # wall time is the compile cost to within dispatch noise
+                compile_ms = (t_disp1 - t_disp0) / 1e6
+            if _obs_trace.enabled():
+                _obs_trace.emit("executor::dispatch", t_disp0, t_disp1)
 
         for name, val in zip(plan.persist_writes, new_state):
             self._note_state_write(name)
@@ -414,21 +496,28 @@ class Executor:
 
         if return_numpy:
             if sync:
-                return [self._fetch_to_numpy(v) for v in fetches]
-            # async dispatch: wrap plain-array fetches lazily so user step
-            # loops pipeline (one batched readback at first access).
-            # Fetches that alias persistable state materialize NOW — the
-            # next run() donates that state's buffer, and a deferred read
-            # of a donated buffer would raise.
-            persist = set(plan.persist_writes) | set(plan.donated_reads)
-            out = []
-            for name, v in zip(fetch_names, fetches):
-                if (isinstance(v, jax.Array) and name not in persist):
-                    out.append(LazyFetch(v))
-                else:
-                    out.append(self._fetch_to_numpy(v))
-            return out
-        return list(fetches)
+                out = [self._fetch_to_numpy(v) for v in fetches]
+            else:
+                # async dispatch: wrap plain-array fetches lazily so user
+                # step loops pipeline (one batched readback at first
+                # access).  Fetches that alias persistable state
+                # materialize NOW — the next run() donates that state's
+                # buffer, and a deferred read of a donated buffer would
+                # raise.
+                persist = set(plan.persist_writes) | set(plan.donated_reads)
+                out = []
+                for name, v in zip(fetch_names, fetches):
+                    if (isinstance(v, jax.Array) and name not in persist):
+                        out.append(LazyFetch(v))
+                    else:
+                        out.append(self._fetch_to_numpy(v))
+        else:
+            out = list(fetches)
+        if tel:
+            self._record_step(entry, key, cache_hit, lowering_ms,
+                              compile_ms, feed_vals, fetches, t_run0, plan,
+                              donated_state)
+        return out
 
     def run_steps(
         self,
@@ -472,6 +561,9 @@ class Executor:
                 "run_steps cannot scan programs with host ops (RPC/IO); "
                 "use run() per step")
 
+        tel = _obs_trace.flags_on()
+        t_run0 = time.perf_counter_ns() if tel else None
+
         feed_names = sorted(feed)
         block = program.global_block
         ks = {np.asarray(feed[n]).shape[0] for n in feed_names}
@@ -488,9 +580,14 @@ class Executor:
 
         sig = tuple((n, v.shape, str(v.dtype))
                     for n, v in zip(feed_names, stacked))
+        base = (id(program), program._version, tuple(fetch_names),
+                "run_steps", self._training)
         key = (id(program), program._version, sig, tuple(fetch_names),
                "run_steps", self._training)
         entry = self._cache.get(key)
+        cache_hit = entry is not None
+        lowering_ms = 0.0
+        t_low0 = time.perf_counter_ns() if tel else None
         if entry is None:
             plan = analyze_block(program, 0, feed_names, fetch_names)
             fn = build_block_fn(program, plan, training=self._training,
@@ -532,9 +629,18 @@ class Executor:
                 return fetches, final_state, rng
 
             jitted = jax.jit(multi, donate_argnums=(1,))
-            entry = (plan, jitted)
+            entry = _CacheEntry(plan, jitted)
             self._cache[key] = entry
-        plan, jitted = entry
+            self._evict_cache_overflow()
+            if tel:
+                t_low1 = time.perf_counter_ns()
+                lowering_ms = (t_low1 - t_low0) / 1e6
+                self._note_cache_miss(base, sig)
+                if _obs_trace.enabled():
+                    _obs_trace.emit("executor::lower", t_low0, t_low1)
+        elif tel:
+            _em().hits.inc()
+        plan, jitted = entry.plan, entry.jitted
 
         donated_state = [self._state_val(scope, block, n)
                          for n in plan.donated_reads]
@@ -545,16 +651,30 @@ class Executor:
             rng = jax.random.PRNGKey(program.random_seed or 0)
         rng = self._put_rng(rng)
 
+        compile_ms = 0.0
+        t_disp0 = time.perf_counter_ns() if tel else None
         fetches, new_state, rng_out = jitted(stacked, donated_state,
                                              const_state, rng)
+        if tel:
+            t_disp1 = time.perf_counter_ns()
+            if not cache_hit:
+                compile_ms = (t_disp1 - t_disp0) / 1e6
+            if _obs_trace.enabled():
+                _obs_trace.emit("executor::dispatch", t_disp0, t_disp1)
         for name, val in zip(plan.persist_writes, new_state):
             self._note_state_write(name)
             scope.set_var(name, val)
         if plan.has_stateful:
             scope.set_var(RNG_STATE_VAR, rng_out)
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+            out = [np.asarray(v) for v in fetches]
+        else:
+            out = list(fetches)
+        if tel:
+            self._record_step(entry, key, cache_hit, lowering_ms,
+                              compile_ms, stacked, fetches, t_run0, plan,
+                              donated_state)
+        return out
 
     def _fetch_to_numpy(self, v):
         return np.asarray(v)
@@ -642,6 +762,73 @@ class Executor:
                 # non-addressable multi-host shards; plain Executor: asarray
             out.append(v)
         return out
+
+    # -- telemetry (paddle_tpu/observability) ------------------------------
+    def _note_cache_miss(self, base, sig) -> None:
+        m = _em()
+        m.misses.inc()
+        if len(self._seen_shapes) > 1024:
+            # bound the side-table (telemetry only: a clear just makes
+            # the next miss per base count as a first compile, not a
+            # shape recompile) — shape churn must not leak memory here
+            # while the executable cache itself is capped
+            self._seen_shapes.clear()
+        seen = self._seen_shapes.setdefault(base, set())
+        if seen and sig not in seen:
+            # same program+fetches, new feed signature: a shape-bucket
+            # recompile (the static-shape policy's cost made visible —
+            # a storm of these means feed shapes are churning)
+            m.shape_recompiles.inc()
+        if len(seen) > 1024:  # same leak bound, per-base
+            seen.clear()
+        seen.add(sig)
+
+    def _evict_cache_overflow(self) -> None:
+        cap = _flags.get_flags("executor_cache_capacity")
+        while cap and len(self._cache) > cap:
+            oldest = next(iter(self._cache))  # insertion order = FIFO
+            del self._cache[oldest]
+            if _obs_trace.flags_on():
+                _em().evictions.inc()
+
+    def _record_step(self, entry, key, cache_hit: bool, lowering_ms: float,
+                     compile_ms: float, feed_vals, fetches,
+                     t_run0_ns: int, plan, donated_state) -> None:
+        t_now = time.perf_counter_ns()
+        wall_ms = (t_now - t_run0_ns) / 1e6
+        meta = entry.meta
+        if meta is None:
+            # once per executable: the cache key pins every feed/fetch
+            # shape, so program_key and the transfer byte totals are
+            # constants — re-deriving them per step (nested-tuple hash +
+            # jax metadata property chains) dominated the cached-run
+            # telemetry cost
+            nbytes = _obs_step.approx_nbytes
+            meta = (f"{key[0]:x}v{key[1]}:{abs(hash(key)) % (16 ** 8):08x}",
+                    sum(nbytes(v) for v in feed_vals),
+                    sum(nbytes(v) for v in fetches))
+            entry.meta = meta
+        pk, feed_bytes, fetch_bytes = meta
+        ss = _obs_step.StepStats(
+            program_key=pk,
+            cache_hit=cache_hit,
+            lowering_ms=round(lowering_ms, 3),
+            compile_ms=round(compile_ms, 3),
+            feed_bytes=feed_bytes,
+            fetch_bytes=fetch_bytes,
+            wall_ms=round(wall_ms, 3))
+        _obs_step.record(ss)
+        m = _em()
+        m.steps.inc()
+        m.wall.observe(wall_ms)
+        m.feed_bytes.inc(ss.feed_bytes)
+        m.fetch_bytes.inc(ss.fetch_bytes)
+        if _obs_trace.enabled():
+            _obs_trace.emit("executor::run", t_run0_ns, t_now)
+        self._post_step_telemetry(ss, plan, donated_state)
+
+    def _post_step_telemetry(self, ss, plan, donated_state) -> None:
+        """Hook for subclasses (ParallelExecutor adds mesh-level stats)."""
 
     # -- placement hooks (overridden by ParallelExecutor) ------------------
     def _prepare_program(self, program: Program, feed: Dict) -> Program:

@@ -100,6 +100,18 @@ define_flag("sparse_dense_update_max_elems", 32_000_000,
             "sorted merge_rows path whose cost is independent of height. "
             "Read at trace time: set it before the first Executor.run of "
             "a program (cached executables keep the path they compiled)")
+define_flag("runtime_stats", True,
+            "collect runtime telemetry (paddle_tpu/observability): "
+            "executor compile-cache and StepStats records, lowering/RPC/"
+            "collective counters and latency histograms, and runtime:: "
+            "profiler spans.  Collection is cheap (dict increments); set "
+            "FLAGS_runtime_stats=0 to disable every hook for true-zero "
+            "overhead")
+define_flag("executor_cache_capacity", 256,
+            "max cached compiled executables per Executor; exceeding it "
+            "evicts the oldest entry (counted in executor.cache_evictions "
+            "— an eviction storm means shape churn is defeating the "
+            "compile cache).  0 = unbounded (the pre-telemetry behavior)")
 define_flag("rpc_server_profile_period", 0,
             "pserver self-profiling: log request-rate stats every N "
             "handled RPCs (reference FLAGS_rpc_server_profile_period, "

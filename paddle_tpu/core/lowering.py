@@ -12,15 +12,20 @@ is delegated to the XLA compiler.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from . import registry
 from .program import Program, Block, EMPTY_VAR
 from .registry import GRAD_OP_SUFFIX, LowerContext
+from ..observability import stats as _obs_stats
+from ..observability import trace as _obs_trace
 
 # ops handled by the executor itself, not lowered
 SKIP_OPS = ("feed", "fetch")
+
+_telemetry_on = _obs_trace.flags_on
 
 
 @dataclass
@@ -55,6 +60,7 @@ class BlockPlan:
 
 def analyze_block(program: Program, block_idx: int, feed_names: Sequence[str],
                   fetch_names: Sequence[str]) -> BlockPlan:
+    t0 = time.perf_counter_ns() if _telemetry_on() else None
     plan = BlockPlan(block_idx, tuple(feed_names), tuple(fetch_names))
     seen_reads = set()
     persist_written = set()
@@ -100,6 +106,12 @@ def analyze_block(program: Program, block_idx: int, feed_names: Sequence[str],
         if n not in defined_or_read and n not in seen_reads:
             seen_reads.add(n)
             plan.state_reads.append(n)
+    if t0 is not None:
+        t1 = time.perf_counter_ns()
+        _obs_stats.scope("lowering").histogram("analyze_ms").observe(
+            (t1 - t0) / 1e6)
+        if _obs_trace.enabled():
+            _obs_trace.emit("lowering::analyze", t0, t1)
     return plan
 
 
@@ -158,6 +170,11 @@ def build_block_fn(program: Program, plan: BlockPlan, training: bool = True,
     donated, const = plan.donated_reads, plan.const_reads
 
     def fn(feed_vals, donated_state, const_state, rng):
+        # host-side timing of the op-by-op jax trace: runs once per XLA
+        # compile (and per scan/eval_shape re-trace), never on cached
+        # executions — the "build" half of the lowering cost
+        t0 = time.perf_counter_ns() if _telemetry_on() else None
+
         def lower_sub(block_idx, env):
             return lower_ops(ctx, program, program.blocks[block_idx], env)
 
@@ -171,6 +188,12 @@ def build_block_fn(program: Program, plan: BlockPlan, training: bool = True,
         lower_ops(ctx, program, block, env)
         fetches = [env[n] for n in plan.fetch_names]
         new_state = [env[n] for n in plan.persist_writes]
+        if t0 is not None:
+            t1 = time.perf_counter_ns()
+            _obs_stats.scope("lowering").histogram("trace_ms").observe(
+                (t1 - t0) / 1e6)
+            if _obs_trace.enabled():
+                _obs_trace.emit("lowering::trace", t0, t1)
         return fetches, new_state, ctx.rng_key
 
     return fn

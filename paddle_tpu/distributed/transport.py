@@ -39,6 +39,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 from . import serde
+from ..observability import stats as _obs_stats
+from ..observability.trace import flags_on as _telemetry_on
 
 # message types (request)
 SEND_VAR = 1
@@ -52,9 +54,19 @@ CHECKPOINT_NOTIFY = 7
 OK = 0
 ERR = 255
 
+MSG_NAMES = {SEND_VAR: "send_var", GET_VAR: "get_var",
+             BATCH_BARRIER: "batch_barrier", FETCH_BARRIER: "fetch_barrier",
+             COMPLETE: "complete", PREFETCH: "prefetch",
+             CHECKPOINT_NOTIFY: "checkpoint_notify"}
+
 _HDR = struct.Struct("<BiH")  # msg_type, trainer_id, name_len
 
 _CONNECT_TIMEOUT = 120.0
+
+# RPC latency buckets (ms): LAN round trips through multi-second
+# sync-barrier waits and tunneled DCN links
+_RPC_MS_BUCKETS = (0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                   250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
 
 
 def _backend() -> str:
@@ -228,13 +240,29 @@ def _serve_io(io, service) -> None:
         body = io.recv_frame()
         if body is None:
             return
+        tel = _telemetry_on()
+        t0 = time.perf_counter() if tel else None
         msg_type, tid, name, payload = _unpack_body(body)
         try:
             rtype, rpayload = service.handle(msg_type, tid, name, payload)
         except Exception as e:
             rtype, rpayload = ERR, repr(e).encode("utf-8")
+        resp = _pack_body(rtype, tid, name, rpayload)
+        if tel:
+            sc = _obs_stats.scope("rpc.server")
+            sc.counter("requests." + MSG_NAMES.get(msg_type,
+                                                   str(msg_type))).inc()
+            sc.counter("bytes_in").inc(len(body))
+            sc.counter("bytes_out").inc(len(resp))
+            if rtype == ERR:
+                sc.counter("handler_errors").inc()
+            # includes any time the handler BLOCKED on a sync-mode
+            # barrier — a saturated histogram tail here is the signature
+            # of one slow trainer stalling the round
+            sc.histogram("handle_ms", buckets=_RPC_MS_BUCKETS).observe(
+                (time.perf_counter() - t0) * 1e3)
         try:
-            io.send_frame(_pack_body(rtype, tid, name, rpayload))
+            io.send_frame(resp)
         except ConnectionError:
             return
 
@@ -559,6 +587,10 @@ class RPCClient:
 
     def _raw_request(self, endpoint: str, msg_type: int, name: str = "",
                      payload: bytes = b"", retry_all: bool = False):
+        tel = _telemetry_on()
+        t0 = time.perf_counter() if tel else None
+        sc = _obs_stats.scope("rpc.client") if tel else None
+        req = _pack_body(msg_type, self.trainer_id, name, payload)
         body = None
         for attempt in (0, 1):
             # retry connects get a short deadline: the long one is only for
@@ -566,8 +598,7 @@ class RPCClient:
             c = self._conn(endpoint, _CONNECT_TIMEOUT if attempt == 0 else 5.0)
             try:
                 with c.lock:
-                    c.io.send_frame(_pack_body(msg_type, self.trainer_id,
-                                               name, payload))
+                    c.io.send_frame(req)
                     body = c.io.recv_frame()
                 if body is None:
                     raise ConnectionError(
@@ -577,10 +608,23 @@ class RPCClient:
                 # stale cached connection (pserver restarted, or the port
                 # was reassigned): reconnect once for idempotent requests
                 self._drop_conn(endpoint, c)
+                if tel:
+                    sc.counter("conn_errors").inc()
                 if attempt or not (retry_all
                                    or msg_type in self._RETRYABLE):
                     raise
+                if tel:
+                    sc.counter("retries").inc()
         rtype, _, _, rpayload = _unpack_body(body)
+        if tel:
+            sc.counter("requests." + MSG_NAMES.get(msg_type,
+                                                   str(msg_type))).inc()
+            sc.counter("bytes_sent").inc(len(req))
+            sc.counter("bytes_recv").inc(len(body))
+            sc.histogram("latency_ms", buckets=_RPC_MS_BUCKETS).observe(
+                (time.perf_counter() - t0) * 1e3)
+            if rtype == ERR:
+                sc.counter("server_errors").inc()
         if rtype == ERR:
             raise RuntimeError(
                 f"pserver {endpoint} error for {name!r}: "
@@ -598,6 +642,8 @@ class RPCClient:
             # the pserver behind this logical endpoint is gone: wait for a
             # replacement registration and retry there.
             new_phys = self._resolve(endpoint, refresh=True, avoid=phys)
+            if _telemetry_on():
+                _obs_stats.scope("rpc.client").counter("failovers").inc()
             # loud by design: operators should see every elastic failover
             print(f"[rpc-failover] {endpoint} msg={msg_type}: "
                   f"{phys} -> {new_phys}", file=_sys.stderr, flush=True)

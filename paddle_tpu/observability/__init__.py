@@ -1,0 +1,53 @@
+"""Runtime telemetry: metrics registry, per-step stats, runtime spans.
+
+Three cooperating pieces (see each module's docstring):
+
+- :mod:`stats` — process-wide counters / gauges / fixed-bucket
+  histograms with ``snapshot()`` / ``to_prometheus_text()`` / JSON
+  export; the instrumented layers (``core/executor.py``,
+  ``core/lowering.py``, ``parallel/parallel_executor.py``,
+  ``distributed/transport.py``) report here under the ``executor.*``,
+  ``lowering.*``, ``parallel.*`` and ``rpc.*`` scopes.
+- :mod:`step_stats` — a bounded ring of per-``Executor.run`` records
+  (cache hit/miss, lowering + XLA compile time, feed/fetch bytes, wall
+  time) with ``last_n()`` and percentile ``summary()``.
+- :mod:`trace` — runtime spans feeding the existing profiler event
+  stream under a ``runtime::`` category, so Chrome traces show executor
+  internals alongside user spans.
+
+Everything is gated by ``FLAGS_runtime_stats`` (env
+``FLAGS_runtime_stats=0`` disables all collection); spans additionally
+require the profiler to be armed, so the default-path overhead is a
+flag lookup.
+"""
+from __future__ import annotations
+
+from . import stats, step_stats, trace  # noqa: F401
+from .stats import (  # noqa: F401
+    StatsRegistry,
+    default_registry,
+    snapshot,
+    to_prometheus_text,
+)
+from .step_stats import StepStats, StepStatsRecorder  # noqa: F401
+
+
+def enabled() -> bool:
+    """Is runtime telemetry collection on (``FLAGS_runtime_stats``)?"""
+    return trace.flags_on()
+
+
+def export(step_tail: int = 32) -> dict:
+    """One JSON-ready bundle: metrics snapshot + step-stats summary/tail.
+
+    The shape bench.py dumps per config into ``step_stats.json``.
+    """
+    import json
+    return {"stats": json.loads(stats.to_json())["metrics"],
+            "step_stats": step_stats.recorder().export(tail=step_tail)}
+
+
+def reset() -> None:
+    """Zero all metrics and drop the step ring (bench isolates configs)."""
+    stats.reset()
+    step_stats.clear()

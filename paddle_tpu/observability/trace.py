@@ -1,0 +1,61 @@
+"""Runtime spans: executor/transport internals in the profiler stream.
+
+User code already records spans through ``paddle_tpu.profiler``; this
+module lets the *runtime itself* feed the same event stream under a
+``runtime::`` name prefix and a ``runtime`` Chrome-trace category, so
+``profiler.chrome_trace()`` / ``tools/timeline.py`` show the
+lower→jit→dispatch pipeline interleaved with the user's ``train_step``
+spans in one Perfetto view.
+
+Overhead discipline: a span is recorded only when the profiler is armed
+AND ``FLAGS_runtime_stats`` is on; the disabled path is two dict
+lookups, so instrumented hot paths cost effectively nothing by default
+(the profiler starts disabled).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+from .. import profiler as _profiler
+from ..core import flags as _flags
+
+CATEGORY = "runtime"
+PREFIX = "runtime::"
+
+
+def flags_on() -> bool:
+    """The one FLAGS_runtime_stats gate — every instrumentation site
+    (executor, lowering, transport, observability.enabled) routes
+    through here so gating semantics live in a single place."""
+    try:
+        return bool(_flags.get_flags("runtime_stats"))
+    except KeyError:  # pragma: no cover - flag always defined
+        return False
+
+
+def enabled() -> bool:
+    # profiler check first: it is False in steady state, so the common
+    # path is one dict lookup
+    return _profiler.is_profiler_enabled() and flags_on()
+
+
+def emit(name: str, t0_ns: int, t1_ns: int) -> None:
+    """Record an already-timed runtime span (callers that measured a
+    region for stats anyway reuse the timestamps instead of nesting a
+    context manager)."""
+    _profiler._emit(PREFIX + name, t0_ns, t1_ns, cat=CATEGORY)
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """``with trace.span("executor::lower"): ...`` — no-op when disabled."""
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        _profiler._emit(PREFIX + name, t0, time.perf_counter_ns(),
+                        cat=CATEGORY)

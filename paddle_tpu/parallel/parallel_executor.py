@@ -45,6 +45,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.executor import Executor, Scope, global_scope
 from ..core.program import OP_ROLE_ATTR, OpRole, Program, default_main_program
 from ..core.backward import grad_var_name
+from ..observability import stats as _obs_stats
+from ..observability.step_stats import approx_nbytes as _approx_nbytes
 from .strategy import (
     BuildStrategy,
     ExecutionStrategy,
@@ -184,6 +186,34 @@ class ParallelExecutor(Executor):
                 op.attr(OP_ROLE_ATTR, 0) & (OpRole.Optimize | OpRole.Backward)
                 for op in self._program.global_block.ops)
         return self._trains_cache
+
+    # -- telemetry ---------------------------------------------------------
+    _pe_metrics = None
+
+    def _post_step_telemetry(self, ss, plan, donated_state) -> None:
+        """Mesh-level stats per dispatched step (called from Executor.run
+        when FLAGS_runtime_stats is on).  SPMD runs every device in
+        lockstep, so the host wall time IS the per-device step time."""
+        m = ParallelExecutor._pe_metrics
+        if m is None:
+            import types as _t
+            sc = _obs_stats.scope("parallel")
+            m = _t.SimpleNamespace(
+                steps=sc.counter("steps"),
+                mesh_devices=sc.gauge("mesh_devices"),
+                step=sc.histogram("device_step_ms"),
+                allreduce_bytes=sc.counter(
+                    "allreduce_bytes_est",
+                    "upper-bound estimate of per-step dp collective "
+                    "payload: total bytes of donated persistable state, "
+                    "each updated from an all-reduced gradient/statistic"))
+            ParallelExecutor._pe_metrics = m
+        m.steps.inc()
+        m.mesh_devices.set(self.mesh.size)
+        m.step.observe(ss.wall_ms)
+        if self._program_trains() and donated_state:
+            m.allreduce_bytes.inc(sum(_approx_nbytes(v)
+                                      for v in donated_state))
 
     # -- placement hooks ---------------------------------------------------
     def _mesh(self):

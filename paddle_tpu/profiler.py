@@ -13,6 +13,7 @@ tools/timeline.py exists for file-based workflows.
 from __future__ import annotations
 
 import contextlib
+import functools
 import json
 import threading
 import time
@@ -28,8 +29,31 @@ def is_profiler_enabled() -> bool:
     return _state["enabled"]
 
 
+def _emit(name: str, t0_ns: int, t1_ns: int, cat: str = "op") -> None:
+    """Append one completed span to the event stream.  Internal: the
+    runtime telemetry layer (observability/trace.py) reuses it to file
+    ``runtime::`` spans alongside user spans."""
+    with _lock:
+        _events.append({
+            "name": name,
+            "cat": cat,
+            "ts": t0_ns / 1000.0,
+            "dur": (t1_ns - t0_ns) / 1000.0,
+            "tid": threading.get_ident() % 100000,
+        })
+
+
 class RecordEvent:
-    """RAII span (profiler.h:73).  Usable as context manager or decorator."""
+    """RAII span (profiler.h:73).  Usable as context manager or decorator:
+
+        with RecordEvent("step"): ...
+
+        @RecordEvent("step")
+        def step(...): ...
+
+    The decorator opens a FRESH span per call (never the shared instance
+    state), so decorated functions are re-entrant and thread-safe.
+    """
 
     def __init__(self, name: str):
         self.name = name
@@ -42,15 +66,16 @@ class RecordEvent:
 
     def __exit__(self, *a):
         if self._t0 is not None:
-            t1 = time.perf_counter_ns()
-            with _lock:
-                _events.append({
-                    "name": self.name,
-                    "ts": self._t0 / 1000.0,
-                    "dur": (t1 - self._t0) / 1000.0,
-                    "tid": threading.get_ident() % 100000,
-                })
+            _emit(self.name, self._t0, time.perf_counter_ns())
+            self._t0 = None
         return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name):
+                return fn(*args, **kwargs)
+        return wrapper
 
 
 record_event = RecordEvent  # snake_case alias
@@ -133,8 +158,8 @@ def chrome_trace(path: str) -> None:
     with _lock:
         trace = {
             "traceEvents": [
-                {"name": e["name"], "cat": "op", "ph": "X", "pid": 0,
-                 "tid": e["tid"], "ts": e["ts"], "dur": e["dur"]}
+                {"name": e["name"], "cat": e.get("cat", "op"), "ph": "X",
+                 "pid": 0, "tid": e["tid"], "ts": e["ts"], "dur": e["dur"]}
                 for e in _events
             ]
         }

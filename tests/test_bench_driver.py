@@ -48,6 +48,9 @@ def _run_bench(tmp_path, table_src, env_extra, timeout=180):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PADDLE_TPU_BENCH_TEST_TABLE"] = str(table)
+    # keep the telemetry artifact out of the repo root (test hygiene)
+    env.setdefault("PADDLE_TPU_BENCH_STATS_PATH",
+                   str(tmp_path / "step_stats.json"))
     env.update(env_extra)
     out = subprocess.run([sys.executable, BENCH], env=env, cwd=REPO,
                          capture_output=True, text=True, timeout=timeout)
@@ -120,6 +123,31 @@ CONFIG_TABLE = [
     cfg = final["configs"]
     assert cfg["needs_chip"] == {"skipped": "tunnel probe failed"}
     assert cfg["cpu_only"] == {"v": 4}
+
+
+def test_step_stats_artifact_written(tmp_path):
+    """Every completed config dumps its runtime telemetry (stats snapshot
+    + StepStats summary/tail) into the step_stats.json artifact, so a
+    BENCH_r*.json regression carries cache/compile/transfer context."""
+    table = """
+def ok():
+    return {"v": 1}
+
+
+CONFIG_TABLE = [
+    ("ok", ok, 120, True),
+]
+"""
+    partials, final = _run_bench(tmp_path, table, {})
+    path = tmp_path / "step_stats.json"
+    assert final["step_stats_path"] == str(path)
+    data = json.loads(path.read_text())
+    rec = data["configs"]["ok"]
+    assert "stats" in rec and "step_stats" in rec
+    summ = rec["step_stats"]["summary"]
+    for key in ("cache_hits", "cache_misses", "compile_ms_total",
+                "feed_bytes_total", "wall_ms"):
+        assert key in summ
 
 
 def test_scan_driver_matches_eager_steps():

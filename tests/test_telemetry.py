@@ -1,0 +1,362 @@
+"""Runtime telemetry subsystem (paddle_tpu/observability): stats
+registry (thread-safe counters/gauges/histograms, Prometheus + JSON
+export), per-Executor.run StepStats ring, compile-cache / shape-bucket
+instrumentation, cache eviction accounting, RPC transport counters, and
+the runtime:: span unification with the profiler's Chrome trace."""
+import importlib.util
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu import profiler
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.observability import stats as stats_mod
+from paddle_tpu.observability.stats import Histogram, StatsRegistry
+from paddle_tpu.observability.step_stats import (StepStats,
+                                                 StepStatsRecorder,
+                                                 approx_nbytes)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_program():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        h = fluid.layers.fc(x, 8, act="tanh")
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return prog, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# stats registry
+# ---------------------------------------------------------------------------
+
+def test_counter_concurrent_increments():
+    reg = StatsRegistry()
+    c = reg.counter("t.hits")
+    h = reg.histogram("t.lat_ms", buckets=(1.0, 10.0))
+    n_threads, per_thread = 8, 5000
+
+    def work():
+        for i in range(per_thread):
+            c.inc()
+            h.observe(float(i % 20))
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+    # get-or-create returns the same object; kind mismatch is loud
+    assert reg.counter("t.hits") is c
+    with pytest.raises(TypeError):
+        reg.gauge("t.hits")
+
+
+def test_histogram_bucket_boundaries():
+    h = Histogram("h", buckets=(1.0, 2.0, 5.0))
+    for v in (1.0, 1.5, 5.0, 6.0):  # edge values are INCLUSIVE (le)
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["buckets"][1.0] == 1      # 1.0 lands in le=1
+    assert snap["buckets"][2.0] == 2      # +1.5
+    assert snap["buckets"][5.0] == 3      # 5.0 lands in le=5, not +Inf
+    assert snap["buckets"][float("inf")] == 4
+    assert snap["count"] == 4 and snap["sum"] == pytest.approx(13.5)
+    assert h.percentile(0.5) == 2.0
+    assert h.percentile(1.0) == 5.0  # +Inf bucket reports last finite edge
+
+
+def test_prometheus_text_round_trip():
+    reg = StatsRegistry()
+    reg.counter("executor.cache_hits", "compile cache hits").inc(7)
+    reg.gauge("parallel.mesh_devices").set(8)
+    h = reg.histogram("rpc.client.latency_ms", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(3.0)
+    h.observe(99.0)
+    text = reg.to_prometheus_text()
+
+    # every line parses: comment, or `name[{le="x"}] value`
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})? (-?[0-9.eE+]+|\+Inf)$')
+    parsed = {}
+    for line in text.splitlines():
+        assert line.strip(), "blank line in exposition output"
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*", line)
+            continue
+        m = sample_re.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, _, value = line.partition(" ")
+        parsed[name] = float(value)
+
+    # dots sanitize to underscores; values round-trip
+    assert parsed["executor_cache_hits"] == 7
+    assert parsed["parallel_mesh_devices"] == 8
+    assert parsed['rpc_client_latency_ms_bucket{le="1"}'] == 1
+    assert parsed['rpc_client_latency_ms_bucket{le="10"}'] == 2
+    assert parsed['rpc_client_latency_ms_bucket{le="+Inf"}'] == 3
+    assert parsed["rpc_client_latency_ms_count"] == 3
+    assert parsed["rpc_client_latency_ms_sum"] == pytest.approx(102.5)
+    # TYPE lines present for each family
+    assert "# TYPE executor_cache_hits counter" in text
+    assert "# TYPE parallel_mesh_devices gauge" in text
+    assert "# TYPE rpc_client_latency_ms histogram" in text
+
+    # JSON export round-trips through json.loads (incl. +Inf keys)
+    data = json.loads(reg.to_json())
+    assert data["metrics"]["executor.cache_hits"] == 7
+    assert data["metrics"]["rpc.client.latency_ms"]["buckets"]["+Inf"] == 3
+
+
+def test_registry_reset_keeps_handles_valid():
+    reg = StatsRegistry()
+    c = reg.counter("x")
+    c.inc(5)
+    reg.reset()
+    assert c.value == 0
+    c.inc()  # the held handle still feeds the registry
+    assert reg.snapshot()["x"] == 1
+
+
+# ---------------------------------------------------------------------------
+# step stats ring
+# ---------------------------------------------------------------------------
+
+def test_step_stats_ring_and_summary():
+    rec = StepStatsRecorder(capacity=8)
+    for i in range(20):
+        rec.record(StepStats(program_key=f"p{i}", cache_hit=(i % 2 == 0),
+                             wall_ms=float(i)))
+    assert len(rec) == 8
+    assert rec.total_recorded == 20
+    tail = rec.last_n(3)
+    assert [s.program_key for s in tail] == ["p17", "p18", "p19"]
+    s = rec.summary()
+    assert s["window"] == 8 and s["total_recorded"] == 20
+    assert s["cache_hits"] + s["cache_misses"] == 8
+    # retained walls are 12..19: percentiles ordered and in range
+    assert 12.0 <= s["wall_ms"]["p50"] <= s["wall_ms"]["p90"] \
+        <= s["wall_ms"]["p99"] <= s["wall_ms"]["max"] == 19.0
+    exported = rec.export(tail=2)
+    assert len(exported["last"]) == 2
+    json.dumps(exported)  # JSON-ready
+
+
+def test_approx_nbytes_metadata_only():
+    assert approx_nbytes(np.zeros((4, 8), "float32")) == 128
+    assert approx_nbytes(object()) == 0
+    from paddle_tpu.core.selected_rows import SelectedRows
+    sr = SelectedRows(np.zeros((3,), "int64"), np.zeros((3, 2), "float32"),
+                      height=10)
+    assert approx_nbytes(sr) == 3 * 8 + 3 * 2 * 4
+
+
+# ---------------------------------------------------------------------------
+# executor instrumentation
+# ---------------------------------------------------------------------------
+
+def test_executor_records_cache_hits_misses_and_shape_recompiles():
+    prog, startup, loss = _tiny_program()
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        obs.reset()
+        runs = [(2, "miss"), (2, "hit"), (6, "miss")]  # batch-size buckets
+        for bs, _ in runs:
+            exe.run(prog, feed={"x": np.ones((bs, 4), "float32")},
+                    fetch_list=[loss.name], sync=True)
+
+    snap = obs.snapshot()
+    assert snap["executor.steps"] == 3
+    assert snap["executor.cache_hits"] == 1
+    assert snap["executor.cache_misses"] == 2
+    # second miss reused the same (program, fetch) base with a new feed
+    # signature: that is a shape-bucket recompile
+    assert snap["executor.shape_recompiles"] == 1
+    assert snap["executor.feed_bytes"] > 0
+    assert snap["executor.fetch_bytes"] > 0
+    assert snap["lowering.analyze_ms"]["count"] >= 2
+
+    tail = obs.step_stats.last_n(3)
+    assert [s.cache_hit for s in tail] == [False, True, False]
+    miss, hit = tail[0], tail[1]
+    assert miss.compile_ms > 0 and miss.lowering_ms > 0
+    assert hit.compile_ms == 0 and hit.lowering_ms == 0
+    assert hit.wall_ms > 0 and hit.feed_bytes == 2 * 4 * 4
+    # prometheus export of the live registry parses
+    text = obs.to_prometheus_text()
+    assert "executor_cache_misses 2" in text
+
+
+def test_executor_cache_eviction_counted():
+    prog, startup, loss = _tiny_program()
+    scope, exe = Scope(), Executor()
+    fluid.set_flags({"executor_cache_capacity": 1})
+    try:
+        with scope_guard(scope):
+            exe.run(startup)
+            obs.reset()
+            for bs in (2, 3, 2):  # three shape buckets through a 1-slot cache
+                exe.run(prog, feed={"x": np.ones((bs, 4), "float32")},
+                        fetch_list=[loss.name], sync=True)
+        assert len(exe._cache) <= 1
+        snap = obs.snapshot()
+        assert snap["executor.cache_evictions"] >= 2
+        # the re-run of bs=2 was evicted in between: a miss, not a hit
+        assert snap["executor.cache_misses"] == 3
+    finally:
+        fluid.set_flags({"executor_cache_capacity": 256})
+
+
+def test_runtime_stats_flag_disables_collection():
+    prog, startup, loss = _tiny_program()
+    scope, exe = Scope(), Executor()
+    fluid.set_flags({"runtime_stats": False})
+    try:
+        with scope_guard(scope):
+            exe.run(startup)
+            obs.reset()
+            exe.run(prog, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[loss.name], sync=True)
+        assert len(obs.step_stats.recorder()) == 0
+        assert obs.snapshot().get("executor.steps") in (None, 0)
+    finally:
+        fluid.set_flags({"runtime_stats": True})
+
+
+def test_run_steps_records_step_stats():
+    prog, startup, loss = _tiny_program()
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        obs.reset()
+        K = 3
+        xs = np.ones((K, 2, 4), "float32")
+        exe.run_steps(prog, feed={"x": xs}, fetch_list=[loss.name])
+    tail = obs.step_stats.last_n(1)
+    assert len(tail) == 1 and not tail[0].cache_hit
+    assert tail[0].compile_ms > 0
+    assert tail[0].feed_bytes == K * 2 * 4 * 4
+    assert obs.snapshot()["executor.cache_misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# trace unification: runtime:: spans + user spans in one Chrome trace
+# ---------------------------------------------------------------------------
+
+def test_runtime_spans_merge_with_user_spans(tmp_path, capsys):
+    prog, startup, loss = _tiny_program()
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        profiler.reset_profiler()
+        profiler.start_profiler("All")
+        with profiler.RecordEvent("user_train_step"):
+            exe.run(prog, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[loss.name], sync=True)
+        profiler.stop_profiler()
+    capsys.readouterr()  # swallow the printed summary
+
+    path = str(tmp_path / "trace.json")
+    profiler.chrome_trace(path)
+    trace = json.load(open(path))
+    by_name = {e["name"]: e for e in trace["traceEvents"]}
+    assert by_name["user_train_step"]["cat"] == "op"
+    runtime_names = {n for n in by_name if n.startswith("runtime::")}
+    assert "runtime::executor::run" in runtime_names
+    assert "runtime::executor::lower" in runtime_names
+    assert "runtime::executor::dispatch" in runtime_names
+    assert "runtime::lowering::analyze" in runtime_names
+    assert all(by_name[n]["cat"] == "runtime" for n in runtime_names)
+    # spans nest sanely: run covers dispatch
+    run_e, disp = by_name["runtime::executor::run"], \
+        by_name["runtime::executor::dispatch"]
+    assert run_e["ts"] <= disp["ts"]
+    assert run_e["ts"] + run_e["dur"] >= disp["ts"] + disp["dur"]
+
+    # tools/timeline.py merges it with a third-party trace missing tid
+    foreign = str(tmp_path / "foreign.json")
+    json.dump({"traceEvents": [
+        {"name": "xla_module", "ph": "X", "ts": 1, "dur": 2}]},
+        open(foreign, "w"))
+    spec = importlib.util.spec_from_file_location(
+        "timeline_under_test", os.path.join(REPO, "tools", "timeline.py"))
+    tl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tl)
+    merged = tl.merge([path, foreign])
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert "runtime::executor::run" in names and "user_train_step" in names
+    ext = [e for e in merged["traceEvents"] if e.get("name") == "xla_module"]
+    assert ext and ext[0]["tid"] == 0 and ext[0]["pid"] == 1
+
+
+def test_record_event_decorator(capsys):
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+
+    @profiler.RecordEvent("decorated_step")
+    def step(x, scale=2):
+        return x * scale
+
+    assert step(3) == 6
+    assert step(4, scale=3) == 12
+    assert step.__name__ == "step"  # functools.wraps preserved
+    profiler.stop_profiler()
+    capsys.readouterr()
+    evs = [e for e in profiler.events() if e["name"] == "decorated_step"]
+    assert len(evs) == 2
+    assert all(e["dur"] >= 0 for e in evs)
+    profiler.reset_profiler()
+
+
+# ---------------------------------------------------------------------------
+# RPC transport counters
+# ---------------------------------------------------------------------------
+
+def test_rpc_transport_counters():
+    from paddle_tpu.distributed import transport
+
+    class EchoService:
+        def handle(self, msg_type, trainer_id, name, payload):
+            return transport.OK, b"pong-" + payload
+
+    fluid.set_flags({"rpc_transport": "python"})
+    try:
+        server = transport.RPCServer("127.0.0.1:0", EchoService())
+        server.start()
+        try:
+            obs.reset()
+            client = transport.RPCClient(trainer_id=0)
+            ep = f"127.0.0.1:{server.port}"
+            client.batch_barrier(ep)
+            payload = client._request(ep, transport.GET_VAR, "w0")
+            assert payload == b"pong-"
+            snap = obs.snapshot()
+            assert snap["rpc.client.requests.batch_barrier"] == 1
+            assert snap["rpc.client.requests.get_var"] == 1
+            assert snap["rpc.client.bytes_sent"] > 0
+            assert snap["rpc.client.bytes_recv"] > 0
+            assert snap["rpc.client.latency_ms"]["count"] == 2
+            assert snap["rpc.server.requests.batch_barrier"] == 1
+            assert snap["rpc.server.requests.get_var"] == 1
+            assert snap["rpc.server.bytes_in"] > 0
+            assert snap["rpc.server.handle_ms"]["count"] == 2
+            assert snap.get("rpc.client.retries", 0) == 0
+        finally:
+            server.stop()
+    finally:
+        fluid.set_flags({"rpc_transport": "native"})

@@ -30,6 +30,9 @@ MODULES = [
     "paddle_tpu.metrics",
     "paddle_tpu.io",
     "paddle_tpu.profiler",
+    "paddle_tpu.observability",
+    "paddle_tpu.observability.stats",
+    "paddle_tpu.observability.step_stats",
     "paddle_tpu.lod_tensor",
     "paddle_tpu.transpiler",
     "paddle_tpu.data_feeder",

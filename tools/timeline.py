@@ -25,6 +25,10 @@ def merge(paths):
         evs = data if isinstance(data, list) else data.get("traceEvents", [])
         for e in evs:
             e = dict(e)
+            # third-party traces (XLA dumps, hand-written markers) may
+            # omit tid/pid; catapult requires both, so default tid to 0
+            # instead of raising (pid is re-homed per input file anyway)
+            e.setdefault("tid", 0)
             e["pid"] = pid
             events.append(e)
         events.append({"name": "process_name", "ph": "M", "pid": pid,

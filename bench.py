@@ -724,6 +724,26 @@ def _probe_main():
         "platform": jax.devices()[0].platform}), flush=True)
 
 
+def _fleet_aggregator():
+    """Multi-host runs: PADDLE_TPU_BENCH_FLEET_ENDPOINTS names the other
+    workers' RPC ports (``trainer-0=host:port,trainer-1=host:port`` —
+    bare ``host:port`` entries get positional names) and the per-config
+    telemetry export then carries a cross-worker ``fleet`` merge with
+    per-worker labels (observability/aggregate.py).  Unset (the
+    single-host default) adds nothing."""
+    spec = os.environ.get("PADDLE_TPU_BENCH_FLEET_ENDPOINTS", "")
+    if not spec:
+        return None
+    workers = {}
+    for i, item in enumerate(x.strip() for x in spec.split(",")):
+        if not item:
+            continue
+        name, _, ep = item.rpartition("=")
+        workers[name or f"worker-{i}"] = ep
+    from paddle_tpu.observability.aggregate import FleetAggregator
+    return FleetAggregator(workers)
+
+
 def _worker_main(names):
     """Child: run the named configs in order, one flushed line each.
 
@@ -736,6 +756,10 @@ def _worker_main(names):
         from paddle_tpu import observability as _obs
     except Exception:  # telemetry must never take the bench down
         _obs = None
+    try:
+        fleet = _fleet_aggregator() if _obs is not None else None
+    except Exception:
+        fleet = None
     fns = dict((n, f) for n, f, _, _ in _config_table())
     for name in names:
         print("BENCHSTART=" + name, flush=True)
@@ -749,8 +773,11 @@ def _worker_main(names):
               flush=True)
         if _obs is not None:
             try:
+                tele = _obs.export(step_tail=8)
+                if fleet is not None:
+                    tele["fleet"] = fleet.export()
                 print("BENCHSTATS=" + json.dumps(
-                    {"name": name, "telemetry": _obs.export(step_tail=8)}),
+                    {"name": name, "telemetry": tele}),
                     flush=True)
             except Exception:
                 pass

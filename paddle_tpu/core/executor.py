@@ -30,6 +30,7 @@ from .lowering import analyze_block, build_block_fn
 from .program import EMPTY_VAR, Program, Variable, default_main_program
 from .selected_rows import SelectedRows
 from .types import np_dtype
+from ..observability import debug_server as _debug_server
 from ..observability import stats as _obs_stats
 from ..observability import step_stats as _obs_step
 from ..observability import trace as _obs_trace
@@ -37,6 +38,26 @@ from ..observability import trace as _obs_trace
 RNG_STATE_VAR = "@RNG_STATE@"
 
 _exec_metrics = None
+
+# live executors for the debug server's /statusz (weak: the provider
+# must never keep a notebook's discarded executor — and its compiled
+# executables — alive)
+_live_executors: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _executor_statusz() -> dict:
+    cap = _flags.get_flags("executor_cache_capacity")
+    return {
+        "cache_capacity": cap,
+        "executors": [
+            {"training": e._training,
+             "cache_entries": len(e._cache),
+             "seen_shape_buckets": len(e._seen_shapes)}
+            for e in list(_live_executors)],
+    }
+
+
+_debug_server.register_provider("executors", _executor_statusz)
 
 
 def _em():
@@ -382,6 +403,10 @@ class Executor:
         # whose training path needs the vjp-friendly scan) pick the test
         # branch; part of the executable cache key
         self._training = training
+        _live_executors.add(self)
+        # fleet observability opt-in: FLAGS_debug_server_port=0 (default)
+        # makes this a flag read — no socket, no thread
+        _debug_server.maybe_start_from_flags()
 
     # -- public API --------------------------------------------------------
     def run(

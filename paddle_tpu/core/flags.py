@@ -116,6 +116,24 @@ define_flag("rpc_server_profile_period", 0,
             "pserver self-profiling: log request-rate stats every N "
             "handled RPCs (reference FLAGS_rpc_server_profile_period, "
             "python/paddle/fluid/__init__.py:121); 0 disables")
+define_flag("debug_server_port", 0,
+            "port for the in-process observability debug HTTP server "
+            "(observability/debug_server.py: /metrics /healthz /statusz "
+            "/stepz).  0 (default) disables it entirely — no socket is "
+            "opened and no thread is started")
+define_flag("debug_server_host", "127.0.0.1",
+            "bind address for the debug HTTP server; loopback by default "
+            "(expose beyond the host deliberately, e.g. 0.0.0.0 behind a "
+            "pod-network firewall)")
+define_flag("health_suspect_misses", 1.0,
+            "missed heartbeat-lease terms (units of each worker's own "
+            "TTL) after which the health registry marks a worker SUSPECT "
+            "(observability/health.py)")
+define_flag("health_dead_misses", 3.0,
+            "missed lease terms after which a worker is DEAD: its health "
+            "gauge flips, and a TaskMaster consulting the registry "
+            "requeues the worker's task leases immediately instead of "
+            "waiting out the lease timeout")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

@@ -26,6 +26,9 @@ from typing import Callable, Dict, List, Optional
 
 from . import transport
 from .transport import OK, RPCServer
+from ..observability import health as _health
+from ..observability import stats as _obs_stats
+from ..observability.trace import flags_on as _telemetry_on
 
 GET_TASK = 16
 TASK_FINISHED = 17
@@ -33,16 +36,28 @@ TASK_FAILED = 18
 SET_DATASET = 19
 MASTER_STATE = 20
 
+# name these in the transport's RPC counters (rpc.*.requests.get_task)
+transport.MSG_NAMES.update({GET_TASK: "get_task",
+                            TASK_FINISHED: "task_finished",
+                            TASK_FAILED: "task_failed",
+                            SET_DATASET: "set_dataset",
+                            MASTER_STATE: "master_state"})
+
 
 class TaskMaster:
     """Service object for an RPCServer (go/master/service.go:89)."""
 
     def __init__(self, snapshot_path: Optional[str] = None,
                  lease_timeout: float = 10.0, failure_max: int = 3,
-                 snapshot_every: int = 1):
+                 snapshot_every: int = 1,
+                 health_source: Optional[Callable[[], Dict]] = None):
         self.snapshot_path = snapshot_path
         self.lease_timeout = lease_timeout
         self.failure_max = failure_max
+        # fleet-health integration (observability/health.py): a callable
+        # returning {trainer_id: state}; leases owned by DEAD trainers are
+        # requeued immediately instead of waiting out lease_timeout
+        self.health_source = health_source
         # durability/throughput knob: snapshot every N state transitions
         # (1 = every transition, like the Go master's per-change etcd put)
         self.snapshot_every = max(1, snapshot_every)
@@ -111,10 +126,31 @@ class TaskMaster:
                 self.next_id += 1
             self._snapshot(force=True)
 
+    def set_health_source(self, fn: Optional[Callable[[], Dict]]) -> None:
+        self.health_source = fn
+
+    def _dead_owners(self) -> set:
+        if self.health_source is None:
+            return set()
+        try:
+            states = self.health_source() or {}
+        except Exception:
+            return set()       # health plane down ≠ workers dead
+        return {owner for owner, state in states.items()
+                if state == _health.DEAD}
+
     def _requeue_expired(self) -> None:
         now = time.monotonic()
+        dead = self._dead_owners()
         expired = [tid for tid, e in self.pending.items()
-                   if e["deadline"] <= now]
+                   if e["deadline"] <= now or e["owner"] in dead]
+        n_dead = sum(1 for tid in expired
+                     if self.pending[tid]["owner"] in dead
+                     and self.pending[tid]["deadline"] > now)
+        if n_dead and _telemetry_on():
+            # leases reclaimed EARLY because the health registry declared
+            # the owner DEAD (vs. riding out lease_timeout)
+            _obs_stats.counter("master.dead_requeues").inc(n_dead)
         for tid in expired:
             task = self.pending.pop(tid)["task"]
             self._note_failure(task)
@@ -185,12 +221,66 @@ class TaskMaster:
         raise ValueError(f"unknown master message type {msg_type}")
 
 
+def registry_health_source(registry_ep: str, trainer_id: int = 0,
+                           cache_ttl: float = 5.0) -> Callable[[], Dict]:
+    """Health source for a TaskMaster: pulls the discovery registry's
+    REG_HEALTH table and maps it to {trainer_id: state}.  Cached for
+    ``cache_ttl`` so the master's hot path (every get_task holds the
+    lock through ``_requeue_expired``) does at most one RPC per ttl.
+
+    Only ``role == "TRAINER"`` heartbeats map to lease owners: pserver
+    Heartbeats (ps_ops) carry the default RPC-client trainer_id of 0,
+    and a dead *pserver* must not get healthy trainer 0's leases
+    reclaimed and its tasks failure-counted toward discard."""
+    from . import registry as _registry_mod
+    client = transport.RPCClient(trainer_id)
+    cache = {"t": float("-inf"), "val": {}}
+
+    def source() -> Dict[int, str]:
+        now = time.monotonic()
+        if now - cache["t"] >= cache_ttl:
+            # stamp BEFORE the fetch: while the registry is unreachable
+            # the connect stall must happen at most once per cache_ttl,
+            # not on every get_task under the master lock (the stale
+            # table keeps serving in between).  The stall bound is kept
+            # BELOW cache_ttl so back-to-back refreshes cannot chain —
+            # worst case the lock loses stall/cache_ttl of its duty
+            # cycle to a black-holed registry, not all of it.
+            cache["t"] = now
+            snap = _registry_mod.fetch_health(
+                client, registry_ep,
+                connect_timeout=min(2.0, max(0.5, cache_ttl / 2.0)))
+            cache["val"] = {info["trainer_id"]: info["state"]
+                            for info in snap.values()
+                            if info.get("trainer_id") is not None
+                            and info.get("role") == "TRAINER"}
+        return cache["val"]
+
+    return source
+
+
 def serve_master(endpoint: str, snapshot_path: Optional[str] = None,
-                 lease_timeout: float = 10.0, failure_max: int = 3):
+                 lease_timeout: float = 10.0, failure_max: int = 3,
+                 health_source: Optional[Callable[[], Dict]] = None):
     """Start a master service; returns (master, server) — call
     ``server.stop()`` to kill it (tests simulate master failure this way)."""
-    master = TaskMaster(snapshot_path, lease_timeout, failure_max)
+    master = TaskMaster(snapshot_path, lease_timeout, failure_max,
+                        health_source=health_source)
     server = RPCServer(endpoint, master)
+    # /statusz shows this process's queue depths when it hosts a master;
+    # the provider is keyed by port (a failover test can host two
+    # masters in one process) and torn down with the server, so a
+    # stopped master is neither kept alive nor still reported
+    from ..observability import debug_server as _debug_server
+    provider_key = f"master:{server.port}"
+    _debug_server.register_provider(provider_key, master.state)
+    impl_stop = server.stop
+
+    def stop_and_unregister():
+        _debug_server.unregister_provider(provider_key)
+        impl_stop()
+
+    server.stop = stop_and_unregister
     server.start()
     return master, server
 

@@ -14,6 +14,13 @@ elsewhere re-registers the same logical key from its shard checkpoint;
 trainers re-resolve on connection failure and carry on — no trainer
 restart (the ``client.Client`` re-dial path of the reference).
 
+The registry doubles as the fleet's health plane
+(``observability/health.py``): each lease refresh may piggyback a
+heartbeat payload (role, step counter, last error) that lands in a
+:class:`HealthTable` with HEALTHY → SUSPECT → DEAD miss-threshold
+transitions; ``REG_HEALTH`` returns the table, and a ``TaskMaster``
+consulting it requeues a DEAD trainer's task leases immediately.
+
 Enabled by ``FLAGS_pserver_registry=<host:port>`` on trainers and
 pservers; off (empty) keeps the static-endpoint behavior.
 """
@@ -22,13 +29,20 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from . import transport
+from ..observability.health import HealthTable
 
 # message types (continuing transport's numbering)
 REG_SET = 8
 REG_GET = 9
+REG_HEALTH = 10
+
+# let the transport's RPC counters name these requests
+# (rpc.client.requests.reg_set, not requests.8)
+transport.MSG_NAMES.update({REG_SET: "reg_set", REG_GET: "reg_get",
+                            REG_HEALTH: "reg_health"})
 
 DEFAULT_TTL = 10.0
 
@@ -36,13 +50,22 @@ DEFAULT_TTL = 10.0
 class RegistryService:
     """handle() contract of transport.RPCServer services."""
 
-    def __init__(self):
+    def __init__(self, health: Optional[HealthTable] = None):
         self._lock = threading.Lock()
         self._map: Dict[str, Tuple[str, float]] = {}  # logical -> (phys, expiry)
+        self.health = health if health is not None else HealthTable()
 
     def handle(self, msg_type, trainer_id, name, payload):
         if msg_type == REG_SET:
             body = json.loads(payload.decode("utf-8"))
+            if body.get("bye"):
+                # graceful exit: drop the lease AND the health entry so a
+                # cleanly-finished worker never shows up as DEAD
+                with self._lock:
+                    self._map.pop(name, None)
+                self.health.forget(name)
+                return transport.OK, b""
+            ttl = float(body["ttl"])
             with self._lock:
                 # sweep expired leases so retired logical endpoints don't
                 # accumulate forever (REG_GET only reaps its own key)
@@ -50,8 +73,13 @@ class RegistryService:
                 for k in [k for k, (_, exp) in self._map.items()
                           if exp < now]:
                     del self._map[k]
-                self._map[name] = (body["endpoint"],
-                                   now + float(body["ttl"]))
+                self._map[name] = (body["endpoint"], now + ttl)
+            hb = body.get("health")
+            if hb is not None:
+                self.health.observe(
+                    name, ttl=ttl, role=hb.get("role", ""),
+                    step=hb.get("step"), last_error=hb.get("last_error"),
+                    trainer_id=hb.get("trainer_id"))
             return transport.OK, b""
         if msg_type == REG_GET:
             with self._lock:
@@ -62,13 +90,21 @@ class RegistryService:
             if ent is None:
                 return transport.ERR, f"no live pserver for {name!r}".encode()
             return transport.OK, ent[0].encode("utf-8")
+        if msg_type == REG_HEALTH:
+            return transport.OK, json.dumps(
+                self.health.snapshot()).encode("utf-8")
         return transport.ERR, f"registry: unknown msg {msg_type}".encode()
 
 
 class RegistryServer:
-    def __init__(self, endpoint: str):
-        self.service = RegistryService()
+    def __init__(self, endpoint: str,
+                 health: Optional[HealthTable] = None):
+        self.service = RegistryService(health)
         self._server = transport.RPCServer(endpoint, self.service)
+
+    @property
+    def health(self) -> HealthTable:
+        return self.service.health
 
     @property
     def port(self) -> int:
@@ -82,9 +118,21 @@ class RegistryServer:
 
 
 def register(client: "transport.RPCClient", registry_ep: str, logical: str,
-             physical: str, ttl: float = DEFAULT_TTL) -> None:
-    payload = json.dumps({"endpoint": physical, "ttl": ttl}).encode("utf-8")
-    client._raw_request(registry_ep, REG_SET, logical, payload,
+             physical: str, ttl: float = DEFAULT_TTL,
+             health: Optional[dict] = None) -> None:
+    body = {"endpoint": physical, "ttl": ttl}
+    if health is not None:
+        body["health"] = health
+    client._raw_request(registry_ep, REG_SET, logical,
+                        json.dumps(body).encode("utf-8"), retry_all=True)
+
+
+def deregister(client: "transport.RPCClient", registry_ep: str,
+               logical: str) -> None:
+    """Graceful goodbye: remove the lease and the health entry (a clean
+    exit must not age into SUSPECT/DEAD on the registry's books)."""
+    client._raw_request(registry_ep, REG_SET, logical,
+                        json.dumps({"bye": True}).encode("utf-8"),
                         retry_all=True)
 
 
@@ -98,32 +146,70 @@ def resolve(client: "transport.RPCClient", registry_ep: str,
         return None          # not registered / lease expired
 
 
+def fetch_health(client: "transport.RPCClient", registry_ep: str,
+                 connect_timeout: Optional[float] = None) -> Dict[str, dict]:
+    """The registry's health table: {worker: {state, role, step, ...}}."""
+    out = client._raw_request(registry_ep, REG_HEALTH, retry_all=True,
+                              connect_timeout=connect_timeout)
+    return json.loads(out.decode("utf-8"))
+
+
 class Heartbeat:
-    """Daemon lease-refresher (etcd_client.go keepalive analogue)."""
+    """Daemon lease-refresher (etcd_client.go keepalive analogue).
+
+    ``health_fn`` (optional) is called per refresh and its dict — role,
+    step counter, last_error, trainer_id — rides the REG_SET into the
+    registry's :class:`HealthTable`; a worker whose heartbeat stops is
+    marked SUSPECT then DEAD by miss thresholds (health.py).  Static
+    fields can be passed as ``role``/``trainer_id`` without a callable.
+    """
 
     def __init__(self, registry_ep: str, logical: str, physical: str,
-                 ttl: float = DEFAULT_TTL, trainer_id: int = 0):
+                 ttl: float = DEFAULT_TTL, trainer_id: int = 0,
+                 role: str = "", health_fn: Optional[Callable[[], dict]] = None):
         self.registry_ep = registry_ep
         self.logical = logical
         self.physical = physical
         self.ttl = ttl
+        self.role = role
+        self.trainer_id = trainer_id
+        self.health_fn = health_fn
         self._client = transport.RPCClient(trainer_id)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"registry-hb-{logical}")
 
-    def start(self):
+    def _health_payload(self) -> dict:
+        hb = {"role": self.role, "trainer_id": self.trainer_id}
+        if self.health_fn is not None:
+            try:
+                hb.update(self.health_fn() or {})
+            except Exception as e:  # a broken probe must not stop the lease
+                hb["last_error"] = repr(e)[:200]
+        return hb
+
+    def _register_once(self) -> None:
         register(self._client, self.registry_ep, self.logical,
-                 self.physical, self.ttl)
+                 self.physical, self.ttl, health=self._health_payload())
+
+    def start(self):
+        self._register_once()
         self._thread.start()
 
     def _run(self):
         while not self._stop.wait(self.ttl / 3.0):
             try:
-                register(self._client, self.registry_ep, self.logical,
-                         self.physical, self.ttl)
+                self._register_once()
             except Exception:
                 pass             # registry briefly down: keep trying
 
-    def stop(self):
+    def stop(self, bye: bool = False):
+        """Stop refreshing.  ``bye=True`` additionally deregisters (the
+        clean-shutdown path); the default leaves the lease to expire —
+        which is also what an actual crash looks like to the registry."""
         self._stop.set()
+        if bye:
+            try:
+                deregister(self._client, self.registry_ep, self.logical)
+            except Exception:
+                pass         # registry already gone: nothing to clean

@@ -50,6 +50,10 @@ FETCH_BARRIER = 4
 COMPLETE = 5
 PREFETCH = 6
 CHECKPOINT_NOTIFY = 7
+# fleet observability (observability/aggregate.py): answered centrally by
+# _serve_io for EVERY service object, so any RPCServer — pserver, master,
+# registry — can be scraped for its process-local metric snapshot
+STATS_PULL = 24
 # message types (response)
 OK = 0
 ERR = 255
@@ -57,7 +61,8 @@ ERR = 255
 MSG_NAMES = {SEND_VAR: "send_var", GET_VAR: "get_var",
              BATCH_BARRIER: "batch_barrier", FETCH_BARRIER: "fetch_barrier",
              COMPLETE: "complete", PREFETCH: "prefetch",
-             CHECKPOINT_NOTIFY: "checkpoint_notify"}
+             CHECKPOINT_NOTIFY: "checkpoint_notify",
+             STATS_PULL: "stats_pull"}
 
 _HDR = struct.Struct("<BiH")  # msg_type, trainer_id, name_len
 
@@ -244,7 +249,12 @@ def _serve_io(io, service) -> None:
         t0 = time.perf_counter() if tel else None
         msg_type, tid, name, payload = _unpack_body(body)
         try:
-            rtype, rpayload = service.handle(msg_type, tid, name, payload)
+            if msg_type == STATS_PULL:
+                # fleet scrape: served here so every service gets it
+                from ..observability import aggregate as _obs_aggregate
+                rtype, rpayload = OK, _obs_aggregate.local_snapshot_payload()
+            else:
+                rtype, rpayload = service.handle(msg_type, tid, name, payload)
         except Exception as e:
             rtype, rpayload = ERR, repr(e).encode("utf-8")
         resp = _pack_body(rtype, tid, name, rpayload)
@@ -301,6 +311,10 @@ class RPCServer:
         return self._impl.port
 
     def start(self) -> None:
+        # every serving process is debug-scrapable when the flag asks
+        # for it (no-op, no socket, at the default flag value 0)
+        from ..observability import debug_server as _debug_server
+        _debug_server.maybe_start_from_flags()
         self._impl.start()
 
     def stop(self) -> None:
@@ -308,7 +322,8 @@ class RPCServer:
 
 
 def wait_server_ready(endpoints, timeout: float = 90.0,
-                      ready_dir: Optional[str] = None) -> None:
+                      ready_dir: Optional[str] = None,
+                      log_every: float = 2.0) -> None:
     """Block until every endpoint's server is listening.
 
     With ``PADDLE_READY_DIR`` set (the deterministic path — every
@@ -317,25 +332,42 @@ def wait_server_ready(endpoints, timeout: float = 90.0,
     races with a server mid-bind.  Without it, falls back to probe
     connects (the reference ``_wait_ps_ready`` role,
     test_dist_base.py:232, bounded here by ``timeout``).
+
+    The wait is never silent: every probe round that leaves servers
+    pending increments ``rpc.wait_server.retries``, and a progress line
+    goes to stderr every ``log_every`` seconds — a launcher stuck here
+    for 90 s used to look identical to a hang.
     """
-    deadline = time.monotonic() + timeout
+    t_start = time.monotonic()
+    deadline = t_start + timeout
+    next_log = t_start + log_every
     ready_dir = ready_dir or os.environ.get("PADDLE_READY_DIR")
     pending = [e.strip() for e in endpoints]
     while pending:
-        ep = pending[0]
-        if ready_dir:
-            ok = os.path.exists(os.path.join(ready_dir, ep + ".ready"))
-        else:
-            host, port = ep.rsplit(":", 1)
-            try:
-                socket.create_connection((host, int(port)), 1.0).close()
-                ok = True
-            except OSError:
-                ok = False
-        if ok:
-            pending.pop(0)
-            continue
-        if time.monotonic() > deadline:
+        still = []
+        for ep in pending:
+            if ready_dir:
+                ok = os.path.exists(os.path.join(ready_dir, ep + ".ready"))
+            else:
+                ok = RPCClient._probe(ep, 1.0)
+            if not ok:
+                still.append(ep)
+        pending = still
+        if not pending:
+            return
+        if _telemetry_on():
+            _obs_stats.counter(
+                "rpc.wait_server.retries",
+                "probe rounds that left at least one server pending in "
+                "wait_server_ready").inc()
+        now = time.monotonic()
+        if now >= next_log:
+            print(f"[wait_server_ready] {now - t_start:.1f}s: waiting for "
+                  f"{len(pending)} server(s): {', '.join(pending[:4])}"
+                  + (" ..." if len(pending) > 4 else ""),
+                  file=_sys.stderr, flush=True)
+            next_log = now + log_every
+        if now > deadline:
             raise TimeoutError(
                 f"servers not ready after {timeout:.0f}s: {pending} "
                 + (f"(no ready-file in {ready_dir})" if ready_dir
@@ -583,10 +615,11 @@ class RPCClient:
     # surface the error instead (the reference's at-most-once discipline
     # for mutating RPCs).
     _RETRYABLE = frozenset((GET_VAR, PREFETCH, FETCH_BARRIER,
-                            CHECKPOINT_NOTIFY))
+                            CHECKPOINT_NOTIFY, STATS_PULL))
 
     def _raw_request(self, endpoint: str, msg_type: int, name: str = "",
-                     payload: bytes = b"", retry_all: bool = False):
+                     payload: bytes = b"", retry_all: bool = False,
+                     connect_timeout: Optional[float] = None):
         tel = _telemetry_on()
         t0 = time.perf_counter() if tel else None
         sc = _obs_stats.scope("rpc.client") if tel else None
@@ -594,8 +627,12 @@ class RPCClient:
         body = None
         for attempt in (0, 1):
             # retry connects get a short deadline: the long one is only for
-            # initial bring-up (pservers may start after trainers)
-            c = self._conn(endpoint, _CONNECT_TIMEOUT if attempt == 0 else 5.0)
+            # initial bring-up (pservers may start after trainers).  Callers
+            # with their own fast-fail policy (fleet metric pulls that must
+            # not hang the scrape on one dead worker) pass connect_timeout.
+            c = self._conn(endpoint,
+                           connect_timeout if connect_timeout is not None
+                           else _CONNECT_TIMEOUT if attempt == 0 else 5.0)
             try:
                 with c.lock:
                     c.io.send_frame(req)

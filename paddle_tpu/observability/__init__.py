@@ -15,6 +15,15 @@ Three cooperating pieces (see each module's docstring):
   stream under a ``runtime::`` category, so Chrome traces show executor
   internals alongside user spans.
 
+The export/aggregation half (this package's fleet plane):
+
+- :mod:`debug_server` — opt-in (``FLAGS_debug_server_port``) HTTP
+  daemon serving ``/metrics`` ``/healthz`` ``/statusz`` ``/stepz``;
+- :mod:`health` — heartbeat-driven worker liveness
+  (HEALTHY/SUSPECT/DEAD), fed by the discovery registry's TTL leases;
+- :mod:`aggregate` — STATS_PULL RPC + cross-worker merge of counters /
+  gauges / histograms into per-worker-labeled ``fleet:*`` series.
+
 Everything is gated by ``FLAGS_runtime_stats`` (env
 ``FLAGS_runtime_stats=0`` disables all collection); spans additionally
 require the profiler to be armed, so the default-path overhead is a
@@ -22,7 +31,9 @@ flag lookup.
 """
 from __future__ import annotations
 
-from . import stats, step_stats, trace  # noqa: F401
+from . import aggregate, debug_server, health, stats, step_stats, trace  # noqa: F401
+from .aggregate import FleetAggregator  # noqa: F401
+from .health import HealthTable  # noqa: F401
 from .stats import (  # noqa: F401
     StatsRegistry,
     default_registry,
@@ -40,10 +51,10 @@ def enabled() -> bool:
 def export(step_tail: int = 32) -> dict:
     """One JSON-ready bundle: metrics snapshot + step-stats summary/tail.
 
-    The shape bench.py dumps per config into ``step_stats.json``.
+    The shape bench.py dumps per config into ``step_stats.json`` and the
+    debug server serves on ``/stepz``.
     """
-    import json
-    return {"stats": json.loads(stats.to_json())["metrics"],
+    return {"stats": stats.to_dict(),
             "step_stats": step_stats.recorder().export(tail=step_tail)}
 
 

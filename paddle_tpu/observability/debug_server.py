@@ -1,0 +1,249 @@
+"""In-process debug HTTP server: /metrics, /healthz, /statusz, /stepz.
+
+The Borgmon/Prometheus pull model for the telemetry core: every process
+(trainer, pserver, master) can expose its :mod:`stats` registry and
+:mod:`step_stats` ring on a loopback HTTP port so operators and
+scrapers reach telemetry *without* attaching to the process.  Strictly
+opt-in: with ``FLAGS_debug_server_port`` unset (0, the default) no
+socket is opened and no thread is started — ``maybe_start_from_flags``
+is a flag read and nothing else.
+
+Endpoints (all GET):
+
+- ``/metrics``  Prometheus text from ``stats.to_prometheus_text()``;
+  when a fleet aggregator is attached (``attach_aggregator``), its
+  ``fleet:*``-prefixed cross-worker series are appended.
+- ``/healthz``  JSON liveness: process uptime, steps recorded, age of
+  the last ``Executor.run`` StepStats record (a serving process whose
+  last-step age keeps growing is stuck even though the port answers).
+- ``/statusz``  JSON process card: role, pid, flags, and every
+  registered status provider (executor cache occupancy, ``TaskMaster``
+  queue depths, ...).
+- ``/stepz``    JSON ``observability.export()`` (metrics snapshot +
+  step-stats summary/tail).
+
+Built on stdlib ``http.server`` (ThreadingHTTPServer, daemon threads):
+no new dependencies, safe to leave running in tests and serving
+processes.  One process-wide singleton; ``start()``/``stop()`` are
+idempotent and test-friendly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from . import stats as _stats
+from . import step_stats as _step_stats
+from . import trace as _trace
+
+_START_TIME = time.time()
+
+_lock = threading.Lock()
+_server: Optional["DebugServer"] = None
+_providers: Dict[str, Callable[[], object]] = {}
+_role: Optional[str] = None
+_aggregator = None  # duck-typed: anything with .to_prometheus_text()
+
+
+def register_provider(name: str, fn: Callable[[], object]) -> None:
+    """Add a /statusz section: ``fn()`` returns a JSON-able value.
+    Re-registering a name replaces it (latest owner wins)."""
+    with _lock:
+        _providers[name] = fn
+
+
+def unregister_provider(name: str) -> None:
+    with _lock:
+        _providers.pop(name, None)
+
+
+def set_role(role: Optional[str]) -> None:
+    """Override the /statusz role (default: PADDLE_TRAINING_ROLE env)."""
+    global _role
+    _role = role
+
+
+def attach_aggregator(agg) -> None:
+    """Serve a FleetAggregator's merged series on /metrics (trainer 0 /
+    the master call this; ``None`` detaches)."""
+    global _aggregator
+    _aggregator = agg
+
+
+def _current_role() -> str:
+    if _role:
+        return _role
+    return os.environ.get("PADDLE_TRAINING_ROLE", "STANDALONE")
+
+
+def _healthz() -> dict:
+    rec = _step_stats.recorder()
+    last = rec.last_n(1)
+    return {
+        "status": "ok",
+        "role": _current_role(),
+        "uptime_s": round(time.time() - _START_TIME, 3),
+        "runtime_stats": _trace.flags_on(),
+        "steps_recorded": rec.total_recorded,
+        "last_step_age_s": (round(time.time() - last[0].ts, 3)
+                            if last else None),
+    }
+
+
+def _statusz() -> dict:
+    from ..core import flags as _flags
+    with _lock:
+        providers = dict(_providers)
+    out = {
+        "role": _current_role(),
+        "pid": os.getpid(),
+        "argv": sys.argv,
+        "uptime_s": round(time.time() - _START_TIME, 3),
+        "constant_labels": _stats.default_registry().constant_labels(),
+        "flags": _flags.all_flags(),
+    }
+    for name, fn in sorted(providers.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # one broken provider must not 500 the page
+            out[name] = {"error": repr(e)[:200]}
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # stderr-per-request logging would swamp training logs; count instead
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _reply(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype + "; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (http.server casing)
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        sc = _stats.scope("debug_server")
+        try:
+            if path == "/metrics":
+                text = _stats.to_prometheus_text()
+                agg = _aggregator
+                if agg is not None:
+                    try:
+                        text += agg.to_prometheus_text()
+                    except Exception as e:
+                        text += f"# fleet aggregation failed: {e!r}\n"
+                self._reply(200, text, "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                self._reply(200, json.dumps(_healthz(), indent=2),
+                            "application/json")
+            elif path == "/statusz":
+                self._reply(200, json.dumps(_statusz(), indent=2,
+                                            default=repr),
+                            "application/json")
+            elif path == "/stepz":
+                from . import export
+                self._reply(200, json.dumps(export(), indent=2),
+                            "application/json")
+            elif path == "/":
+                self._reply(200, "\n".join(
+                    ["paddle_tpu debug server", "",
+                     "/metrics  /healthz  /statusz  /stepz", ""]),
+                    "text/plain")
+            else:
+                sc.counter("not_found").inc()
+                self._reply(404, f"no such page: {path}\n", "text/plain")
+                return
+            sc.counter("requests" + path.replace("/", ".")).inc()
+        except Exception as e:  # pragma: no cover - handler last resort
+            try:
+                self._reply(500, f"internal error: {e!r}\n", "text/plain")
+            except Exception:
+                pass
+
+
+class DebugServer:
+    """One ThreadingHTTPServer on a daemon thread (see module doc)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name=f"debug-server-{host}:{self.port}")
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def server() -> Optional[DebugServer]:
+    """The running singleton, or None (the flag-off steady state)."""
+    return _server
+
+
+def start(port: int = 0, host: Optional[str] = None) -> DebugServer:
+    """Start (or return) the process-wide server.  ``port=0`` binds an
+    ephemeral port — tests read ``.port`` back."""
+    global _server
+    with _lock:
+        if _server is not None:
+            return _server
+        from ..core import flags as _flags
+        if host is None:
+            try:
+                host = _flags.get_flags("debug_server_host")
+            except KeyError:  # pragma: no cover
+                host = "127.0.0.1"
+        srv = DebugServer(port=port, host=host)
+        srv.start()
+        _server = srv
+        return srv
+
+
+def stop() -> None:
+    global _server
+    with _lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()
+
+
+def maybe_start_from_flags() -> Optional[DebugServer]:
+    """The wiring hook (Executor init, RPCServer start): starts the
+    singleton iff ``FLAGS_debug_server_port`` > 0.  With the flag at its
+    default 0 this is a dict lookup — no socket, no thread."""
+    from ..core import flags as _flags
+    try:
+        port = int(_flags.get_flags("debug_server_port"))
+    except KeyError:  # pragma: no cover
+        return None
+    if port <= 0:
+        return _server
+    try:
+        return start(port=port)
+    except OSError as e:
+        # a second process on the host with the same flag value: telemetry
+        # must never take training down — warn and run without the server
+        print(f"[debug-server] cannot bind port {port}: {e}",
+              file=sys.stderr, flush=True)
+        return None

@@ -46,6 +46,30 @@ def _prom_name(name: str) -> str:
     return out
 
 
+def _prom_label_value(v) -> str:
+    """Escape a label value per the exposition format."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prom_labels(labels: Dict[str, object], extra: str = "") -> str:
+    """``{k="v",...}`` rendering (sorted keys; '' when empty)."""
+    parts = [f'{_prom_name(k)}="{_prom_label_value(v)}"'
+             for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _jsonable(v):
+    if isinstance(v, dict):
+        # histogram bucket keys are floats incl. +Inf: stringify every
+        # key so sort_keys never compares str to float
+        return {(k if isinstance(k, str) else _prom_num(k)):
+                _jsonable(x) for k, x in v.items()}
+    return v
+
+
 def _prom_num(v) -> str:
     """Prometheus floats: +Inf spelled out, integers without .0 noise."""
     if v == float("inf"):
@@ -223,6 +247,21 @@ class StatsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
+        # constant labels stamped on every exported series (e.g.
+        # process_index/process_count from parallel/multihost.py) so a
+        # fleet scrape can tell per-process exports apart
+        self._constant_labels: Dict[str, str] = {}
+
+    def set_constant_labels(self, labels: Dict[str, object]) -> None:
+        """Replace the constant label set ({} clears).  Applied at export
+        time only — metric objects and snapshots are label-free."""
+        with self._lock:
+            self._constant_labels = {str(k): str(v)
+                                     for k, v in (labels or {}).items()}
+
+    def constant_labels(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._constant_labels)
 
     def _get_or_create(self, name: str, factory, kind: str):
         with self._lock:
@@ -276,6 +315,8 @@ class StatsRegistry:
         """Prometheus text exposition format, one family per metric."""
         with self._lock:
             items = sorted(self._metrics.items())
+            clabels = dict(self._constant_labels)
+        base = prom_labels(clabels)
         lines = []
         for name, m in items:
             pn = _prom_name(name)
@@ -286,24 +327,41 @@ class StatsRegistry:
                 snap = m.snapshot()
                 for le, cum in snap["buckets"].items():
                     lines.append(
-                        f'{pn}_bucket{{le="{_prom_num(le)}"}} {cum}')
-                lines.append(f"{pn}_sum {_prom_num(snap['sum'])}")
-                lines.append(f"{pn}_count {snap['count']}")
+                        pn + "_bucket"
+                        + prom_labels(clabels, f'le="{_prom_num(le)}"')
+                        + f" {cum}")
+                lines.append(f"{pn}_sum{base} {_prom_num(snap['sum'])}")
+                lines.append(f"{pn}_count{base} {snap['count']}")
             else:
-                lines.append(f"{pn} {_prom_num(m.snapshot())}")
+                lines.append(f"{pn}{base} {_prom_num(m.snapshot())}")
         return "\n".join(lines) + ("\n" if lines else "")
 
+    def to_dict(self) -> Dict[str, object]:
+        """JSON-ready ``snapshot()``: histogram bucket keys (floats incl.
+        +Inf) stringified so the dict survives ``json.dumps`` untouched —
+        the shape ``observability.export()`` embeds directly."""
+        return {k: _jsonable(v) for k, v in self.snapshot().items()}
+
+    def export_state(self) -> dict:
+        """Merge-ready wire form for cross-worker aggregation
+        (observability/aggregate.py): every metric tagged with its kind,
+        histogram buckets as stringified cumulative-``le`` counts, plus
+        this process's constant labels."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+            clabels = dict(self._constant_labels)
+        metrics = {}
+        for name, m in items:
+            if isinstance(m, Histogram):
+                snap = _jsonable(m.snapshot())
+                metrics[name] = {"kind": m.kind, **snap}
+            else:
+                metrics[name] = {"kind": m.kind, "value": m.snapshot()}
+        return {"labels": clabels, "metrics": metrics}
+
     def to_json(self, indent: Optional[int] = None) -> str:
-        def _jsonable(v):
-            if isinstance(v, dict):
-                # histogram bucket keys are floats incl. +Inf: stringify
-                # every key so sort_keys never compares str to float
-                return {(k if isinstance(k, str) else _prom_num(k)):
-                        _jsonable(x) for k, x in v.items()}
-            return v
-        snap = {k: _jsonable(v) for k, v in self.snapshot().items()}
-        return json.dumps({"ts": time.time(), "metrics": snap}, indent=indent,
-                          sort_keys=True)
+        return json.dumps({"ts": time.time(), "metrics": self.to_dict()},
+                          indent=indent, sort_keys=True)
 
     def dump_json(self, path: str, indent: int = 2) -> None:
         with open(path, "w") as f:
@@ -354,6 +412,14 @@ def snapshot() -> Dict[str, object]:
 
 def to_prometheus_text() -> str:
     return _default.to_prometheus_text()
+
+
+def to_dict() -> Dict[str, object]:
+    return _default.to_dict()
+
+
+def export_state() -> dict:
+    return _default.export_state()
 
 
 def to_json(indent: Optional[int] = None) -> str:

@@ -22,6 +22,17 @@ from typing import Mapping, Optional, Tuple
 
 import jax
 
+from ..observability import stats as _obs_stats
+
+
+def _stamp_process_labels(process_index: int, process_count: int) -> None:
+    """Constant-label the default metrics registry with this process's
+    coordinates so multi-host ``/metrics`` exports (and fleet pulls of
+    them) are distinguishable from single-host ones — and from each
+    other — without the scraper inferring identity from the port."""
+    _obs_stats.default_registry().set_constant_labels(
+        {"process_index": process_index, "process_count": process_count})
+
 
 def init_from_env(environ: Optional[Mapping[str, str]] = None) -> Tuple[int, int]:
     """Initialize the multi-process JAX world from PADDLE_* env vars.
@@ -39,7 +50,10 @@ def init_from_env(environ: Optional[Mapping[str, str]] = None) -> Tuple[int, int
     # backend, after which jax.distributed.initialize refuses to run
     from jax._src import distributed as _dist
     if _dist.global_state.client is not None:
-        return jax.process_index(), jax.process_count()
+        idx, count = jax.process_index(), jax.process_count()
+        if count > 1:
+            _stamp_process_labels(idx, count)
+        return idx, count
 
     endpoints = env.get("PADDLE_TRAINER_ENDPOINTS", "")
     trainer_id = int(env.get("PADDLE_TRAINER_ID", "0"))
@@ -54,4 +68,5 @@ def init_from_env(environ: Optional[Mapping[str, str]] = None) -> Tuple[int, int
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_trainers,
                                process_id=trainer_id)
+    _stamp_process_labels(trainer_id, num_trainers)
     return trainer_id, num_trainers

@@ -1,0 +1,520 @@
+"""Fleet observability plane (ISSUE 2): debug HTTP server
+(/metrics /healthz /statusz /stepz), heartbeat-driven worker health
+(HEALTHY/SUSPECT/DEAD) riding the registry's TTL leases, health-aware
+TaskMaster lease requeue, and cross-worker metric aggregation over the
+STATS_PULL RPC — plus the satellite fixes (export() double
+serialization, wait_server_ready progress, registry lease sweeps)."""
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.core import flags as core_flags
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope, scope_guard
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.distributed import transport
+from paddle_tpu.distributed.master import (TaskMaster,
+                                           registry_health_source,
+                                           serve_master)
+from paddle_tpu.distributed.registry import (REG_GET, REG_SET, Heartbeat,
+                                             RegistryServer, RegistryService,
+                                             fetch_health, register, resolve)
+from paddle_tpu.observability import aggregate, debug_server
+from paddle_tpu.observability import stats as stats_mod
+from paddle_tpu.observability.health import (DEAD, HEALTHY, SUSPECT,
+                                             HealthTable)
+
+
+@pytest.fixture(autouse=True)
+def _clean_debug_server():
+    """Every test leaves the singleton stopped and the flag at 0."""
+    yield
+    debug_server.attach_aggregator(None)
+    debug_server.stop()
+    core_flags.set_flags({"debug_server_port": 0})
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(port: int, page: str) -> str:
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{page}", timeout=10).read().decode("utf-8")
+
+
+def _tiny_program():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        h = fluid.layers.fc(x, 8, act="tanh")
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return prog, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# debug HTTP server
+# ---------------------------------------------------------------------------
+
+def test_flag_unset_starts_nothing():
+    """Default FLAGS_debug_server_port=0: no socket, no thread."""
+    assert core_flags.get_flags("debug_server_port") == 0
+    before = {t.name for t in threading.enumerate()}
+    exe = Executor()
+    assert debug_server.maybe_start_from_flags() is None
+    assert debug_server.server() is None
+    after = {t.name for t in threading.enumerate()}
+    assert not [n for n in after - before if n.startswith("debug-server")]
+    del exe
+
+
+def test_debug_server_serves_metrics_during_run_loop():
+    """Acceptance: flag set → executor starts the server; /metrics GET
+    during a live run loop returns Prometheus text with executor.* and
+    rpc.* series; /healthz reports ready."""
+    port = _free_port()
+    core_flags.set_flags({"debug_server_port": port})
+    prog, startup, loss = _tiny_program()
+    scope = Scope()
+    with scope_guard(scope):
+        exe = Executor()
+        assert debug_server.server() is not None
+        assert debug_server.server().port == port
+        exe.run(startup)
+
+        # some rpc.* series: one registry round trip through the client
+        reg = RegistryServer("127.0.0.1:0")
+        reg.start()
+        client = transport.RPCClient(0)
+        register(client, f"127.0.0.1:{reg.port}", "ps0", "10.0.0.1:70")
+
+        stop = threading.Event()
+        failures = []
+
+        def run_loop():
+            x = np.random.rand(8, 4).astype("float64")
+            while not stop.is_set():
+                try:
+                    exe.run(prog, feed={"x": x}, fetch_list=[loss])
+                except Exception as e:  # pragma: no cover
+                    failures.append(e)
+                    return
+
+        t = threading.Thread(target=run_loop, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 20
+            while obs.step_stats.recorder().total_recorded < 3:
+                assert time.monotonic() < deadline and not failures
+                time.sleep(0.02)
+            text = _get(port, "/metrics")
+            assert "# TYPE executor_steps counter" in text
+            assert "executor_run_wall_ms_bucket" in text
+            assert "rpc_client_requests_reg_set" in text
+            hz = json.loads(_get(port, "/healthz"))
+            assert hz["status"] == "ok"
+            assert hz["steps_recorded"] >= 3
+            assert hz["last_step_age_s"] is not None
+            sz = json.loads(_get(port, "/statusz"))
+            assert sz["pid"] > 0 and "flags" in sz
+            assert any(e["cache_entries"] >= 1
+                       for e in sz["executors"]["executors"])
+            stz = json.loads(_get(port, "/stepz"))
+            assert stz["step_stats"]["summary"]["total_recorded"] >= 3
+            assert "executor.steps" in stz["stats"]
+            with pytest.raises(urllib.error.HTTPError):
+                _get(port, "/nope")
+        finally:
+            stop.set()
+            t.join(timeout=10)
+            reg.stop()
+    assert not failures
+
+
+def test_statusz_reports_master_queues():
+    ep = "127.0.0.1:0"
+    master, server = serve_master(ep)
+    try:
+        master.set_dataset(["a", "b"])
+        master.get_task(owner=0)
+        port = _free_port()
+        core_flags.set_flags({"debug_server_port": port})
+        assert debug_server.maybe_start_from_flags() is not None
+        key = f"master:{server.port}"
+        sz = json.loads(_get(port, "/statusz"))
+        assert sz[key]["todo"] == 1 and sz[key]["pending"] == 1
+        server.stop()
+        # stopping the master tears its provider down (no leak, no
+        # stale /statusz section for a dead master)
+        assert key not in json.loads(_get(port, "/statusz"))
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# export()/to_dict (satellite: double-serialization fix)
+# ---------------------------------------------------------------------------
+
+def test_to_dict_matches_json_roundtrip():
+    reg = stats_mod.StatsRegistry()
+    reg.counter("c").inc(2)
+    reg.histogram("h", buckets=(1.0, 5.0)).observe(3.0)
+    assert reg.to_dict() == json.loads(reg.to_json())["metrics"]
+    # +Inf bucket key is already a string — dict dumps untouched
+    json.dumps(reg.to_dict())
+
+
+def test_export_uses_dict_directly():
+    obs.reset()
+    stats_mod.counter("executor.steps").inc()
+    out = obs.export(step_tail=4)
+    assert out["stats"]["executor.steps"] >= 1
+    json.dumps(out)
+
+
+# ---------------------------------------------------------------------------
+# constant labels (multihost process stamping)
+# ---------------------------------------------------------------------------
+
+def test_constant_labels_in_prometheus_text():
+    reg = stats_mod.StatsRegistry()
+    reg.counter("c").inc(4)
+    reg.histogram("h", buckets=(1.0,)).observe(0.5)
+    reg.set_constant_labels({"process_index": 1, "process_count": 4})
+    text = reg.to_prometheus_text()
+    assert 'c{process_count="4",process_index="1"} 4' in text
+    assert 'h_bucket{process_count="4",process_index="1",le="1"} 1' in text
+    assert 'h_count{process_count="4",process_index="1"} 1' in text
+    state = reg.export_state()
+    assert state["labels"] == {"process_index": "1", "process_count": "4"}
+    reg.set_constant_labels({})
+    assert "{" not in reg.to_prometheus_text().splitlines()[-1]
+
+
+def test_multihost_stamps_default_registry():
+    from paddle_tpu.parallel import multihost
+    try:
+        multihost._stamp_process_labels(2, 8)
+        labels = stats_mod.default_registry().constant_labels()
+        assert labels == {"process_index": "2", "process_count": "8"}
+    finally:
+        stats_mod.default_registry().set_constant_labels({})
+
+
+# ---------------------------------------------------------------------------
+# health table
+# ---------------------------------------------------------------------------
+
+def test_health_state_transitions():
+    t = HealthTable(suspect_misses=1.0, dead_misses=3.0)
+    t.observe("w0", ttl=0.2, role="TRAINER", step=5, trainer_id=0)
+    assert t.status("w0") == HEALTHY
+    time.sleep(0.3)                      # age ~0.3 in (0.2, 0.6]
+    assert t.status("w0") == SUSPECT
+    time.sleep(0.4)                      # age ~0.7 > 0.6
+    assert t.status("w0") == DEAD
+    assert t.dead_trainers() == {0}
+    t.observe("w0", ttl=0.2)             # heartbeat resumes
+    assert t.status("w0") == HEALTHY
+    snap = t.snapshot()
+    assert snap["w0"]["role"] == "TRAINER" and snap["w0"]["heartbeats"] == 2
+    assert stats_mod.default_registry().get(
+        "health.workers_healthy").value == 1
+    t.forget("w0")
+    assert t.status("w0") is None
+
+
+def test_health_thresholds_validated():
+    with pytest.raises(ValueError):
+        HealthTable(suspect_misses=3.0, dead_misses=2.0)
+    with pytest.raises(ValueError):
+        HealthTable(suspect_misses=1.0, dead_misses=3.0, forget_misses=2.0)
+    # the retention default scales with dead_misses: a flags-only bump
+    # of FLAGS_health_dead_misses can never invert the ordering
+    assert HealthTable(dead_misses=150.0).forget_misses == 1500.0
+    assert HealthTable().forget_misses == 120.0
+
+
+def test_dead_trainers_filters_non_trainer_roles():
+    t = HealthTable(suspect_misses=1.0, dead_misses=2.0)
+    t.observe("ps-0", ttl=0.05, role="PSERVER", trainer_id=0)
+    t.observe("trainer-1", ttl=0.05, role="TRAINER", trainer_id=1)
+    time.sleep(0.2)
+    # both DEAD, but only the TRAINER maps to a lease owner
+    assert t.status("ps-0") == DEAD and t.status("trainer-1") == DEAD
+    assert t.dead_trainers() == {1}
+
+
+def test_health_retention_bound_reaps_old_corpses():
+    t = HealthTable(suspect_misses=1.0, dead_misses=2.0, forget_misses=4.0)
+    t.observe("old-job-worker", ttl=0.05)
+    time.sleep(0.15)
+    assert t.status("old-job-worker") == DEAD   # past dead, inside forget
+    time.sleep(0.15)                            # age ~0.3 > 4*0.05
+    assert t.status("old-job-worker") is None   # reaped
+    assert t.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# registry: lease expiry + sweep (satellite tests) and REG_HEALTH
+# ---------------------------------------------------------------------------
+
+def test_reg_set_sweeps_expired_leases():
+    svc = RegistryService()
+    body = lambda ep, ttl: json.dumps(  # noqa: E731
+        {"endpoint": ep, "ttl": ttl}).encode()
+    svc.handle(REG_SET, 0, "ps-old", body("10.0.0.1:1", 0.05))
+    svc.handle(REG_SET, 0, "ps-live", body("10.0.0.2:2", 60.0))
+    time.sleep(0.1)
+    assert "ps-old" in svc._map           # not yet swept
+    svc.handle(REG_SET, 0, "ps-new", body("10.0.0.3:3", 60.0))
+    assert "ps-old" not in svc._map       # REG_SET swept the expired key
+    assert set(svc._map) == {"ps-live", "ps-new"}
+
+
+def test_reg_get_lazy_reap_and_reregistration():
+    svc = RegistryService()
+    body = lambda ep, ttl: json.dumps(  # noqa: E731
+        {"endpoint": ep, "ttl": ttl}).encode()
+    svc.handle(REG_SET, 0, "ps0", body("10.0.0.1:7000", 0.05))
+    rtype, payload = svc.handle(REG_GET, 0, "ps0", b"")
+    assert rtype == transport.OK and payload == b"10.0.0.1:7000"
+    time.sleep(0.1)
+    rtype, _ = svc.handle(REG_GET, 0, "ps0", b"")
+    assert rtype == transport.ERR         # lease expired (lazy reap)
+    assert "ps0" not in svc._map
+    # re-registration after expiry resolves to the NEW physical endpoint
+    svc.handle(REG_SET, 0, "ps0", body("10.0.0.9:7001", 60.0))
+    rtype, payload = svc.handle(REG_GET, 0, "ps0", b"")
+    assert rtype == transport.OK and payload == b"10.0.0.9:7001"
+
+
+def test_registry_expiry_over_sockets():
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        client = transport.RPCClient(0)
+        register(client, ep, "ps0", "10.0.0.1:7000", ttl=0.2)
+        assert resolve(client, ep, "ps0") == "10.0.0.1:7000"
+        time.sleep(0.4)
+        assert resolve(client, ep, "ps0") is None
+        register(client, ep, "ps0", "10.0.0.2:7001", ttl=30.0)
+        assert resolve(client, ep, "ps0") == "10.0.0.2:7001"
+    finally:
+        srv.stop()
+
+
+def test_graceful_goodbye_clears_lease_and_health():
+    """Heartbeat.stop(bye=True): a cleanly-exiting worker deregisters
+    instead of aging into SUSPECT/DEAD on the registry's books."""
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        hb = Heartbeat(ep, "trainer-7", "127.0.0.1:9007", ttl=0.2,
+                       trainer_id=7, role="TRAINER")
+        hb.start()
+        client = transport.RPCClient(0)
+        assert fetch_health(client, ep)["trainer-7"]["state"] == HEALTHY
+        hb.stop(bye=True)
+        assert resolve(client, ep, "trainer-7") is None
+        assert "trainer-7" not in fetch_health(client, ep)
+        time.sleep(0.8)  # well past dead_misses * ttl: still gone, not DEAD
+        assert "trainer-7" not in fetch_health(client, ep)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-worker aggregation
+# ---------------------------------------------------------------------------
+
+def test_merge_snapshots_semantics():
+    def state(c, g, hist_counts, labels=None):
+        # hist_counts: cumulative (le=1, le=+Inf)
+        return {"labels": labels or {}, "metrics": {
+            "reqs": {"kind": "counter", "value": c},
+            "depth": {"kind": "gauge", "value": g},
+            "lat": {"kind": "histogram", "sum": float(c), "count": c,
+                    "buckets": {"1": hist_counts[0],
+                                "+Inf": hist_counts[1]}},
+        }}
+
+    merged = aggregate.merge_snapshots(
+        {"w0": state(3, 7.0, (1, 3), {"process_index": "0"}),
+         "w1": state(5, 2.0, (2, 5), {"process_index": "1"})})
+    assert merged["counters"]["reqs"]["total"] == 8
+    assert merged["counters"]["reqs"]["per_worker"] == {"w0": 3, "w1": 5}
+    assert merged["gauges"]["depth"]["per_worker"] == {"w0": 7.0, "w1": 2.0}
+    h = merged["histograms"]["lat"]
+    assert h["buckets"] == {"1": 3, "+Inf": 8}
+    assert h["count"] == 8 and h["sum"] == 8.0
+    text = aggregate.fleet_prometheus_text(merged)
+    # per-worker series carry the worker's own constant labels too
+    assert 'fleet:reqs{process_index="0",worker="w0"} 3' in text
+    assert "fleet:reqs 8" in text
+    assert 'fleet:depth{process_index="1",worker="w1"} 2' in text
+    assert 'fleet:lat_bucket{le="+Inf"} 8' in text
+
+
+def test_stats_pull_served_by_any_service():
+    """STATS_PULL is answered by _serve_io for every service object —
+    a TaskMaster server is scrapable without opting in."""
+    master, server = serve_master("127.0.0.1:0")
+    try:
+        stats_mod.counter("executor.steps").inc()
+        client = transport.RPCClient(0)
+        payload = client._raw_request(f"127.0.0.1:{server.port}",
+                                      transport.STATS_PULL)
+        snap = aggregate.parse_snapshot(payload)
+        assert snap["metrics"]["executor.steps"]["kind"] == "counter"
+    finally:
+        server.stop()
+
+
+def test_parse_snapshot_rejects_unknown_version():
+    with pytest.raises(ValueError):
+        aggregate.parse_snapshot(b'{"version": 99, "metrics": {}}')
+
+
+# ---------------------------------------------------------------------------
+# wait_server_ready progress (satellite)
+# ---------------------------------------------------------------------------
+
+def test_wait_server_ready_logs_and_counts(capsys):
+    obs.reset()
+    dead = f"127.0.0.1:{_free_port()}"
+    with pytest.raises(TimeoutError):
+        transport.wait_server_ready([dead], timeout=0.5, log_every=0.1)
+    c = stats_mod.default_registry().get("rpc.wait_server.retries")
+    assert c is not None and c.value > 0
+    err = capsys.readouterr().err
+    assert "[wait_server_ready]" in err and dead in err
+
+
+def test_wait_server_ready_immediate_when_up():
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    try:
+        transport.wait_server_ready([f"127.0.0.1:{srv.port}"], timeout=10)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the 3-worker acceptance scenario
+# ---------------------------------------------------------------------------
+
+def test_three_worker_health_requeue_and_fleet_labels():
+    """Heartbeats keep 3 workers HEALTHY; killing one worker's heartbeat
+    drives it DEAD within the miss threshold, the master requeues its
+    lease early (lease_timeout far larger than the test), and the fleet
+    /metrics aggregate carries per-worker labels for the survivors."""
+    obs.reset()
+    registry = RegistryServer("127.0.0.1:0")
+    registry.start()
+    reg_ep = f"127.0.0.1:{registry.port}"
+
+    # two survivor workers serve an RPC port each (any service works —
+    # STATS_PULL is answered centrally); worker-1 will die
+    w0 = RegistryServer("127.0.0.1:0")
+    w2 = RegistryServer("127.0.0.1:0")
+    w0.start()
+    w2.start()
+    dead_port = _free_port()
+
+    ttl = 0.3
+    hbs = {}
+    for tid in (0, 1, 2):
+        hb = Heartbeat(reg_ep, f"trainer-{tid}", f"127.0.0.1:{9000 + tid}",
+                       ttl=ttl, trainer_id=tid, role="TRAINER",
+                       health_fn=lambda tid=tid: {"step": tid * 10})
+        hb.start()
+        hbs[tid] = hb
+    # a pserver heartbeat with the default RPC trainer_id (0): when IT
+    # dies it must not be mistaken for trainer 0 by the master
+    ps_hb = Heartbeat(reg_ep, "ps-0", "127.0.0.1:8900", ttl=ttl,
+                      role="PSERVER")
+    ps_hb.start()
+
+    client = transport.RPCClient(0)
+    master = TaskMaster(
+        lease_timeout=300.0,  # only the health plane can requeue in-test
+        health_source=registry_health_source(reg_ep, cache_ttl=0.0))
+    master.set_dataset(["chunk-a", "chunk-b", "chunk-c"])
+
+    try:
+        snap = fetch_health(client, reg_ep)
+        assert {w["state"] for w in snap.values()} == {HEALTHY}
+        assert snap["trainer-1"]["step"] == 10
+
+        # trainer 1 leases a task, then dies (heartbeat stops) — and so
+        # does the pserver
+        t1_task = master.get_task(owner=1)
+        assert t1_task is not None
+        hbs[1].stop()
+        ps_hb.stop()
+
+        deadline = time.monotonic() + 4 * 3.0 * ttl
+        while fetch_health(client, reg_ep)["trainer-1"]["state"] != DEAD:
+            assert time.monotonic() < deadline, "never went DEAD"
+            time.sleep(0.05)
+        snap = fetch_health(client, reg_ep)
+        assert snap["trainer-0"]["state"] == HEALTHY
+        assert snap["trainer-2"]["state"] == HEALTHY
+        # only TRAINER-role corpses map to lease owners: the dead
+        # pserver (trainer_id defaulted to 0) must not kill trainer 0
+        while fetch_health(client, reg_ep)["ps-0"]["state"] != DEAD:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert master._dead_owners() == {1}
+
+        # the DEAD owner's lease is requeued immediately (lease_timeout
+        # is 300 s — only the health path can free it) and every chunk
+        # is leasable by the survivors
+        released = {master.get_task(owner=0)["payload"] for _ in range(2)}
+        t2 = master.get_task(owner=2)
+        assert t2 is not None
+        released.add(t2["payload"])
+        assert t1_task["payload"] in released
+        assert master.state()["pending"] == 3
+        assert master.failures[t1_task["id"]] == 1  # counted like a timeout
+        c = stats_mod.default_registry().get("master.dead_requeues")
+        assert c is not None and c.value == 1
+
+        # fleet aggregate over the survivors + the dead worker's port
+        agg = aggregate.FleetAggregator(
+            {"trainer-0": f"127.0.0.1:{w0.port}",
+             "trainer-1": f"127.0.0.1:{dead_port}",
+             "trainer-2": f"127.0.0.1:{w2.port}"},
+            connect_timeout=1.0)
+        text = agg.to_prometheus_text()
+        assert 'worker="trainer-0"' in text
+        assert 'worker="trainer-2"' in text
+        assert 'worker="trainer-1"' not in text
+        assert list(agg.last_errors) == ["trainer-1"]
+        # health gauges made it into the local exposition too
+        # (dead = trainer-1 + the pserver; healthy = the two survivors)
+        local = stats_mod.to_prometheus_text()
+        assert "health_workers_dead 2" in local
+        assert "health_workers_healthy 2" in local
+    finally:
+        for hb in hbs.values():
+            hb.stop()
+        registry.stop()
+        w0.stop()
+        w2.stop()

@@ -1,0 +1,78 @@
+"""Fetch and pretty-print a worker's debug-server pages by port.
+
+Operator companion to ``paddle_tpu/observability/debug_server.py``
+(start workers with ``FLAGS_debug_server_port=<port>``):
+
+    python tools/dump_metrics.py 8085                 # metrics + healthz
+    python tools/dump_metrics.py 8085 statusz         # one page
+    python tools/dump_metrics.py 8085 metrics stepz
+    python tools/dump_metrics.py --host 10.0.0.7 8085 healthz
+    python tools/dump_metrics.py --grep rpc_ 8085 metrics
+
+JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
+through (optionally filtered with ``--grep``) so the output pastes
+straight into a Prometheus exposition parser.  Stdlib only — runs on
+any host that can reach the port, no paddle_tpu import needed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+DEFAULT_PAGES = ("metrics", "healthz")
+KNOWN_PAGES = ("metrics", "healthz", "statusz", "stepz")
+
+
+def fetch(host: str, port: int, page: str, timeout: float = 5.0) -> str:
+    url = f"http://{host}:{port}/{page.lstrip('/')}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def render(page: str, body: str, grep: str = "") -> str:
+    if page.strip("/") == "metrics":
+        if grep:
+            body = "\n".join(l for l in body.splitlines() if grep in l)
+            return body + ("\n" if body else "")
+        return body
+    try:
+        return json.dumps(json.loads(body), indent=2, sort_keys=True) + "\n"
+    except ValueError:
+        return body
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dump a paddle_tpu worker's debug-server pages")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--grep", default="",
+                    help="only /metrics lines containing this substring")
+    ap.add_argument("port", type=int,
+                    help="the worker's FLAGS_debug_server_port")
+    ap.add_argument("pages", nargs="*", default=list(DEFAULT_PAGES),
+                    help=f"pages to fetch (default: {' '.join(DEFAULT_PAGES)};"
+                         f" known: {' '.join(KNOWN_PAGES)})")
+    args = ap.parse_args(argv)
+
+    rc = 0
+    pages = args.pages or list(DEFAULT_PAGES)
+    for page in pages:
+        header = f"==== {args.host}:{args.port} /{page.strip('/')} ===="
+        if len(pages) > 1:
+            print(header)
+        try:
+            body = fetch(args.host, args.port, page, timeout=args.timeout)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"error fetching /{page.strip('/')}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        sys.stdout.write(render(page, body, grep=args.grep))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

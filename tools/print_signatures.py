@@ -33,6 +33,9 @@ MODULES = [
     "paddle_tpu.observability",
     "paddle_tpu.observability.stats",
     "paddle_tpu.observability.step_stats",
+    "paddle_tpu.observability.debug_server",
+    "paddle_tpu.observability.health",
+    "paddle_tpu.observability.aggregate",
     "paddle_tpu.lod_tensor",
     "paddle_tpu.transpiler",
     "paddle_tpu.data_feeder",

@@ -611,6 +611,127 @@ def bench_ring_shard():
     return out
 
 
+def bench_rpc_transport():
+    """Var-transport hot path on a loopback pserver (no TPU needed):
+    measures the batched/striped/zero-copy wire (SEND_VARS/GET_VARS,
+    ``FLAGS_rpc_conns_per_endpoint`` striping, sendmsg/iovec
+    scatter-gather serde) against the pre-change transport shape
+    (per-var SEND_VAR/GET_VAR round trips over one lock-serialized
+    connection, concat-copy serde) — same server, same sockets, so the
+    ratio isolates the transport work.
+
+    Two scaling axes, two-point-fit style (min over reps):
+    - ``storm_256``: 256 small dense vars per round — round-trip-count
+      scaling (the many-sections model shape); metric vars/s.
+    - ``dense_64mb``: one 64 MB gradient per round — copy/bandwidth
+      scaling; metric effective MB/s.
+    """
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import serde, transport
+
+    class _VarStore:
+        """Minimal pserver-shaped service: var table behind one lock
+        (the PServerLoop per-frame lock acquisition), both legacy and
+        batched message types."""
+
+        def __init__(self):
+            self.vars = {}
+            self.lock = threading.Lock()
+
+        def handle(self, msg_type, tid, name, payload):
+            if msg_type == transport.SEND_VAR:
+                v = serde.loads_value(payload)
+                with self.lock:
+                    self.vars[name] = v
+                return transport.OK, b""
+            if msg_type == transport.SEND_VARS:
+                pairs = serde.loads_batch(payload, copy=False)
+                with self.lock:
+                    for n, v in pairs:
+                        self.vars[n] = v
+                return transport.OK, b""
+            if msg_type == transport.GET_VAR:
+                with self.lock:
+                    v = self.vars[name]
+                return transport.OK, serde.dumps_value(v)
+            if msg_type == transport.GET_VARS:
+                names = [n for n, _ in serde.loads_batch(payload)]
+                with self.lock:
+                    pairs = [(n, self.vars[n]) for n in names]
+                return transport.OK, serde.dumps_batch_vec(pairs)
+            return transport.OK, b""
+
+    LEGACY = {"rpc_batch_vars": 0, "rpc_vectored_io": 0,
+              "rpc_conns_per_endpoint": 1, "rpc_stripe_chunk_bytes": 0}
+    NEW = {"rpc_batch_vars": 1, "rpc_vectored_io": 1,
+           "rpc_conns_per_endpoint": 4,
+           "rpc_stripe_chunk_bytes": 8 << 20}
+
+    def timed_min(fn, reps):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def run_mode(flags, out, tag):
+        fluid.set_flags(flags)
+        srv = transport.RPCServer("127.0.0.1:0", _VarStore())
+        srv.start()
+        ep = f"127.0.0.1:{srv.port}"
+        client = transport.RPCClient(0)
+        try:
+            rng = np.random.RandomState(0)
+            small = [(f"v{i}", rng.randn(16).astype("float32"))
+                     for i in range(256)]
+            names = [n for n, _ in small]
+            big = rng.randn(64 << 18).astype("float32")  # 64 MB
+
+            def storm_send():
+                if flags["rpc_batch_vars"]:
+                    client.send_vars(ep, small)
+                else:
+                    client.parallel([(client.send_var, ep, n, v)
+                                     for n, v in small])
+
+            def storm_get():
+                if flags["rpc_batch_vars"]:
+                    client.get_vars(ep, names)
+                else:
+                    client.parallel([(client.get_var, ep, n)
+                                     for n in names])
+
+            def dense_send():
+                if flags["rpc_batch_vars"]:
+                    client.send_vars(ep, [("big", big)])
+                else:
+                    client.send_var(ep, "big", big)
+
+            storm_send(), storm_get(), dense_send()  # warmup/connect
+            t_storm = timed_min(storm_send, 5) + timed_min(storm_get, 5)
+            t_dense = timed_min(dense_send, 5)
+            out[f"{tag}_storm_vars_per_sec"] = round(512 / t_storm, 1)
+            out[f"{tag}_dense_mb_per_sec"] = round(64 / t_dense, 1)
+        finally:
+            srv.stop()
+
+    saved = fluid.get_flags(list(LEGACY))
+    out = {"storm_vars": 256, "dense_bytes": 64 << 20}
+    try:
+        run_mode(LEGACY, out, "legacy")
+        run_mode(NEW, out, "batched")
+    finally:
+        fluid.set_flags(saved)
+    out["storm_speedup"] = round(out["batched_storm_vars_per_sec"]
+                                 / out["legacy_storm_vars_per_sec"], 2)
+    out["dense_speedup"] = round(out["batched_dense_mb_per_sec"]
+                                 / out["legacy_dense_mb_per_sec"], 2)
+    return out
+
+
 A100_RESNET50_IMG_S = 2500.0
 A100_TRANSFORMER_TOK_S = 50000.0
 
@@ -653,6 +774,7 @@ CONFIG_TABLE = [
     ("transformer_seq256", bench_transformer, 420, True),
     ("stacked_lstm", bench_stacked_lstm, 300, True),
     ("resnet50_datapath", bench_resnet50_datapath, 420, True),
+    ("rpc_transport", bench_rpc_transport, 300, False),
     ("scaling_dp8", bench_scaling, 900, False),
 ]
 

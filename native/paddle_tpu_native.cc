@@ -30,6 +30,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 #include <cerrno>
 #include <chrono>
@@ -386,6 +387,52 @@ int ptq_conn_send_frame(void* cp, const char* body, size_t len) {
   int rc = write_all(c->fd, buf, len + 4);
   free(buf);
   return rc;
+}
+
+// Scatter-gather frame send: the u32 length prefix plus every caller
+// buffer goes to the kernel through writev — tensor bytes leave the
+// ndarray with NO userspace concat copy (the grpc_serde.cc:35 zero-copy
+// ByteBuffer role).  Partial writes advance the iovec in place; iovec
+// batches are capped well under IOV_MAX.
+int ptq_conn_send_frame_vec(void* cp, void** bufs, const size_t* lens,
+                            size_t nbufs) {
+  auto* c = static_cast<Conn*>(cp);
+  size_t total = 0;
+  for (size_t i = 0; i < nbufs; ++i) total += lens[i];
+  uint32_t n = static_cast<uint32_t>(total);
+  char hdr[4];
+  memcpy(hdr, &n, 4);  // little-endian hosts (x86/ARM TPU VMs)
+
+  std::vector<iovec> iov;
+  iov.reserve(nbufs + 1);
+  iov.push_back({hdr, 4});
+  for (size_t i = 0; i < nbufs; ++i) {
+    if (lens[i] == 0) continue;
+    iov.push_back({bufs[i], lens[i]});
+  }
+  size_t idx = 0;
+  while (idx < iov.size()) {
+    size_t cnt = iov.size() - idx;
+    if (cnt > 512) cnt = 512;  // stay under IOV_MAX everywhere
+    msghdr msg{};
+    msg.msg_iov = &iov[idx];
+    msg.msg_iovlen = cnt;
+    ssize_t w = ::sendmsg(c->fd, &msg, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    size_t done = static_cast<size_t>(w);
+    while (idx < iov.size() && done >= iov[idx].iov_len) {
+      done -= iov[idx].iov_len;
+      ++idx;
+    }
+    if (idx < iov.size() && done) {
+      iov[idx].iov_base = static_cast<char*>(iov[idx].iov_base) + done;
+      iov[idx].iov_len -= done;
+    }
+  }
+  return 0;
 }
 
 char* ptq_conn_recv_frame(void* cp, size_t* len_out) {

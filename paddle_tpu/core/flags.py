@@ -112,6 +112,30 @@ define_flag("executor_cache_capacity", 256,
             "evicts the oldest entry (counted in executor.cache_evictions "
             "— an eviction storm means shape churn is defeating the "
             "compile cache).  0 = unbounded (the pre-telemetry behavior)")
+define_flag("rpc_conns_per_endpoint", 2,
+            "striped persistent connections per pserver endpoint "
+            "(distributed/transport.py RPCClient): concurrent requests "
+            "to one pserver pipeline across stripes instead of "
+            "serializing on a single connection lock (the reference's "
+            "multi-channel grpc_client).  Latched per endpoint at first "
+            "use; 1 restores the single-connection behavior")
+define_flag("rpc_vectored_io", True,
+            "send multi-buffer RPC frames scatter-gather "
+            "(socket.sendmsg / native sendmsg-iovec) straight from the "
+            "ndarray views — no Python-level concat copy of tensor "
+            "bytes.  0 falls back to joining buffers before send")
+define_flag("rpc_stripe_chunk_bytes", 8 << 20,
+            "SEND_VARS batches whose tensor payload exceeds this many "
+            "bytes are split (at var granularity) into per-stripe "
+            "sub-batches sent concurrently across the striped "
+            "connections; 0 disables splitting (always one frame per "
+            "endpoint per round)")
+define_flag("rpc_batch_vars", True,
+            "group send/recv host-op variables by endpoint into batched "
+            "SEND_VARS/GET_VARS frames (one RPC per pserver per round "
+            "instead of one per variable).  0 restores per-var "
+            "SEND_VAR/GET_VAR wire behavior (e.g. against a peer that "
+            "predates the batched frames)")
 define_flag("rpc_server_profile_period", 0,
             "pserver self-profiling: log request-rate stats every N "
             "handled RPCs (reference FLAGS_rpc_server_profile_period, "

@@ -54,6 +54,10 @@ def load() -> ctypes.CDLL:
     lib.ptq_conn_connect.restype = ctypes.c_void_p
     lib.ptq_conn_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                      ctypes.c_double]
+    lib.ptq_conn_send_frame_vec.restype = ctypes.c_int
+    lib.ptq_conn_send_frame_vec.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t]
     lib.ptq_conn_send_frame.restype = ctypes.c_int
     lib.ptq_conn_send_frame.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                         ctypes.c_size_t]

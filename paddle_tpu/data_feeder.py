@@ -72,8 +72,15 @@ class DataFeeder:
         flat = [np.asarray(s) for ex in col for s in ex]
         inner = [len(s) for s in flat]
         # zero-word sentences are legal (they pool to 0 downstream); give
-        # them the word-row feature shape so concatenation lines up
-        feat = next((s.shape[1:] for s in flat if len(s)), ())
+        # them the word-row feature shape so concatenation lines up.
+        # When EVERY sentence in the batch is empty, derive the feature
+        # shape from the declared var shape ([B, S, W, ...feat]) instead
+        # of degrading to (0,)-shaped features (ADVICE r5)
+        feat = next((s.shape[1:] for s in flat if len(s)), None)
+        if feat is None:
+            shp = var.shape
+            feat = (tuple(int(d) for d in shp[3:])
+                    if shp is not None and len(shp) > 3 else ())
         flat = [s if len(s) else np.zeros((0,) + feat) for s in flat]
         lt = _create_nested(flat, [outer, inner])
         data = lt.data.astype(np_dtype(var.dtype), copy=False)

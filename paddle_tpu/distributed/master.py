@@ -214,7 +214,7 @@ class TaskMaster:
             self.task_failed(int(name))
             return OK, b""
         if msg_type == SET_DATASET:
-            self.set_dataset(json.loads(payload.decode("utf-8")))
+            self.set_dataset(json.loads(bytes(payload).decode("utf-8")))
             return OK, b""
         if msg_type == MASTER_STATE:
             return OK, json.dumps(self.state()).encode("utf-8")
@@ -298,7 +298,7 @@ class MasterClient:
 
     def get_task(self) -> Optional[dict]:
         out = self._rpc._request(self.endpoint, GET_TASK)
-        return json.loads(out.decode("utf-8"))
+        return json.loads(bytes(out).decode("utf-8"))
 
     def task_finished(self, task_id: int) -> None:
         self._rpc._request(self.endpoint, TASK_FINISHED, str(task_id))
@@ -308,7 +308,7 @@ class MasterClient:
 
     def state(self) -> dict:
         out = self._rpc._request(self.endpoint, MASTER_STATE)
-        return json.loads(out.decode("utf-8"))
+        return json.loads(bytes(out).decode("utf-8"))
 
 
 def task_reader(client: MasterClient, make_reader: Callable,

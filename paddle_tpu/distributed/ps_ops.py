@@ -27,7 +27,8 @@ from ..core.program import Operator, Program, Variable
 from ..core.selected_rows import SelectedRows
 from . import transport
 from .transport import (BATCH_BARRIER, CHECKPOINT_NOTIFY, COMPLETE,
-                        FETCH_BARRIER, GET_VAR, OK, PREFETCH, SEND_VAR, serde)
+                        FETCH_BARRIER, GET_VAR, GET_VARS, OK, PREFETCH,
+                        SEND_VAR, SEND_VARS, serde)
 
 
 def _to_host(value):
@@ -36,6 +37,42 @@ def _to_host(value):
         return SelectedRows(np.asarray(value.rows), np.asarray(value.values),
                             value.height)
     return np.asarray(value)
+
+
+def _start_readback(value) -> None:
+    """Kick off a non-blocking device→host copy (jax
+    ``copy_to_host_async``) so every var's readback overlaps the others
+    AND the first endpoint's wire time; the later ``np.asarray`` then
+    just waits on an already-in-flight transfer.  No-op for values
+    already on host."""
+    parts = ((value.rows, value.values)
+             if isinstance(value, SelectedRows) else (value,))
+    for p in parts:
+        start = getattr(p, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # pragma: no cover - committed-to-device etc.
+                pass
+
+
+def _batching_on() -> bool:
+    from ..core import flags
+    try:
+        return bool(flags.get_flags("rpc_batch_vars"))
+    except KeyError:  # pragma: no cover
+        return True
+
+
+def _ep_groups(op, names):
+    """[(endpoint, [name, ...]), ...] for a send/recv op: the
+    transpiler-emitted grouping when present (``ep_groups`` attr),
+    otherwise grouped at runtime with the same transpiler helper."""
+    groups = op.attr("ep_groups", None)
+    if groups:
+        return [(ep, list(ns)) for ep, ns in groups]
+    from .transpiler import _ep_groups as _group
+    return [(ep, ns) for ep, ns in _group(names, op.attr("epmap"))]
 
 
 # ---------------------------------------------------------------------------
@@ -47,14 +84,29 @@ def _send(exe, program, op, scope):
     names = op.input("X")
     epmap = op.attr("epmap")
     client = transport.get_client(op.attr("trainer_id", 0))
-    calls = []
-    for name, ep in zip(names, epmap):
+    varmap = op.attr("varmap", {})
+
+    vals = {}
+    for name in names:
         val = scope.find_var(name)
         if val is None:
             raise RuntimeError(f"send: variable {name!r} not found in scope")
-        remote = op.attr("varmap", {}).get(name, name)
-        calls.append((client.send_var, ep, remote, _to_host(val)))
-    client.parallel(calls)
+        vals[name] = val
+    # overlapped readback: start EVERY device→host materialization
+    # before the first byte hits the wire
+    for val in vals.values():
+        _start_readback(val)
+
+    if not _batching_on():
+        client.parallel([
+            (client.send_var, ep, varmap.get(name, name), _to_host(vals[name]))
+            for name, ep in zip(names, epmap)])
+        return
+    # one batched SEND_VARS per pserver instead of one RPC per variable
+    client.parallel([
+        (client.send_vars, ep,
+         [(varmap.get(n, n), _to_host(vals[n])) for n in group])
+        for ep, group in _ep_groups(op, names)])
 
 
 @register_host_op("send_barrier")
@@ -70,10 +122,24 @@ def _recv(exe, program, op, scope):
     epmap = op.attr("epmap")
     client = transport.get_client(op.attr("trainer_id", 0))
     varmap = op.attr("varmap", {})
-    vals = client.parallel([(client.get_var, ep, varmap.get(n, n))
-                            for n, ep in zip(names, epmap)])
-    for name, val in zip(names, vals):
-        scope.set_var(name, val)
+    if not _batching_on():
+        vals = client.parallel([(client.get_var, ep, varmap.get(n, n))
+                                for n, ep in zip(names, epmap)])
+        for name, val in zip(names, vals):
+            scope.set_var(name, val)
+        return
+    # one batched GET_VARS per pserver; results scatter back by group.
+    # copy=False: the views are consumed (device-put by the next concat
+    # segment) and replaced within the round, so the zero-copy read
+    # path is safe here — public get_vars callers default to owned
+    # copies instead
+    groups = _ep_groups(op, names)
+    results = client.parallel([
+        (client.get_vars, ep, [varmap.get(n, n) for n in group], False)
+        for ep, group in groups])
+    for (ep, group), vals in zip(groups, results):
+        for name, val in zip(group, vals):
+            scope.set_var(name, val)
 
 
 @register_host_op("fetch_barrier")
@@ -319,6 +385,47 @@ class PServerLoop:
                 import warnings
                 warnings.warn(f"pserver checkpoint failed (continuing): {e}")
 
+    def _apply_async(self, name, value) -> None:
+        """Async-mode apply of ONE incoming var (RunAsyncLoop:213
+        hogwild): no scaling, no barriers; LR block advances once per
+        virtual round."""
+        bidx = self.grad_to_block.get(name)
+        if bidx is None:
+            # plain var write (e.g. startup broadcast)
+            with self.lock:
+                self.scope.set_var(name, value)
+            return
+        with self.lr_lock:
+            n_grads = max(1, len(self.grad_to_block))
+            if self._async_sends % n_grads == 0:
+                self._run_lr()
+            self._async_sends += 1
+            ckpt_now = (
+                self.ckpt_dir and self.ckpt_every > 0
+                and self._async_sends %
+                (n_grads * self.ckpt_every) == 0)
+        with self.block_locks[bidx]:
+            self.scope.set_var(name, value)
+            self._run_block(bidx)
+        if ckpt_now:
+            # hogwild checkpoint: per-var snapshot consistency
+            # only, like the Go async pserver (service.go:346)
+            with self.lr_lock:
+                self._checkpoint()
+
+    def _wait_round(self, trainer_id) -> None:
+        """Sync-mode read barrier: block until every round this trainer
+        has closed is applied (rpc_server.cc request-type condition
+        barrier reduced to one monotonic counter)."""
+        if self.sync_mode:
+            with self.lock:
+                target = self.rounds_sent[trainer_id]
+                while self.applied_rounds < target and not self.exit:
+                    self.lock.wait(timeout=1.0)
+        if self.error is not None:
+            raise RuntimeError(
+                f"pserver optimize pass failed: {self.error!r}")
+
     # -- service entry (one call per request, many threads) ----------------
     def handle(self, msg_type, trainer_id, name, payload):
         self._profile_tick()
@@ -328,31 +435,25 @@ class PServerLoop:
                 with self.lock:
                     self.open_round[trainer_id][name] = value
             else:
-                bidx = self.grad_to_block.get(name)
-                if bidx is None:
-                    # plain var write (e.g. startup broadcast)
-                    with self.lock:
-                        self.scope.set_var(name, value)
-                else:
-                    # hogwild apply (RunAsyncLoop:213): no scaling, no
-                    # barriers; LR block advances once per virtual round
-                    with self.lr_lock:
-                        n_grads = max(1, len(self.grad_to_block))
-                        if self._async_sends % n_grads == 0:
-                            self._run_lr()
-                        self._async_sends += 1
-                        ckpt_now = (
-                            self.ckpt_dir and self.ckpt_every > 0
-                            and self._async_sends %
-                            (n_grads * self.ckpt_every) == 0)
-                    with self.block_locks[bidx]:
-                        self.scope.set_var(name, value)
-                        self._run_block(bidx)
-                    if ckpt_now:
-                        # hogwild checkpoint: per-var snapshot consistency
-                        # only, like the Go async pserver (service.go:346)
-                        with self.lr_lock:
-                            self._checkpoint()
+                self._apply_async(name, value)
+            return OK, b""
+
+        if msg_type == SEND_VARS:
+            # zero-copy decode: values are views over the recv buffer
+            # (pinned by the arrays; merge/apply never mutates in place)
+            pairs = serde.loads_batch(payload, copy=False)
+            if self.sync_mode:
+                # the whole batch lands under ONE lock acquisition; each
+                # var still counts individually toward the round, so a
+                # batch of N is indistinguishable from N SEND_VARs to
+                # the batch_barrier accounting
+                with self.lock:
+                    buf = self.open_round[trainer_id]
+                    for n, v in pairs:
+                        buf[n] = v
+            else:
+                for n, v in pairs:
+                    self._apply_async(n, v)
             return OK, b""
 
         if msg_type == BATCH_BARRIER:
@@ -368,30 +469,29 @@ class PServerLoop:
             return OK, b""
 
         if msg_type == GET_VAR:
-            if self.sync_mode:
-                with self.lock:
-                    target = self.rounds_sent[trainer_id]
-                    while self.applied_rounds < target and not self.exit:
-                        self.lock.wait(timeout=1.0)
-            if self.error is not None:
-                raise RuntimeError(
-                    f"pserver optimize pass failed: {self.error!r}")
+            self._wait_round(trainer_id)
             val = self.scope.find_var(name)
             if val is None:
                 raise KeyError(f"pserver has no variable {name!r}")
             return OK, serde.dumps_value(_to_host(val))
 
+        if msg_type == GET_VARS:
+            # one round-barrier wait covers the whole batch, then the
+            # reply streams every tensor scatter-gather (buffer list)
+            names = [n for n, _ in serde.loads_batch(payload)]
+            self._wait_round(trainer_id)
+            pairs = []
+            for n in names:
+                val = self.scope.find_var(n)
+                if val is None:
+                    raise KeyError(f"pserver has no variable {n!r}")
+                pairs.append((n, _to_host(val)))
+            return OK, serde.dumps_batch_vec(pairs)
+
         if msg_type == PREFETCH:
             # same round barrier as GET: the next forward's lookup must see
             # this round's sparse update applied
-            if self.sync_mode:
-                with self.lock:
-                    target = self.rounds_sent[trainer_id]
-                    while self.applied_rounds < target and not self.exit:
-                        self.lock.wait(timeout=1.0)
-            if self.error is not None:
-                raise RuntimeError(
-                    f"pserver optimize pass failed: {self.error!r}")
+            self._wait_round(trainer_id)
             info = self.dist_tables[name]
             ids = np.asarray(serde.loads_value(payload)).reshape(-1)
             table = np.asarray(self.scope.find_var(info["var"]))

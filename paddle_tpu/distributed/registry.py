@@ -57,7 +57,7 @@ class RegistryService:
 
     def handle(self, msg_type, trainer_id, name, payload):
         if msg_type == REG_SET:
-            body = json.loads(payload.decode("utf-8"))
+            body = json.loads(bytes(payload).decode("utf-8"))
             if body.get("bye"):
                 # graceful exit: drop the lease AND the health entry so a
                 # cleanly-finished worker never shows up as DEAD
@@ -141,7 +141,7 @@ def resolve(client: "transport.RPCClient", registry_ep: str,
     try:
         out = client._raw_request(registry_ep, REG_GET, logical, b"",
                                   retry_all=True)
-        return out.decode("utf-8")
+        return bytes(out).decode("utf-8")
     except RuntimeError:
         return None          # not registered / lease expired
 
@@ -151,7 +151,7 @@ def fetch_health(client: "transport.RPCClient", registry_ep: str,
     """The registry's health table: {worker: {state, role, step, ...}}."""
     out = client._raw_request(registry_ep, REG_HEALTH, retry_all=True,
                               connect_timeout=connect_timeout)
-    return json.loads(out.decode("utf-8"))
+    return json.loads(bytes(out).decode("utf-8"))
 
 
 class Heartbeat:

@@ -77,6 +77,21 @@ def _split_rows(dim0: int, numel: int, max_parts: int, min_block: int) -> List[i
     return [base + (1 if i < extra else 0) for i in range(parts)]
 
 
+def _ep_groups(names: List[str], endpoints: List[str]) -> List[list]:
+    """Grouped epmap for send/recv ops: ``[[endpoint, [name, ...]], ...]``
+    in first-appearance endpoint order.  Emitted at transpile time so the
+    batched host ops (ps_ops.py) issue ONE RPC per pserver per round
+    without regrouping every step."""
+    by: Dict[str, List[str]] = {}
+    order: List[str] = []
+    for n, ep in zip(names, endpoints):
+        if ep not in by:
+            by[ep] = []
+            order.append(ep)
+        by[ep].append(n)
+    return [[ep, by[ep]] for ep in order]
+
+
 def _is_optimize_op(op) -> bool:
     return ("Param" in op.inputs and "Grad" in op.inputs
             and op.attr(OP_ROLE_ATTR) == OpRole.Optimize)
@@ -242,11 +257,14 @@ class DistributeTranspiler:
                 {"Out": [s.gname for s in secs]},
                 {**rpc_attrs, "sections": [[s.offset, s.rows] for s in secs]})
 
-        # host: send grad sections → pservers
+        # host: send grad sections → pservers (ep_groups: one batched
+        # SEND_VARS frame per endpoint per round)
         send_secs = self.sections + self.table_sections
         block.append_op(
             "send", {"X": [s.gname for s in send_secs]}, {},
-            {**rpc_attrs, "epmap": [s.endpoint for s in send_secs]})
+            {**rpc_attrs, "epmap": [s.endpoint for s in send_secs],
+             "ep_groups": _ep_groups([s.gname for s in send_secs],
+                                     [s.endpoint for s in send_secs])})
         if self.sync_mode:
             block.append_op("send_barrier", {}, {},
                             {**rpc_attrs, "endpoints": self.endpoints})
@@ -264,7 +282,9 @@ class DistributeTranspiler:
                         dtype=pvar.dtype)
         block.append_op(
             "recv", {}, {"Out": [s.pname for s in self.sections]},
-            {**rpc_attrs, "epmap": [s.endpoint for s in self.sections]})
+            {**rpc_attrs, "epmap": [s.endpoint for s in self.sections],
+             "ep_groups": _ep_groups([s.pname for s in self.sections],
+                                     [s.endpoint for s in self.sections])})
         if self.sync_mode:
             block.append_op("fetch_barrier", {}, {},
                             {**rpc_attrs, "endpoints": self.endpoints})
@@ -311,7 +331,9 @@ class DistributeTranspiler:
         if self.sections:
             block.append_op(
                 "recv", {}, {"Out": [s.pname for s in self.sections]},
-                {**rpc_attrs, "epmap": [s.endpoint for s in self.sections]})
+                {**rpc_attrs, "epmap": [s.endpoint for s in self.sections],
+                 "ep_groups": _ep_groups([s.pname for s in self.sections],
+                                         [s.endpoint for s in self.sections])})
             block.append_op("fetch_barrier", {}, {},
                             {**rpc_attrs, "endpoints": self.endpoints})
         for p, secs in self.param_sections.items():

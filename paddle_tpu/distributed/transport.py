@@ -22,8 +22,17 @@ Connections are persistent; each client connection is a serial
 request/response channel (guarded by a lock), and the client fans out to
 many endpoints concurrently via a shared thread pool — the analogue of the
 reference's async completion queues + ``Wait`` (``grpc_client.h:180-213``).
+``FLAGS_rpc_conns_per_endpoint`` stripes several connections per endpoint
+so concurrent requests to ONE pserver (a batched round's sub-batches, a
+storm of small vars) no longer serialize on a single connection lock —
+the multi-channel ``grpc_client`` role (``GetChannel`` channel pools).
 Server handlers may block (sync-mode barriers), so both server backends
 are thread-per-connection like the reference's handler thread pools.
+
+Batched frames (``SEND_VARS``/``GET_VARS``) carry many ``(name, value)``
+pairs per round trip, and large tensor bodies are sent scatter-gather
+(``socket.sendmsg``/``sendmsg(iovec)`` in the native backend) straight
+from the ndarray — see ``serde.dumps_batch_vec``.
 """
 from __future__ import annotations
 
@@ -36,7 +45,9 @@ import struct
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from . import serde
 from ..observability import stats as _obs_stats
@@ -50,6 +61,14 @@ FETCH_BARRIER = 4
 COMPLETE = 5
 PREFETCH = 6
 CHECKPOINT_NOTIFY = 7
+# batched var transport: one frame carries many (name, value) pairs —
+# the round-trip-per-variable cost of SEND_VAR/GET_VAR amortized to one
+# RPC per pserver per round (the reference's async completion-queue
+# pipelining, collapsed into explicit batch frames).  Message-type ids
+# share ONE namespace across every service (registry.py holds 8-10,
+# master.py 16-20, STATS_PULL 24) so telemetry labels stay unambiguous
+SEND_VARS = 11
+GET_VARS = 12
 # fleet observability (observability/aggregate.py): answered centrally by
 # _serve_io for EVERY service object, so any RPCServer — pserver, master,
 # registry — can be scraped for its process-local metric snapshot
@@ -59,6 +78,7 @@ OK = 0
 ERR = 255
 
 MSG_NAMES = {SEND_VAR: "send_var", GET_VAR: "get_var",
+             SEND_VARS: "send_vars", GET_VARS: "get_vars",
              BATCH_BARRIER: "batch_barrier", FETCH_BARRIER: "fetch_barrier",
              COMPLETE: "complete", PREFETCH: "prefetch",
              CHECKPOINT_NOTIFY: "checkpoint_notify",
@@ -107,11 +127,68 @@ def _pack_body(msg_type: int, trainer_id: int, name: str,
     return _HDR.pack(msg_type, trainer_id, len(nm)) + nm + payload
 
 
+def _pack_body_vec(msg_type: int, trainer_id: int, name: str,
+                   payload_bufs: Sequence) -> list:
+    """Scatter-gather body: header bytes + the payload buffer list
+    untouched (tensor bodies stay views; see serde.dumps_value_vec).
+    Zero-length buffers are dropped so empty-payload control messages
+    (barriers, COMPLETE) keep the single-buffer fast path."""
+    nm = name.encode("utf-8")
+    return [_HDR.pack(msg_type, trainer_id, len(nm)) + nm,
+            *[b for b in payload_bufs if len(b)]]
+
+
 def _unpack_body(body: bytes):
+    """Returns (msg_type, trainer_id, name, payload) — ``payload`` is a
+    zero-copy memoryview over ``body`` (a 64 MB inbound gradient frame
+    must not pay a full slice copy before ``loads_batch(copy=False)``
+    builds its views); consumers needing ``bytes`` wrap it explicitly."""
     msg_type, trainer_id, name_len = _HDR.unpack_from(body, 0)
     off = _HDR.size
-    name = body[off:off + name_len].decode("utf-8")
-    return msg_type, trainer_id, name, body[off + name_len:]
+    name = bytes(body[off:off + name_len]).decode("utf-8")
+    return msg_type, trainer_id, name, memoryview(body)[off + name_len:]
+
+
+def _int_flag(name: str, default: int) -> int:
+    from ..core import flags
+    try:
+        return int(flags.get_flags(name))
+    except (KeyError, TypeError, ValueError):  # pragma: no cover
+        return default
+
+
+def _vectored_on() -> bool:
+    from ..core import flags
+    try:
+        return bool(flags.get_flags("rpc_vectored_io"))
+    except KeyError:  # pragma: no cover
+        return True
+
+
+def _send_frame_any(io, bufs: list) -> Tuple[int, bool]:
+    """Send one frame from a buffer list; returns (nbytes, vectored).
+
+    Single-buffer bodies and flag-off runs take the classic one-buffer
+    path; everything else goes scatter-gather (``sendmsg``/``writev`` —
+    no Python-level concat of tensor bytes)."""
+    nbytes = serde.buffers_nbytes(bufs)
+    if nbytes >= 1 << 32:
+        # the u32 frame-length prefix cannot carry it; without this
+        # guard the native path would TRUNCATE the length silently and
+        # desynchronize the stream.  Shard the variable (slice_var_up)
+        # or lower FLAGS_rpc_stripe_chunk_bytes to keep frames smaller.
+        raise ValueError(
+            f"RPC frame of {nbytes} bytes exceeds the u32 frame limit "
+            "(4 GiB); split the batch or shard the variable")
+    if len(bufs) == 1:
+        io.send_frame(bufs[0] if isinstance(bufs[0], bytes)
+                      else bytes(bufs[0]))
+        return nbytes, False
+    if _vectored_on():
+        io.send_frame_vec(bufs)
+        return nbytes, True
+    io.send_frame(b"".join(bufs))
+    return nbytes, False
 
 
 # ---------------------------------------------------------------------------
@@ -145,7 +222,38 @@ class _PyIO:
                 time.sleep(0.1)
 
     def send_frame(self, body: bytes) -> None:
-        self.sock.sendall(struct.pack("<I", len(body)) + body)
+        try:
+            self.sock.sendall(struct.pack("<I", len(body)) + body)
+        except OSError as e:
+            # normalize EVERY socket failure (EPIPE, EBADF, ETIMEDOUT,
+            # ...) to ConnectionError: the retry/at-most-once discipline
+            # in RPCClient keys on that type
+            raise ConnectionError(f"send failed: {e}") from e
+
+    # sendmsg iovec batches stay comfortably under IOV_MAX (1024 on
+    # Linux); a 256-var batch is ~513 buffers
+    _IOV_BATCH = 512
+
+    def send_frame_vec(self, buffers: Sequence) -> None:
+        """Scatter-gather frame: u32 length prefix + every buffer via
+        ``socket.sendmsg`` — tensor bytes go from the ndarray views to
+        the kernel with no userspace concat copy."""
+        views = [b if isinstance(b, (bytes, bytearray))
+                 else memoryview(b).cast("B") for b in buffers]
+        total = sum(len(v) for v in views)
+        views.insert(0, struct.pack("<I", total))
+        idx, off = 0, 0
+        try:
+            while idx < len(views):
+                batch = [memoryview(views[idx])[off:],
+                         *views[idx + 1:idx + self._IOV_BATCH]]
+                sent = self.sock.sendmsg(batch)
+                while idx < len(views) and sent >= len(views[idx]) - off:
+                    sent -= len(views[idx]) - off
+                    idx, off = idx + 1, 0
+                off += sent
+        except OSError as e:
+            raise ConnectionError(f"vectored send failed: {e}") from e
 
     def recv_frame(self) -> Optional[bytes]:
         raw = self._recv_exact(4)
@@ -204,6 +312,20 @@ class _NativeIO:
         if self._lib.ptq_conn_send_frame(h, body, len(body)) != 0:
             raise ConnectionError("native transport: send failed")
 
+    def send_frame_vec(self, buffers: Sequence) -> None:
+        """Scatter-gather frame through the C transport's sendmsg/iovec
+        path (``ptq_conn_send_frame_vec``): buffer addresses are taken
+        via zero-copy uint8 views; ``arrs`` pins them for the call."""
+        h = self._h
+        if not h:
+            raise ConnectionError("native transport: connection closed")
+        arrs = [np.frombuffer(b, np.uint8) for b in buffers]
+        n = len(arrs)
+        ptrs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])
+        lens = (ctypes.c_size_t * n)(*[a.nbytes for a in arrs])
+        if self._lib.ptq_conn_send_frame_vec(h, ptrs, lens, n) != 0:
+            raise ConnectionError("native transport: vectored send failed")
+
     def recv_frame(self) -> Optional[bytes]:
         h = self._h
         if not h:
@@ -240,7 +362,11 @@ def _connect_io(host: str, port: int, timeout: float):
 # ---------------------------------------------------------------------------
 
 def _serve_io(io, service) -> None:
-    """Request loop for one connection (either backend)."""
+    """Request loop for one connection (either backend).
+
+    ``service.handle`` may return its payload as ``bytes`` or as a
+    scatter-gather buffer list (a ``GET_VARS`` reply streams tensor
+    views with no concat copy)."""
     while True:
         body = io.recv_frame()
         if body is None:
@@ -257,13 +383,24 @@ def _serve_io(io, service) -> None:
                 rtype, rpayload = service.handle(msg_type, tid, name, payload)
         except Exception as e:
             rtype, rpayload = ERR, repr(e).encode("utf-8")
-        resp = _pack_body(rtype, tid, name, rpayload)
+        if rtype is None:
+            # handler-requested drop: close WITHOUT responding — the
+            # lost-response window of a peer dying mid-request (the
+            # at-most-once failure-path tests inject through this)
+            return
+        resp_bufs = _pack_body_vec(rtype, tid, name,
+                                   rpayload if isinstance(rpayload, list)
+                                   else [rpayload])
         if tel:
             sc = _obs_stats.scope("rpc.server")
             sc.counter("requests." + MSG_NAMES.get(msg_type,
                                                    str(msg_type))).inc()
             sc.counter("bytes_in").inc(len(body))
-            sc.counter("bytes_out").inc(len(resp))
+            sc.counter("bytes_out").inc(serde.buffers_nbytes(resp_bufs))
+            if msg_type in (SEND_VARS, GET_VARS) and len(payload) >= 4:
+                # batch frames carry their pair count up front
+                sc.counter("batched_vars").inc(
+                    struct.unpack_from("<I", payload)[0])
             if rtype == ERR:
                 sc.counter("handler_errors").inc()
             # includes any time the handler BLOCKED on a sync-mode
@@ -272,7 +409,10 @@ def _serve_io(io, service) -> None:
             sc.histogram("handle_ms", buckets=_RPC_MS_BUCKETS).observe(
                 (time.perf_counter() - t0) * 1e3)
         try:
-            io.send_frame(resp)
+            nbytes, vectored = _send_frame_any(io, resp_bufs)
+            if tel and vectored:
+                _obs_stats.scope("rpc.server").counter(
+                    "vectored_bytes").inc(nbytes)
         except ConnectionError:
             return
 
@@ -321,17 +461,76 @@ class RPCServer:
         self._impl.stop()
 
 
+_HOST_NORM_CACHE: Dict[str, str] = {}
+
+
+def _normalize_host(host: str) -> str:
+    """Canonical spelling of a ready-file host: wildcard binds collapse
+    to ``*``, names resolve to their address, loopback spellings agree —
+    so ``0.0.0.0``/hostname vs ``127.0.0.1`` endpoint lists still match
+    (ADVICE r5: a live server must never time out over a spelling)."""
+    host = host.strip().lower()
+    if host in ("0.0.0.0", "::", "*", ""):
+        return "*"
+    if host == "localhost":
+        return "127.0.0.1"
+    cached = _HOST_NORM_CACHE.get(host)
+    if cached is None:
+        try:
+            cached = socket.gethostbyname(host)
+        except OSError:
+            cached = host
+        _HOST_NORM_CACHE[host] = cached
+    return cached
+
+
+def _ready_file_present(ready_dir: str, endpoint: str) -> bool:
+    """True when a ready-file announces ``endpoint`` — matched verbatim
+    first, then by port with normalized hosts (a server that bound
+    ``0.0.0.0``/a hostname announces under that spelling).
+
+    A wildcard-only match (``0.0.0.0:PORT.ready``) names no host, so on
+    a SHARED ready-dir it could belong to another machine's same-port
+    server — it is only trusted after a connect probe confirms a local
+    listener."""
+    if os.path.exists(os.path.join(ready_dir, endpoint + ".ready")):
+        return True
+    host, _, port = endpoint.rpartition(":")
+    want = _normalize_host(host)
+    suffix = f":{port}.ready"
+    try:
+        entries = os.listdir(ready_dir)
+    except OSError:
+        return False
+    wildcard = False
+    for fn in entries:
+        if not fn.endswith(suffix):
+            continue
+        got = _normalize_host(fn[:-len(suffix)])
+        if got == want:
+            return True  # exact host match wins over any wildcard file
+        wildcard = wildcard or got == "*" or want == "*"
+    return wildcard and RPCClient._probe(endpoint, 1.0)
+
+
 def wait_server_ready(endpoints, timeout: float = 90.0,
                       ready_dir: Optional[str] = None,
-                      log_every: float = 2.0) -> None:
+                      log_every: float = 2.0,
+                      probe_grace: Optional[float] = None) -> None:
     """Block until every endpoint's server is listening.
 
     With ``PADDLE_READY_DIR`` set (the deterministic path — every
     RPCServer in that environment announces itself with an atomic
     ready-file), this waits on the files: no connection attempts, no
-    races with a server mid-bind.  Without it, falls back to probe
-    connects (the reference ``_wait_ps_ready`` role,
-    test_dist_base.py:232, bounded here by ``timeout``).
+    races with a server mid-bind.  Ready filenames are matched with
+    normalized hosts (wildcard binds, hostnames and loopback spellings
+    all agree), and after ``probe_grace`` seconds (default
+    ``min(5, timeout/2)``) a still-missing file falls back to a connect
+    probe — a live server whose announcement went to a different
+    ready-dir (or spelling) can no longer time the caller out.  Without
+    a ready-dir, probe connects from the start (the reference
+    ``_wait_ps_ready`` role, test_dist_base.py:232, bounded by
+    ``timeout``).
 
     The wait is never silent: every probe round that leaves servers
     pending increments ``rpc.wait_server.retries``, and a progress line
@@ -342,12 +541,25 @@ def wait_server_ready(endpoints, timeout: float = 90.0,
     deadline = t_start + timeout
     next_log = t_start + log_every
     ready_dir = ready_dir or os.environ.get("PADDLE_READY_DIR")
+    if probe_grace is None:
+        probe_grace = min(5.0, timeout / 2.0)
+    probe_after = t_start + probe_grace
     pending = [e.strip() for e in endpoints]
     while pending:
         still = []
         for ep in pending:
             if ready_dir:
-                ok = os.path.exists(os.path.join(ready_dir, ep + ".ready"))
+                ok = _ready_file_present(ready_dir, ep)
+                if not ok and time.monotonic() >= probe_after:
+                    # grace expired: trust a live listener over a
+                    # missing announcement file
+                    ok = RPCClient._probe(ep, 1.0)
+                    if ok and _telemetry_on():
+                        _obs_stats.counter(
+                            "rpc.wait_server.probe_fallbacks",
+                            "endpoints accepted via the connect-probe "
+                            "fallback after the ready-file grace "
+                            "period").inc()
             else:
                 ok = RPCClient._probe(ep, 1.0)
             if not ok:
@@ -498,12 +710,18 @@ class _Conn:
 
 
 class RPCClient:
-    """Trainer-side client: one persistent connection per endpoint +
-    a shared pool for concurrent fan-out (``GRPCClient`` analogue)."""
+    """Trainer-side client: ``FLAGS_rpc_conns_per_endpoint`` striped
+    persistent connections per endpoint + a shared pool for concurrent
+    fan-out (``GRPCClient`` analogue).  Stripe selection prefers an idle
+    connection, so concurrent requests to one pserver pipeline across
+    stripes instead of serializing on one connection lock."""
 
     def __init__(self, trainer_id: int = 0):
         self.trainer_id = trainer_id
-        self._conns: Dict[str, _Conn] = {}
+        # endpoint -> fixed-size stripe list (None = not yet connected);
+        # stripe width is latched per endpoint at first use
+        self._conns: Dict[str, List[Optional[_Conn]]] = {}
+        self._rr: Dict[str, int] = {}
         self._was_connected: set = set()
         self._conns_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=16,
@@ -571,8 +789,28 @@ class RPCClient:
 
     def _conn(self, endpoint: str, timeout: float = _CONNECT_TIMEOUT) -> _Conn:
         with self._conns_lock:
-            c = self._conns.get(endpoint)
+            pool = self._conns.get(endpoint)
+            if pool is None:
+                pool = self._conns[endpoint] = \
+                    [None] * max(1, _int_flag("rpc_conns_per_endpoint", 2))
             was = endpoint in self._was_connected
+            # stripe choice: an idle live connection first (``locked()``
+            # is a hint — a raced grab just means one extra queued
+            # request), then an unopened slot, then round-robin
+            idx = None
+            for i, x in enumerate(pool):
+                if x is not None and not x.lock.locked():
+                    idx = i
+                    break
+            if idx is None:
+                for i, x in enumerate(pool):
+                    if x is None:
+                        idx = i
+                        break
+            if idx is None:
+                idx = self._rr.get(endpoint, 0) % len(pool)
+                self._rr[endpoint] = idx + 1
+            c = pool[idx]
         if c is not None:
             return c
         # Reconnect deadline policy: the LONG deadline exists for initial
@@ -586,22 +824,29 @@ class RPCClient:
         # must not stall requests to healthy pservers
         c = _Conn(endpoint, timeout)
         with self._conns_lock:
-            winner = self._conns.get(endpoint)
-            if winner is None:
-                self._conns[endpoint] = c
-                self._was_connected.add(endpoint)
-                return c
-        # raced another creator: keep theirs, drop ours
+            pool = self._conns.get(endpoint)
+            if pool is not None and idx < len(pool):
+                winner = pool[idx]
+                if winner is None:
+                    pool[idx] = c
+                    self._was_connected.add(endpoint)
+                    return c
+            else:
+                winner = None
+        # raced another creator (or the pool was dropped): keep theirs
         try:
             c.io.close()
         except Exception:
             pass
-        return winner
+        return winner if winner is not None else self._conn(endpoint, timeout)
 
     def _drop_conn(self, endpoint: str, c: "_Conn") -> None:
         with self._conns_lock:
-            if self._conns.get(endpoint) is c:
-                self._conns.pop(endpoint)
+            pool = self._conns.get(endpoint)
+            if pool:
+                for i, x in enumerate(pool):
+                    if x is c:
+                        pool[i] = None
         try:
             with c.lock:  # never free under a peer thread's send/recv
                 c.io.close()
@@ -609,21 +854,28 @@ class RPCClient:
             pass
 
     # messages safe to auto-retry after a connection error: read-only or
-    # idempotent on the server.  SEND_VAR (async mode applies grads on
-    # arrival) and BATCH_BARRIER (closes a round) could have been applied
-    # before the response was lost — retrying would double-count, so they
-    # surface the error instead (the reference's at-most-once discipline
-    # for mutating RPCs).
-    _RETRYABLE = frozenset((GET_VAR, PREFETCH, FETCH_BARRIER,
+    # idempotent on the server.  SEND_VAR/SEND_VARS (async mode applies
+    # grads on arrival) and BATCH_BARRIER (closes a round) could have been
+    # applied before the response was lost — retrying would double-count,
+    # so they surface the error instead (the reference's at-most-once
+    # discipline for mutating RPCs).  A batch frame is all-or-nothing on
+    # the wire (the server decodes it only once fully received), so
+    # SEND_VARS keeps the same discipline as N SEND_VARs.
+    _RETRYABLE = frozenset((GET_VAR, GET_VARS, PREFETCH, FETCH_BARRIER,
                             CHECKPOINT_NOTIFY, STATS_PULL))
 
     def _raw_request(self, endpoint: str, msg_type: int, name: str = "",
-                     payload: bytes = b"", retry_all: bool = False,
-                     connect_timeout: Optional[float] = None):
+                     payload=b"", retry_all: bool = False,
+                     connect_timeout: Optional[float] = None,
+                     n_vars: int = 0):
+        """``payload``: bytes, or a scatter-gather buffer list (batched
+        frames — sent via sendmsg/iovec, no concat copy)."""
         tel = _telemetry_on()
         t0 = time.perf_counter() if tel else None
         sc = _obs_stats.scope("rpc.client") if tel else None
-        req = _pack_body(msg_type, self.trainer_id, name, payload)
+        req_bufs = _pack_body_vec(msg_type, self.trainer_id, name,
+                                  payload if isinstance(payload, list)
+                                  else [payload])
         body = None
         for attempt in (0, 1):
             # retry connects get a short deadline: the long one is only for
@@ -635,7 +887,7 @@ class RPCClient:
                            else _CONNECT_TIMEOUT if attempt == 0 else 5.0)
             try:
                 with c.lock:
-                    c.io.send_frame(req)
+                    req_len, vectored = _send_frame_any(c.io, req_bufs)
                     body = c.io.recv_frame()
                 if body is None:
                     raise ConnectionError(
@@ -656,8 +908,14 @@ class RPCClient:
         if tel:
             sc.counter("requests." + MSG_NAMES.get(msg_type,
                                                    str(msg_type))).inc()
-            sc.counter("bytes_sent").inc(len(req))
+            sc.counter("bytes_sent").inc(req_len)
             sc.counter("bytes_recv").inc(len(body))
+            if vectored:
+                sc.counter("vectored_bytes").inc(req_len)
+            if n_vars:
+                # vars carried per batched frame: frames-per-round vs
+                # batched_vars is the round-trip amortization ratio
+                sc.counter("batched_vars").inc(n_vars)
             sc.histogram("latency_ms", buckets=_RPC_MS_BUCKETS).observe(
                 (time.perf_counter() - t0) * 1e3)
             if rtype == ERR:
@@ -665,14 +923,15 @@ class RPCClient:
         if rtype == ERR:
             raise RuntimeError(
                 f"pserver {endpoint} error for {name!r}: "
-                f"{rpayload.decode('utf-8', 'replace')}")
+                f"{bytes(rpayload).decode('utf-8', 'replace')}")
         return rpayload
 
     def _request(self, endpoint: str, msg_type: int, name: str = "",
-                 payload: bytes = b""):
+                 payload=b"", n_vars: int = 0):
         phys = self._resolve(endpoint)
         try:
-            return self._raw_request(phys, msg_type, name, payload)
+            return self._raw_request(phys, msg_type, name, payload,
+                                     n_vars=n_vars)
         except ConnectionError:
             if self._registry is None or endpoint == self._registry:
                 raise
@@ -694,20 +953,105 @@ class RPCClient:
                 # request lands on checkpoint-restored state (one extra
                 # async grad — the reference's elastic-mode tolerance).
                 raise
-            # Non-idempotent messages (SEND_VAR/BATCH_BARRIER/...) get ONE
-            # attempt at the replacement: with retry_all a transient drop
-            # at the new server could apply the message twice there — two
-            # duplicate grads, beyond the documented one-extra-async-grad
-            # tolerance.  Read-only messages still retry via _raw_request's
-            # own _RETRYABLE gate.
-            return self._raw_request(new_phys, msg_type, name, payload)
+            # Non-idempotent messages (SEND_VAR/SEND_VARS/BATCH_BARRIER/
+            # ...) get ONE attempt at the replacement: with retry_all a
+            # transient drop at the new server could apply the message
+            # twice there — two duplicate grads, beyond the documented
+            # one-extra-async-grad tolerance.  Read-only messages still
+            # retry via _raw_request's own _RETRYABLE gate.
+            return self._raw_request(new_phys, msg_type, name, payload,
+                                     n_vars=n_vars)
 
     # -- public API (grpc_client.h:180-206 signatures) ---------------------
     def send_var(self, endpoint: str, name: str, value) -> None:
-        self._request(endpoint, SEND_VAR, name, serde.dumps_value(value))
+        self._request(endpoint, SEND_VAR, name,
+                      serde.dumps_value_vec(value), n_vars=1)
 
     def get_var(self, endpoint: str, name: str):
         return serde.loads_value(self._request(endpoint, GET_VAR, name))
+
+    # -- batched var transport ---------------------------------------------
+    def send_vars(self, endpoint: str,
+                  pairs: Sequence[Tuple[str, object]]) -> None:
+        """One ``SEND_VARS`` frame carrying every ``(name, value)`` pair
+        (at-most-once, like N ``SEND_VAR`` s — never silently retried).
+        Batches whose tensor payload exceeds
+        ``FLAGS_rpc_stripe_chunk_bytes`` are split at VAR granularity
+        into per-stripe sub-batches sent concurrently, so a big dense
+        round uses every striped connection; per-var semantics on the
+        server are unchanged (a batch of N counts as N)."""
+        pairs = list(pairs)
+        if not pairs:
+            return
+        batches = self._stripe_batches(endpoint, pairs)
+        if len(batches) == 1:
+            self._request(endpoint, SEND_VARS, "",
+                          serde.dumps_batch_vec(pairs), n_vars=len(pairs))
+            return
+        # sub-batches go on DEDICATED threads, never back onto the
+        # shared fan-out pool: send_vars itself usually runs ON that
+        # pool (ps_ops._send fans out per endpoint), and nested
+        # submit+result on one bounded pool deadlocks once every worker
+        # holds an outer task.  One sub-batch rides this thread.
+        errs: List[BaseException] = []
+
+        def _one(sub):
+            try:
+                self._request(endpoint, SEND_VARS, "",
+                              serde.dumps_batch_vec(sub), n_vars=len(sub))
+            except BaseException as e:  # noqa: BLE001 - reraised below
+                errs.append(e)
+
+        threads = [threading.Thread(target=_one, args=(sub,), daemon=True)
+                   for sub in batches[1:]]
+        for t in threads:
+            t.start()
+        _one(batches[0])
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def get_vars(self, endpoint: str, names: Sequence[str],
+                 copy: bool = True) -> list:
+        """One ``GET_VARS`` round trip for many variables, in request
+        order.  Defaults to ``copy=True`` — writable owned arrays, same
+        semantics as N ``get_var`` calls.  ``copy=False`` returns
+        zero-copy read-only views over the response buffer (each view
+        pins the WHOLE response — right for a consumer that uses and
+        drops them within the round, like the recv host op)."""
+        names = list(names)
+        if not names:
+            return []
+        payload = serde.dumps_batch([(n, None) for n in names])
+        resp = self._request(endpoint, GET_VARS, "", payload,
+                             n_vars=len(names))
+        pairs = serde.loads_batch(resp, copy=copy)
+        if [n for n, _ in pairs] != names:
+            raise RuntimeError(
+                f"pserver {endpoint} GET_VARS answered out of order: "
+                f"asked {names[:4]}..., got {[n for n, _ in pairs][:4]}...")
+        return [v for _, v in pairs]
+
+    def _stripe_batches(self, endpoint: str, pairs: list) -> List[list]:
+        """Split a big batch into per-stripe sub-batches (greedy balance
+        by tensor bytes).  Single frame when striping is off, the batch
+        is small, or only one var."""
+        n_stripes = max(1, _int_flag("rpc_conns_per_endpoint", 2))
+        if n_stripes <= 1 or len(pairs) <= 1:
+            return [pairs]
+        chunk_min = _int_flag("rpc_stripe_chunk_bytes", 8 << 20)
+        sizes = [serde.value_nbytes(v) for _, v in pairs]
+        if chunk_min <= 0 or sum(sizes) < chunk_min:
+            return [pairs]
+        k = min(n_stripes, len(pairs))
+        buckets: List[list] = [[] for _ in range(k)]
+        fill = [0] * k
+        for (pair, sz) in sorted(zip(pairs, sizes), key=lambda t: -t[1]):
+            i = fill.index(min(fill))
+            buckets[i].append(pair)
+            fill[i] += sz
+        return [b for b in buckets if b]
 
     def prefetch(self, endpoint: str, table_name: str, ids):
         return serde.loads_value(

@@ -41,7 +41,7 @@ def local_snapshot_payload() -> bytes:
 
 
 def parse_snapshot(payload: bytes) -> dict:
-    state = json.loads(payload.decode("utf-8"))
+    state = json.loads(bytes(payload).decode("utf-8"))
     if state.get("version") != _WIRE_VERSION:
         raise ValueError(
             f"stats snapshot version {state.get('version')!r} != "

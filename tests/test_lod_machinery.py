@@ -324,3 +324,25 @@ def test_datafeeder_level2_emits_nested_contract():
     # zero-word sentences are legal and survive the feeder
     fd0 = feeder.feed([(([words[:2], []]),)])
     assert fd0["doc@LEN2"][0, 0] == 2 and fd0["doc@LEN2"][0, 1] == 0
+
+
+def test_datafeeder_level2_all_empty_batch_keeps_feature_shape():
+    """ADVICE r5: when EVERY sentence in a batch is empty, the feature
+    shape falls back to the declared var shape ([B, S, W, feat]) instead
+    of degrading to (0,)-shaped features that mismatch downstream."""
+    import paddle_tpu as fluid
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        d = fluid.layers.data("doc", [2], lod_level=2)
+        feeder = fluid.DataFeeder(feed_list=[d], program=prog)
+
+    fd = feeder.feed([(([[], []]),), (([[]]),)])
+    assert fd["doc"].ndim == 4 and fd["doc"].shape[-1] == 2, fd["doc"].shape
+    np.testing.assert_array_equal(fd["doc@LEN"], [2, 1])
+    assert not fd["doc@LEN2"].any()
+    # same trailing dims a mixed batch (one non-empty sentence) produces
+    words = np.arange(4, dtype="float64").reshape(2, 2)
+    fd_mixed = feeder.feed([(([words, []]),), (([[]]),)])
+    assert fd_mixed["doc"].shape[-1] == fd["doc"].shape[-1]
+    assert fd_mixed["doc"].ndim == fd["doc"].ndim

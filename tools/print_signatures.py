@@ -48,6 +48,10 @@ MODULES = [
     # distributed/parallel/inference surfaces (VERDICT r4 #6): these
     # public classes churn the most — freeze them too
     "paddle_tpu.distributed",
+    # the var-transport wire surface (batched SEND_VARS/GET_VARS,
+    # scatter-gather serde): frozen so wire-format/API drift is loud
+    "paddle_tpu.distributed.serde",
+    "paddle_tpu.distributed.transport",
     "paddle_tpu.parallel",
     "paddle_tpu.inference",
     "paddle_tpu.contrib.trainer",

@@ -15,7 +15,10 @@ combined JSON line itself, with explicit {"skipped": "budget"} /
 path override PADDLE_TPU_BENCH_STATS_PATH, empty disables):
 compile-cache hits/misses, lowering + XLA compile time and feed/fetch
 bytes from paddle_tpu.observability, so a BENCH_r*.json regression
-carries its own explanation.
+carries its own explanation.  The rpc_transport config additionally
+writes a sampled-trace artifact (bench_trace.json; path override
+PADDLE_TPU_BENCH_TRACE_PATH, empty disables): one traced batched round
+as a Chrome/Perfetto trace, so the wire spans are inspectable per run.
 Role analogue: the reference benchmark driver emits numbers as it goes
 (benchmark/fluid/fluid_benchmark.py:295 print_train_time), not at exit.
 
@@ -718,18 +721,71 @@ def bench_rpc_transport():
         finally:
             srv.stop()
 
-    saved = fluid.get_flags(list(LEGACY))
+    def traced_round(flags):
+        """One sampled batched round AFTER timing (sampling must not
+        pollute the measured numbers): the PR-3 wire spans —
+        rpc.client/rpc.server send_vars/get_vars — land in the span
+        ring, which _write_bench_trace turns into the trace artifact."""
+        from paddle_tpu.observability import trace as _trace
+
+        fluid.set_flags(dict(flags, trace_sample_rate=1.0))
+        try:
+            _trace.clear_spans()
+            srv = transport.RPCServer("127.0.0.1:0", _VarStore())
+            srv.start()
+            ep = f"127.0.0.1:{srv.port}"
+            client = transport.RPCClient(0)
+            try:
+                rng = np.random.RandomState(0)
+                small = [(f"v{i}", rng.randn(16).astype("float32"))
+                         for i in range(32)]
+                with _trace.start_span("bench::rpc_round", cat="bench"):
+                    client.send_vars(ep, small)
+                    client.get_vars(ep, [n for n, _ in small])
+            finally:
+                srv.stop()
+        finally:
+            fluid.set_flags({"trace_sample_rate": 0.0})
+
+    saved = fluid.get_flags(list(LEGACY) + ["trace_sample_rate"])
     out = {"storm_vars": 256, "dense_bytes": 64 << 20}
     try:
         run_mode(LEGACY, out, "legacy")
         run_mode(NEW, out, "batched")
+        traced_round(NEW)
     finally:
         fluid.set_flags(saved)
     out["storm_speedup"] = round(out["batched_storm_vars_per_sec"]
                                  / out["legacy_storm_vars_per_sec"], 2)
     out["dense_speedup"] = round(out["batched_dense_mb_per_sec"]
                                  / out["legacy_dense_mb_per_sec"], 2)
+    _write_bench_trace(out)
     return out
+
+
+def _write_bench_trace(out):
+    """Sampled-trace artifact next to step_stats.json
+    (PADDLE_TPU_BENCH_TRACE_PATH overrides, empty disables): the span
+    ring of the traced rpc_transport round as a Chrome/Perfetto trace,
+    so the batched-wire spans are *visible* in the bench artifact, not
+    just summarized."""
+    import os
+
+    path = os.environ.get("PADDLE_TPU_BENCH_TRACE_PATH", "bench_trace.json")
+    if not path:
+        return
+    try:
+        from paddle_tpu.observability import trace as _trace
+
+        snap = _trace.local_trace_snapshot()
+        if not snap["spans"]:
+            return
+        with open(path, "w") as f:
+            json.dump(_trace.stitch_chrome_trace({"bench": snap}), f)
+        out["trace_path"] = path
+        out["trace_spans"] = len(snap["spans"])
+    except Exception as e:  # telemetry must never take the bench down
+        out["trace_error"] = repr(e)[:200]
 
 
 A100_RESNET50_IMG_S = 2500.0

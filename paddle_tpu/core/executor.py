@@ -405,11 +405,34 @@ class Executor:
         self._training = training
         _live_executors.add(self)
         # fleet observability opt-in: FLAGS_debug_server_port=0 (default)
-        # makes this a flag read — no socket, no thread
+        # makes this a flag read — no socket, no thread; same deal for
+        # the crash flight recorder (FLAGS_flight_record_dir empty)
         _debug_server.maybe_start_from_flags()
+        from ..observability import flight as _flight
+        _flight.arm_from_flags()
 
     # -- public API --------------------------------------------------------
     def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, object]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+        sync: bool = False,
+    ):
+        # one step-root span per top-level run (head-sampled by
+        # FLAGS_trace_sample_rate): everything below — lowering, the
+        # jitted dispatch, and every RPC the host ops issue — stitches
+        # under this trace id, across processes (distributed/transport
+        # carries the context on the wire).  Nested runs (device
+        # segments, pserver optimize blocks) become child spans.
+        with _obs_trace.start_span("executor::step", cat="executor"):
+            return self._run_traced(program, feed, fetch_list, scope,
+                                    return_numpy, use_program_cache, sync)
+
+    def _run_traced(
         self,
         program: Optional[Program] = None,
         feed: Optional[Dict[str, object]] = None,
@@ -448,10 +471,12 @@ class Executor:
         lowering_ms = 0.0
         if entry is None:
             t_low0 = time.perf_counter_ns()
-            plan = analyze_block(program, 0, feed_names, fetch_names)
-            fn = build_block_fn(program, plan, training=self._training,
-                                mesh=self._mesh())
-            jitted = jax.jit(fn, donate_argnums=(1,))
+            with _obs_trace.start_span("executor::lower", cat="executor",
+                                       root=False):
+                plan = analyze_block(program, 0, feed_names, fetch_names)
+                fn = build_block_fn(program, plan, training=self._training,
+                                    mesh=self._mesh())
+                jitted = jax.jit(fn, donate_argnums=(1,))
             t_low1 = time.perf_counter_ns()
             lowering_ms = (t_low1 - t_low0) / 1e6
             entry = _CacheEntry(plan, jitted)
@@ -477,7 +502,10 @@ class Executor:
 
         compile_ms = 0.0
         t_disp0 = time.perf_counter_ns() if tel else None
-        fetches, new_state, rng_out = jitted(feed_vals, donated_state, const_state, rng)
+        with _obs_trace.start_span("executor::dispatch", cat="executor",
+                                   root=False):
+            fetches, new_state, rng_out = jitted(feed_vals, donated_state,
+                                                 const_state, rng)
         if tel:
             t_disp1 = time.perf_counter_ns()
             if not cache_hit:
@@ -678,8 +706,12 @@ class Executor:
 
         compile_ms = 0.0
         t_disp0 = time.perf_counter_ns() if tel else None
-        fetches, new_state, rng_out = jitted(stacked, donated_state,
-                                             const_state, rng)
+        # run_steps admits no host ops, so the K-step dispatch IS the
+        # step: one root span (head-sampled like run()'s)
+        with _obs_trace.start_span("executor::step", cat="executor",
+                                   tags={"k_steps": K}):
+            fetches, new_state, rng_out = jitted(stacked, donated_state,
+                                                 const_state, rng)
         if tel:
             t_disp1 = time.perf_counter_ns()
             if not cache_hit:
@@ -761,7 +793,13 @@ class Executor:
         for seg in segs:
             if seg[0] == "host":
                 for op in seg[1]:
-                    _host_ops.run_host_op(self, program, op, scope)
+                    # one child span per host op: in a stitched trace
+                    # the send/recv/barrier rows sit between the device
+                    # segments, with the pserver's server spans hanging
+                    # under them via the wire context
+                    with _obs_trace.start_span("host_op::" + op.type,
+                                               cat="executor", root=False):
+                        _host_ops.run_host_op(self, program, op, scope)
                 continue
             _, sub, seg_fetches, reads = seg
             sub_feed = {n: v for n, v in feed.items() if n in reads}

@@ -158,6 +158,24 @@ define_flag("health_dead_misses", 3.0,
             "gauge flips, and a TaskMaster consulting the registry "
             "requeues the worker's task leases immediately instead of "
             "waiting out the lease timeout")
+define_flag("trace_sample_rate", 0.0,
+            "distributed-tracing head-sampling rate in [0,1] "
+            "(observability/trace.py): each top-level Executor.run rolls "
+            "once and, when sampled, opens a step-root span whose context "
+            "propagates over the RPC wire so trainer and pserver spans "
+            "stitch under one trace id.  0 (default) disables tracing "
+            "entirely — no span-ring writes and zero extra wire bytes")
+define_flag("trace_ring_spans", 4096,
+            "capacity of the in-memory completed-span ring each process "
+            "keeps for TRACE_PULL / the /tracez debug page; oldest spans "
+            "fall off — bound memory, never block the hot path")
+define_flag("flight_record_dir", "",
+            "directory for crash flight-recorder dumps "
+            "(observability/flight.py): when set, unhandled exceptions, "
+            "SIGTERM and Heartbeat.stop(bye=False)-style dirty exits "
+            "write a JSON post-mortem (recent + in-flight spans, log "
+            "events, step-stats tail) there.  Empty (default) disarms "
+            "the recorder — no hooks installed")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

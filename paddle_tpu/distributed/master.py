@@ -147,10 +147,18 @@ class TaskMaster:
         n_dead = sum(1 for tid in expired
                      if self.pending[tid]["owner"] in dead
                      and self.pending[tid]["deadline"] > now)
-        if n_dead and _telemetry_on():
-            # leases reclaimed EARLY because the health registry declared
-            # the owner DEAD (vs. riding out lease_timeout)
-            _obs_stats.counter("master.dead_requeues").inc(n_dead)
+        if n_dead:
+            if _telemetry_on():
+                # leases reclaimed EARLY because the health registry
+                # declared the owner DEAD (vs. riding out lease_timeout)
+                _obs_stats.counter("master.dead_requeues").inc(n_dead)
+            # post-mortem breadcrumb: which trainers' work got reclaimed
+            from ..observability import flight as _flight
+            _flight.note("master_dead_requeue", n=n_dead,
+                         owners=sorted({self.pending[tid]["owner"]
+                                        for tid in expired
+                                        if self.pending[tid]["owner"]
+                                        in dead}))
         for tid in expired:
             task = self.pending.pop(tid)["task"]
             self._note_failure(task)

@@ -25,6 +25,8 @@ import numpy as np
 from ..core.host_ops import register_host_op
 from ..core.program import Operator, Program, Variable
 from ..core.selected_rows import SelectedRows
+from ..observability import flight as _flight
+from ..observability import trace as _trace
 from . import transport
 from .transport import (BATCH_BARRIER, CHECKPOINT_NOTIFY, COMPLETE,
                         FETCH_BARRIER, GET_VAR, GET_VARS, OK, PREFETCH,
@@ -362,15 +364,24 @@ class PServerLoop:
         per_trainer = [self.closed[t].popleft()
                        for t in range(self.num_trainers) if self.closed[t]]
         try:
-            self._merge_grads(per_trainer)
-            self._run_lr()
-            for bidx in sorted(set(self.grad_to_block.values())):
-                self._run_block(bidx)
+            # child of the round-closing BATCH_BARRIER's server span
+            # (the inbound wire context): in a stitched trace the apply
+            # work hangs under the barrier that triggered it, which is
+            # exactly where "why was this batch_barrier slow" lives
+            with _trace.start_span("pserver::apply_round", cat="pserver",
+                                   root=False,
+                                   tags={"round": self.applied_rounds + 1}):
+                self._merge_grads(per_trainer)
+                self._run_lr()
+                for bidx in sorted(set(self.grad_to_block.values())):
+                    self._run_block(bidx)
         except Exception as e:
             # record + still advance the round so waiting GETs wake up and
             # surface the error instead of deadlocking (exception_holder.h
             # role in the reference's threaded executor)
             self.error = e
+            _flight.note("pserver_apply_error", error=repr(e)[:200],
+                         round=self.applied_rounds + 1)
             raise
         finally:
             self.applied_rounds += 1
@@ -404,9 +415,14 @@ class PServerLoop:
                 self.ckpt_dir and self.ckpt_every > 0
                 and self._async_sends %
                 (n_grads * self.ckpt_every) == 0)
-        with self.block_locks[bidx]:
-            self.scope.set_var(name, value)
-            self._run_block(bidx)
+        # child of the SEND_VAR(S) server span: the per-var hogwild
+        # apply, lock wait included (a hot block lock shows up as a
+        # long apply_async under a short wire span)
+        with _trace.start_span("pserver::apply_async", cat="pserver",
+                               root=False, tags={"var": name}):
+            with self.block_locks[bidx]:
+                self.scope.set_var(name, value)
+                self._run_block(bidx)
         if ckpt_now:
             # hogwild checkpoint: per-var snapshot consistency
             # only, like the Go async pserver (service.go:346)
@@ -541,11 +557,17 @@ def _listen_and_serv(exe, program, op, scope):
         hb = registry_mod.Heartbeat(registry_ep, op.attr("endpoint"),
                                     f"{host}:{server.port}")
         hb.start()
+    clean = False
     try:
         loop.wait_exit()
+        clean = loop.error is None
     finally:
         if hb is not None:
-            hb.stop()
+            # a clean end of training (every trainer said COMPLETE, no
+            # apply error) says goodbye; anything else is a DIRTY exit —
+            # the lease ages out and, when armed, the flight recorder
+            # writes this pserver's post-mortem
+            hb.stop(bye=clean)
         server.stop()
 
 

@@ -206,10 +206,17 @@ class Heartbeat:
     def stop(self, bye: bool = False):
         """Stop refreshing.  ``bye=True`` additionally deregisters (the
         clean-shutdown path); the default leaves the lease to expire —
-        which is also what an actual crash looks like to the registry."""
+        which is also what an actual crash looks like to the registry,
+        so it counts as a DIRTY exit: with the flight recorder armed
+        (``FLAGS_flight_record_dir``) this worker writes its post-mortem
+        (recent + in-flight spans, log events, step tail) on the way
+        out — the registry's DEAD gauge flip gets a black box to read."""
         self._stop.set()
         if bye:
             try:
                 deregister(self._client, self.registry_ep, self.logical)
             except Exception:
                 pass         # registry already gone: nothing to clean
+        else:
+            from ..observability import flight as _flight
+            _flight.dirty_exit(f"heartbeat_stop:{self.logical}")

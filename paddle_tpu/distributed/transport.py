@@ -50,7 +50,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import serde
+from ..observability import flight as _flight
 from ..observability import stats as _obs_stats
+from ..observability import trace as _trace
 from ..observability.trace import flags_on as _telemetry_on
 
 # message types (request)
@@ -73,6 +75,11 @@ GET_VARS = 12
 # _serve_io for EVERY service object, so any RPCServer — pserver, master,
 # registry — can be scraped for its process-local metric snapshot
 STATS_PULL = 24
+# distributed tracing (observability/trace.py): pull this process's
+# bounded span ring — answered centrally like STATS_PULL, so trainer 0
+# (or tools/stitch_trace.py) can stitch a fleet-wide trace from any
+# worker's RPC port
+TRACE_PULL = 25
 # message types (response)
 OK = 0
 ERR = 255
@@ -82,9 +89,19 @@ MSG_NAMES = {SEND_VAR: "send_var", GET_VAR: "get_var",
              BATCH_BARRIER: "batch_barrier", FETCH_BARRIER: "fetch_barrier",
              COMPLETE: "complete", PREFETCH: "prefetch",
              CHECKPOINT_NOTIFY: "checkpoint_notify",
-             STATS_PULL: "stats_pull"}
+             STATS_PULL: "stats_pull", TRACE_PULL: "trace_pull"}
 
 _HDR = struct.Struct("<BiH")  # msg_type, trainer_id, name_len
+
+# Trace-context frame extension: the high bit of msg_type says "a
+# compact trace context (trace.WIRE_CTX_SIZE bytes) sits between the
+# name and the payload".  Real message types stay < 0x80 (ERR=255 is a
+# response type and is excluded from the flag check), so a frame
+# WITHOUT the extension is byte-identical to the pre-trace wire format
+# — old peers interop untouched as long as sampling is off, which is
+# the default.  Enable FLAGS_trace_sample_rate only on an upgraded
+# fleet.
+TRACE_CTX_FLAG = 0x80
 
 _CONNECT_TIMEOUT = 120.0
 
@@ -122,31 +139,57 @@ def _native_lib():
 
 
 def _pack_body(msg_type: int, trainer_id: int, name: str,
-               payload: bytes) -> bytes:
+               payload: bytes, ctx: Optional[bytes] = None) -> bytes:
     nm = name.encode("utf-8")
+    if ctx:
+        return (_HDR.pack(msg_type | TRACE_CTX_FLAG, trainer_id, len(nm))
+                + nm + ctx + payload)
     return _HDR.pack(msg_type, trainer_id, len(nm)) + nm + payload
 
 
 def _pack_body_vec(msg_type: int, trainer_id: int, name: str,
-                   payload_bufs: Sequence) -> list:
+                   payload_bufs: Sequence,
+                   ctx: Optional[bytes] = None) -> list:
     """Scatter-gather body: header bytes + the payload buffer list
     untouched (tensor bodies stay views; see serde.dumps_value_vec).
     Zero-length buffers are dropped so empty-payload control messages
-    (barriers, COMPLETE) keep the single-buffer fast path."""
+    (barriers, COMPLETE) keep the single-buffer fast path.  ``ctx``
+    (a sampled trace context) rides between name and payload under the
+    TRACE_CTX_FLAG msg-type bit; None adds zero bytes."""
     nm = name.encode("utf-8")
-    return [_HDR.pack(msg_type, trainer_id, len(nm)) + nm,
-            *[b for b in payload_bufs if len(b)]]
+    if ctx:
+        head = (_HDR.pack(msg_type | TRACE_CTX_FLAG, trainer_id, len(nm))
+                + nm + ctx)
+    else:
+        head = _HDR.pack(msg_type, trainer_id, len(nm)) + nm
+    return [head, *[b for b in payload_bufs if len(b)]]
+
+
+def _unpack_body_ext(body: bytes):
+    """Returns (msg_type, trainer_id, name, payload, ctx_bytes) —
+    ``payload`` is a zero-copy memoryview over ``body`` (a 64 MB inbound
+    gradient frame must not pay a full slice copy before
+    ``loads_batch(copy=False)`` builds its views); ``ctx_bytes`` is the
+    raw trace-context extension or None.  A frame without the extension
+    parses exactly as the pre-trace format."""
+    raw, trainer_id, name_len = _HDR.unpack_from(body, 0)
+    off = _HDR.size
+    name = bytes(body[off:off + name_len]).decode("utf-8")
+    off += name_len
+    ctx = None
+    msg_type = raw
+    if raw != ERR and raw & TRACE_CTX_FLAG:
+        msg_type = raw & ~TRACE_CTX_FLAG
+        ctx = bytes(body[off:off + _trace.WIRE_CTX_SIZE])
+        off += _trace.WIRE_CTX_SIZE
+    return msg_type, trainer_id, name, memoryview(body)[off:], ctx
 
 
 def _unpack_body(body: bytes):
-    """Returns (msg_type, trainer_id, name, payload) — ``payload`` is a
-    zero-copy memoryview over ``body`` (a 64 MB inbound gradient frame
-    must not pay a full slice copy before ``loads_batch(copy=False)``
-    builds its views); consumers needing ``bytes`` wrap it explicitly."""
-    msg_type, trainer_id, name_len = _HDR.unpack_from(body, 0)
-    off = _HDR.size
-    name = bytes(body[off:off + name_len]).decode("utf-8")
-    return msg_type, trainer_id, name, memoryview(body)[off + name_len:]
+    """4-tuple form of :func:`_unpack_body_ext` (trace context, if any,
+    is parsed off and dropped)."""
+    msg_type, trainer_id, name, payload, _ = _unpack_body_ext(body)
+    return msg_type, trainer_id, name, payload
 
 
 def _int_flag(name: str, default: int) -> int:
@@ -361,26 +404,49 @@ def _connect_io(host: str, port: int, timeout: float):
 # server
 # ---------------------------------------------------------------------------
 
+def _handle_request(service, msg_type: int, tid: int, name: str, payload):
+    """One request against the service, with the observability messages
+    (STATS_PULL/TRACE_PULL) answered centrally so EVERY service —
+    pserver, master, registry — is scrapable without changes."""
+    if msg_type == STATS_PULL:
+        from ..observability import aggregate as _obs_aggregate
+        return OK, _obs_aggregate.local_snapshot_payload()
+    if msg_type == TRACE_PULL:
+        from ..observability import aggregate as _obs_aggregate
+        return OK, _obs_aggregate.local_trace_payload()
+    return service.handle(msg_type, tid, name, payload)
+
+
 def _serve_io(io, service) -> None:
     """Request loop for one connection (either backend).
 
     ``service.handle`` may return its payload as ``bytes`` or as a
     scatter-gather buffer list (a ``GET_VARS`` reply streams tensor
-    views with no concat copy)."""
+    views with no concat copy).  A frame carrying a sampled trace
+    context gets a server-side span parented under the inbound context
+    — the cross-process half of the Dapper stitch; the span covers the
+    WHOLE handle (including any sync-barrier block, which is exactly
+    the wait a stitched timeline needs to show)."""
     while True:
         body = io.recv_frame()
         if body is None:
             return
         tel = _telemetry_on()
         t0 = time.perf_counter() if tel else None
-        msg_type, tid, name, payload = _unpack_body(body)
+        msg_type, tid, name, payload, wctx = _unpack_body_ext(body)
+        sctx = _trace.ctx_from_wire(wctx) if wctx else None
         try:
-            if msg_type == STATS_PULL:
-                # fleet scrape: served here so every service gets it
-                from ..observability import aggregate as _obs_aggregate
-                rtype, rpayload = OK, _obs_aggregate.local_snapshot_payload()
+            if sctx is not None:
+                with _trace.start_span(
+                        "rpc.server::" + MSG_NAMES.get(msg_type,
+                                                       str(msg_type)),
+                        cat="rpc", parent=sctx, root=False,
+                        tags={"trainer_id": tid}):
+                    rtype, rpayload = _handle_request(service, msg_type,
+                                                      tid, name, payload)
             else:
-                rtype, rpayload = service.handle(msg_type, tid, name, payload)
+                rtype, rpayload = _handle_request(service, msg_type, tid,
+                                                  name, payload)
         except Exception as e:
             rtype, rpayload = ERR, repr(e).encode("utf-8")
         if rtype is None:
@@ -452,9 +518,11 @@ class RPCServer:
 
     def start(self) -> None:
         # every serving process is debug-scrapable when the flag asks
-        # for it (no-op, no socket, at the default flag value 0)
+        # for it (no-op, no socket, at the default flag value 0), and
+        # leaves a flight-recorder post-mortem when armed
         from ..observability import debug_server as _debug_server
         _debug_server.maybe_start_from_flags()
+        _flight.arm_from_flags()
         self._impl.start()
 
     def stop(self) -> None:
@@ -869,13 +937,34 @@ class RPCClient:
                      connect_timeout: Optional[float] = None,
                      n_vars: int = 0):
         """``payload``: bytes, or a scatter-gather buffer list (batched
-        frames — sent via sendmsg/iovec, no concat copy)."""
+        frames — sent via sendmsg/iovec, no concat copy).
+
+        Under a sampled trace context this opens a client span and
+        injects ITS context into the frame's trace extension, so the
+        server's span parents under this request (not the whole step);
+        with nothing sampled the frame is byte-identical to the
+        pre-trace wire."""
         tel = _telemetry_on()
         t0 = time.perf_counter() if tel else None
         sc = _obs_stats.scope("rpc.client") if tel else None
+        tctx = _trace.current()
+        span = (_trace.start_span(
+            "rpc.client::" + MSG_NAMES.get(msg_type, str(msg_type)),
+            cat="rpc", root=False,
+            tags={"endpoint": endpoint, "n_vars": n_vars} if n_vars
+            else {"endpoint": endpoint})
+            if tctx is not None and tctx.sampled else _trace.NOOP)
+        with span:
+            return self._raw_request_framed(endpoint, msg_type, name,
+                                            payload, retry_all,
+                                            connect_timeout, n_vars,
+                                            tel, t0, sc)
+
+    def _raw_request_framed(self, endpoint, msg_type, name, payload,
+                            retry_all, connect_timeout, n_vars, tel, t0, sc):
         req_bufs = _pack_body_vec(msg_type, self.trainer_id, name,
                                   payload if isinstance(payload, list)
-                                  else [payload])
+                                  else [payload], ctx=_trace.inject())
         body = None
         for attempt in (0, 1):
             # retry connects get a short deadline: the long one is only for
@@ -941,8 +1030,12 @@ class RPCClient:
             if _telemetry_on():
                 _obs_stats.scope("rpc.client").counter("failovers").inc()
             # loud by design: operators should see every elastic failover
+            # (and the flight recorder should remember it post-mortem)
             print(f"[rpc-failover] {endpoint} msg={msg_type}: "
                   f"{phys} -> {new_phys}", file=_sys.stderr, flush=True)
+            _flight.note("rpc_failover", endpoint=endpoint,
+                         msg=MSG_NAMES.get(msg_type, str(msg_type)),
+                         old=phys, new=new_phys)
             if new_phys == phys and msg_type not in self._RETRYABLE:
                 # same address answering the probe: could be the SAME live
                 # server after a transient drop — re-sending a SEND_VAR or
@@ -994,15 +1087,20 @@ class RPCClient:
         # submit+result on one bounded pool deadlocks once every worker
         # holds an outer task.  One sub-batch rides this thread.
         errs: List[BaseException] = []
+        tctx = _trace.current()
+        tctx = tctx if tctx is not None and tctx.sampled else None
 
-        def _one(sub):
+        def _one(sub, _ctx=None):
             try:
-                self._request(endpoint, SEND_VARS, "",
-                              serde.dumps_batch_vec(sub), n_vars=len(sub))
+                with _trace.activate(_ctx):
+                    self._request(endpoint, SEND_VARS, "",
+                                  serde.dumps_batch_vec(sub),
+                                  n_vars=len(sub))
             except BaseException as e:  # noqa: BLE001 - reraised below
                 errs.append(e)
 
-        threads = [threading.Thread(target=_one, args=(sub,), daemon=True)
+        threads = [threading.Thread(target=_one, args=(sub, tctx),
+                                    daemon=True)
                    for sub in batches[1:]]
         for t in threads:
             t.start()
@@ -1084,8 +1182,19 @@ class RPCClient:
             self._drop_conn(endpoint, c)
 
     def parallel(self, calls):
-        """Run [(fn, args...), ...] concurrently; reraise first error."""
-        futs = [self._pool.submit(fn, *args) for fn, *args in calls]
+        """Run [(fn, args...), ...] concurrently; reraise first error.
+        A sampled trace context on the calling thread is re-homed onto
+        the pool threads so per-endpoint RPC spans still stitch under
+        the step root."""
+        ctx = _trace.current()
+        if ctx is not None and ctx.sampled:
+            def _with_ctx(fn, *args):
+                with _trace.activate(ctx):
+                    return fn(*args)
+            futs = [self._pool.submit(_with_ctx, fn, *args)
+                    for fn, *args in calls]
+        else:
+            futs = [self._pool.submit(fn, *args) for fn, *args in calls]
         return [f.result() for f in futs]
 
 

@@ -13,7 +13,14 @@ Three cooperating pieces (see each module's docstring):
   time) with ``last_n()`` and percentile ``summary()``.
 - :mod:`trace` — runtime spans feeding the existing profiler event
   stream under a ``runtime::`` category, so Chrome traces show executor
-  internals alongside user spans.
+  internals alongside user spans — PLUS the distributed-tracing layer:
+  trace/span ids, head sampling (``FLAGS_trace_sample_rate``),
+  cross-process context propagation over the RPC wire, a bounded span
+  ring per process, and ``stitch_chrome_trace`` fleet stitching.
+- :mod:`flight` — the crash flight recorder: bounded log-event ring +
+  post-mortem dumps (recent/in-flight spans, events, step tail) to
+  ``FLAGS_flight_record_dir`` on unhandled exceptions, SIGTERM and
+  dirty exits.
 
 The export/aggregation half (this package's fleet plane):
 
@@ -31,7 +38,15 @@ flag lookup.
 """
 from __future__ import annotations
 
-from . import aggregate, debug_server, health, stats, step_stats, trace  # noqa: F401
+from . import (  # noqa: F401
+    aggregate,
+    debug_server,
+    flight,
+    health,
+    stats,
+    step_stats,
+    trace,
+)
 from .aggregate import FleetAggregator  # noqa: F401
 from .health import HealthTable  # noqa: F401
 from .stats import (  # noqa: F401
@@ -41,6 +56,7 @@ from .stats import (  # noqa: F401
     to_prometheus_text,
 )
 from .step_stats import StepStats, StepStatsRecorder  # noqa: F401
+from .trace import SpanContext, start_span, stitch_chrome_trace  # noqa: F401
 
 
 def enabled() -> bool:

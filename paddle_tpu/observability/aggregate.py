@@ -27,6 +27,7 @@ import json
 from typing import Dict, Mapping, Optional
 
 from . import stats as _stats
+from . import trace as _trace
 
 # wire form version guard (payloads cross processes of possibly
 # different builds during a rolling restart)
@@ -47,6 +48,22 @@ def parse_snapshot(payload: bytes) -> dict:
             f"stats snapshot version {state.get('version')!r} != "
             f"{_WIRE_VERSION}")
     return state
+
+
+def local_trace_payload() -> bytes:
+    """The TRACE_PULL response body: this process's span-ring snapshot
+    (``trace.local_trace_snapshot()`` — pid/role/host identity + spans),
+    versioned like the stats payload."""
+    return _trace.local_snapshot_payload()
+
+
+def parse_trace_snapshot(payload: bytes) -> dict:
+    snap = json.loads(bytes(payload).decode("utf-8"))
+    if snap.get("version") != _trace._SNAPSHOT_VERSION:
+        raise ValueError(
+            f"trace snapshot version {snap.get('version')!r} != "
+            f"{_trace._SNAPSHOT_VERSION}")
+    return snap
 
 
 def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
@@ -148,8 +165,13 @@ class FleetAggregator:
         self.workers.pop(name, None)
         self.last_errors.pop(name, None)
 
-    def pull(self) -> Dict[str, dict]:
-        """{worker: export_state()} for every reachable worker."""
+    def _pull_over_rpc(self, msg_type: int, parse, ok_counter: str,
+                       err_counter: str) -> Dict[str, dict]:
+        """Concurrent {worker: parse(payload)} fan-out for one of the
+        centrally-served observability messages (STATS_PULL /
+        TRACE_PULL): k unreachable workers cost ONE connect timeout,
+        not k of them — /metrics with an aggregator attached must stay
+        inside scrape deadlines."""
         from concurrent.futures import ThreadPoolExecutor
         from ..distributed import transport
         client = self._rpc()
@@ -167,25 +189,46 @@ class FleetAggregator:
                         ep, min(1.0, self.connect_timeout)):
                     raise ConnectionError(f"no listener at {ep}")
                 payload = client._raw_request(
-                    ep, transport.STATS_PULL,
-                    connect_timeout=self.connect_timeout)
-                out[worker] = parse_snapshot(payload)
-                sc.counter("pulls").inc()
+                    ep, msg_type, connect_timeout=self.connect_timeout)
+                out[worker] = parse(payload)
+                sc.counter(ok_counter).inc()
             except Exception as e:
-                sc.counter("pull_errors").inc()
+                sc.counter(err_counter).inc()
                 errors[worker] = repr(e)[:200]
 
         items = sorted(self.workers.items())
         if items:
-            # concurrent pulls: k unreachable workers cost ONE connect
-            # timeout, not k of them — /metrics with an aggregator
-            # attached must stay inside scrape deadlines
             with ThreadPoolExecutor(
                     max_workers=min(8, len(items)),
                     thread_name_prefix="fleet-pull") as pool:
                 list(pool.map(one, items))
         self.last_errors = errors
         return out
+
+    def pull(self) -> Dict[str, dict]:
+        """{worker: export_state()} for every reachable worker."""
+        from ..distributed import transport
+        return self._pull_over_rpc(transport.STATS_PULL, parse_snapshot,
+                                   "pulls", "pull_errors")
+
+    def pull_traces(self) -> Dict[str, dict]:
+        """{worker: trace snapshot} over TRACE_PULL for every reachable
+        worker — the fleet half of trace stitching (unreachable workers
+        are skipped and counted like metric pulls)."""
+        from ..distributed import transport
+        return self._pull_over_rpc(transport.TRACE_PULL,
+                                   parse_trace_snapshot,
+                                   "trace_pulls", "trace_pull_errors")
+
+    def stitched_trace(self, include_self: Optional[str] = None) -> dict:
+        """One Chrome/Perfetto JSON stitched from every reachable
+        worker's span ring; ``include_self`` adds THIS process's ring
+        under that label (trainer 0 usually wants its own spans in the
+        picture)."""
+        snaps = self.pull_traces()
+        if include_self:
+            snaps.setdefault(include_self, _trace.local_trace_snapshot())
+        return _trace.stitch_chrome_trace(snaps)
 
     def merged(self) -> dict:
         return merge_snapshots(self.pull())

@@ -128,8 +128,33 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _tracez(self, query: str) -> str:
+        from urllib.parse import parse_qs
+        q = parse_qs(query)
+
+        def _flag(name: str) -> bool:
+            return q.get(name, ["0"])[0] not in ("0", "", "false")
+
+        if _flag("recent"):
+            # the flight-recorder view, served LIVE (what a dump file
+            # would contain right now): recent + in-flight spans, log
+            # events, step-stats tail
+            from . import flight as _flight
+            return json.dumps(_flight.snapshot("tracez"), indent=2,
+                              default=repr)
+        snap = _trace.local_trace_snapshot()
+        if _flag("raw"):
+            # the TRACE_PULL snapshot form — what tools/stitch_trace.py
+            # merges across workers
+            return json.dumps(snap, indent=2)
+        # default: this process's ring as a directly-loadable
+        # Chrome/Perfetto trace (real pid + process/thread names)
+        label = f"{snap['role'].lower()}-{snap['pid']}"
+        return json.dumps(_trace.stitch_chrome_trace({label: snap}))
+
     def do_GET(self):  # noqa: N802 (http.server casing)
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/") or "/"
         sc = _stats.scope("debug_server")
         try:
             if path == "/metrics":
@@ -152,10 +177,14 @@ class _Handler(BaseHTTPRequestHandler):
                 from . import export
                 self._reply(200, json.dumps(export(), indent=2),
                             "application/json")
+            elif path == "/tracez":
+                self._reply(200, self._tracez(query), "application/json")
             elif path == "/":
                 self._reply(200, "\n".join(
                     ["paddle_tpu debug server", "",
-                     "/metrics  /healthz  /statusz  /stepz", ""]),
+                     "/metrics  /healthz  /statusz  /stepz",
+                     "/tracez  (?raw=1 span snapshot, ?recent=1 flight "
+                     "recorder)", ""]),
                     "text/plain")
             else:
                 sc.counter("not_found").inc()

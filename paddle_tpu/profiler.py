@@ -15,18 +15,63 @@ from __future__ import annotations
 import contextlib
 import functools
 import json
+import os
 import threading
 import time
 from collections import defaultdict
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 _state = {"enabled": False, "tracer_dir": None}
 _events: List[dict] = []
 _lock = threading.Lock()
 
+# thread ident → small stable lane id.  ``threading.get_ident() % N``
+# could alias two threads into one Chrome-trace lane (idents are reused
+# addresses); lanes are assigned densely in first-seen order instead and
+# remembered with the thread's name for ``thread_name`` metadata.  The
+# OS recycles idents after a thread exits, so a recycled ident whose
+# CURRENT thread name differs gets a fresh lane (otherwise short-lived
+# workers would inherit a dead thread's lane and its stale label — the
+# exact aliasing class the dense mapping exists to fix); both tables are
+# bounded (telemetry only: a clear just re-derives lanes on next use).
+_lanes: Dict[int, tuple] = {}      # ident -> (lane, thread name)
+_lane_names: Dict[int, str] = {}   # lane -> name
+_next_lane = 0
+_LANE_BOUND = 1024
+
 
 def is_profiler_enabled() -> bool:
     return _state["enabled"]
+
+
+def _thread_lane_locked() -> int:
+    global _next_lane
+    ident = threading.get_ident()
+    name = threading.current_thread().name or ""
+    ent = _lanes.get(ident)
+    if ent is not None and ent[1] == name:
+        return ent[0]
+    if len(_lane_names) > _LANE_BOUND:
+        _lanes.clear()
+        _lane_names.clear()
+    lane = _next_lane
+    _next_lane += 1
+    _lanes[ident] = (lane, name)
+    _lane_names[lane] = name or f"thread-{lane}"
+    return lane
+
+
+def thread_lane() -> int:
+    """This thread's stable lane id (shared with the distributed-trace
+    spans so both streams agree on ``tid``)."""
+    with _lock:
+        return _thread_lane_locked()
+
+
+def lane_names() -> Dict[int, str]:
+    """{lane id: thread name} for ``ph:"M"`` thread_name metadata."""
+    with _lock:
+        return dict(_lane_names)
 
 
 def _emit(name: str, t0_ns: int, t1_ns: int, cat: str = "op") -> None:
@@ -39,7 +84,7 @@ def _emit(name: str, t0_ns: int, t1_ns: int, cat: str = "op") -> None:
             "cat": cat,
             "ts": t0_ns / 1000.0,
             "dur": (t1_ns - t0_ns) / 1000.0,
-            "tid": threading.get_ident() % 100000,
+            "tid": _thread_lane_locked(),
         })
 
 
@@ -154,17 +199,26 @@ def print_summary(sorted_key: str = "total") -> None:
 
 
 def chrome_trace(path: str) -> None:
-    """Write catapult trace-event JSON (tools/timeline.py output format)."""
+    """Write catapult trace-event JSON (tools/timeline.py output format).
+
+    Includes ``ph:"M"`` ``process_name``/``thread_name`` metadata so
+    Perfetto labels the process row and every thread lane instead of
+    showing bare numeric ids."""
+    pid = os.getpid()
     with _lock:
-        trace = {
-            "traceEvents": [
-                {"name": e["name"], "cat": e.get("cat", "op"), "ph": "X",
-                 "pid": 0, "tid": e["tid"], "ts": e["ts"], "dur": e["dur"]}
-                for e in _events
-            ]
-        }
+        events = [
+            {"name": e["name"], "cat": e.get("cat", "op"), "ph": "X",
+             "pid": pid, "tid": e["tid"], "ts": e["ts"], "dur": e["dur"]}
+            for e in _events
+        ]
+        names = dict(_lane_names)
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"paddle_tpu (pid {pid})"}}]
+    for lane in sorted(names):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": lane, "args": {"name": names[lane]}})
     with open(path, "w") as f:
-        json.dump(trace, f)
+        json.dump({"traceEvents": meta + events}, f)
 
 
 def cuda_profiler(*a, **kw):  # parity stub: no CUDA on this backend

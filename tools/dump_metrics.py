@@ -8,11 +8,19 @@ Operator companion to ``paddle_tpu/observability/debug_server.py``
     python tools/dump_metrics.py 8085 metrics stepz
     python tools/dump_metrics.py --host 10.0.0.7 8085 healthz
     python tools/dump_metrics.py --grep rpc_ 8085 metrics
+    python tools/dump_metrics.py 8085 --tracez        # Chrome trace json
+    python tools/dump_metrics.py 8085 --tracez --raw  # span snapshot
+    python tools/dump_metrics.py 8085 --flight        # flight recorder
 
 JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
 through (optionally filtered with ``--grep``) so the output pastes
-straight into a Prometheus exposition parser.  Stdlib only — runs on
-any host that can reach the port, no paddle_tpu import needed.
+straight into a Prometheus exposition parser.  ``--tracez`` fetches the
+worker's span ring as a directly-loadable Chrome/Perfetto trace (add
+``--raw`` for the snapshot form ``tools/stitch_trace.py`` merges);
+``--flight`` fetches the live flight-recorder view
+(``/tracez?recent=1`` — recent + in-flight spans, log events, step
+tail).  Stdlib only — runs on any host that can reach the port, no
+paddle_tpu import needed.
 """
 from __future__ import annotations
 
@@ -51,6 +59,15 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=5.0)
     ap.add_argument("--grep", default="",
                     help="only /metrics lines containing this substring")
+    ap.add_argument("--tracez", action="store_true",
+                    help="fetch the span ring as a Chrome trace "
+                         "(/tracez) instead of the default pages")
+    ap.add_argument("--raw", action="store_true",
+                    help="with --tracez: the snapshot form "
+                         "(/tracez?raw=1) for tools/stitch_trace.py")
+    ap.add_argument("--flight", action="store_true",
+                    help="fetch the live flight-recorder view "
+                         "(/tracez?recent=1)")
     ap.add_argument("port", type=int,
                     help="the worker's FLAGS_debug_server_port")
     ap.add_argument("pages", nargs="*", default=list(DEFAULT_PAGES),
@@ -59,6 +76,22 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     rc = 0
+    if args.tracez or args.flight:
+        pages = []
+        if args.tracez:
+            pages.append("tracez?raw=1" if args.raw else "tracez")
+        if args.flight:
+            pages.append("tracez?recent=1")
+        for page in pages:
+            try:
+                body = fetch(args.host, args.port, page,
+                             timeout=args.timeout)
+            except (urllib.error.URLError, OSError) as e:
+                print(f"error fetching /{page}: {e}", file=sys.stderr)
+                rc = 1
+                continue
+            sys.stdout.write(body if body.endswith("\n") else body + "\n")
+        return rc
     pages = args.pages or list(DEFAULT_PAGES)
     for page in pages:
         header = f"==== {args.host}:{args.port} /{page.strip('/')} ===="

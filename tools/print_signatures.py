@@ -36,6 +36,11 @@ MODULES = [
     "paddle_tpu.observability.debug_server",
     "paddle_tpu.observability.health",
     "paddle_tpu.observability.aggregate",
+    # the distributed-tracing + flight-recorder surface (trace ids,
+    # sampling, span ring, stitching, crash dumps): frozen so wire/API
+    # drift in the trace layer is loud
+    "paddle_tpu.observability.trace",
+    "paddle_tpu.observability.flight",
     "paddle_tpu.lod_tensor",
     "paddle_tpu.transpiler",
     "paddle_tpu.data_feeder",

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Stitch per-worker span snapshots into one Chrome/Perfetto trace.
+
+The fleet half of the distributed-tracing layer
+(``paddle_tpu/observability/trace.py``): every process keeps a bounded
+span ring; this tool merges several rings into ONE multi-process
+timeline where each worker keeps its real ``pid`` and a labeled
+process row — a 2-process trainer+pserver step renders as one stitched
+trace (client ``send_vars`` spans over the pserver's server/apply
+spans, same trace id).
+
+Inputs, mixable:
+
+- snapshot files: the ``TRACE_PULL`` / ``/tracez?raw=1`` JSON form
+  (``{"version":1, "pid":..., "spans":[...]}``), e.g. saved with
+  ``python tools/dump_metrics.py <port> --tracez --raw > worker.json``;
+- chrome-form files (``{"traceEvents": [...]}``, e.g. ``/tracez``
+  output or a flight-recorder-adjacent dump) — passed through with
+  pids preserved (collisions bumped);
+- ``--endpoints host:port,...``: pull live span rings over the
+  ``TRACE_PULL`` RPC from any running worker's RPC port (pserver,
+  master, registry — every service answers it).
+
+Usage:
+    python tools/stitch_trace.py trainer.json pserver.json -o out.json
+    python tools/stitch_trace.py --endpoints 10.0.0.7:6174,10.0.0.8:6174 \\
+        -o out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _repo_import():
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+        __file__)), ".."))
+
+
+def load_inputs(paths):
+    """→ (snapshots {label: snap}, passthrough chrome event lists)."""
+    snaps, chrome = {}, []
+    for path in paths:
+        with open(path) as f:
+            data = json.load(f)
+        label = os.path.splitext(os.path.basename(path))[0]
+        if isinstance(data, dict) and "spans" in data:
+            while label in snaps:
+                label += "'"
+            snaps[label] = data
+        elif isinstance(data, dict) and "traceEvents" in data:
+            chrome.append(data["traceEvents"])
+        elif isinstance(data, list):
+            chrome.append(data)
+        else:
+            raise ValueError(
+                f"{path}: neither a span snapshot ('spans') nor a chrome "
+                "trace ('traceEvents')")
+    return snaps, chrome
+
+
+def pull_endpoints(endpoints, timeout: float = 5.0):
+    """{endpoint: snapshot} over the TRACE_PULL RPC."""
+    _repo_import()
+    from paddle_tpu.distributed import transport
+    from paddle_tpu.observability import aggregate
+
+    client = transport.RPCClient(0)
+    out = {}
+    for ep in endpoints:
+        payload = client._raw_request(ep, transport.TRACE_PULL,
+                                      connect_timeout=timeout)
+        out[ep] = aggregate.parse_trace_snapshot(payload)
+    return out
+
+
+def stitch(snaps, chrome_event_lists):
+    _repo_import()
+    from paddle_tpu.observability import trace as _trace
+
+    doc = _trace.stitch_chrome_trace(snaps)
+    used = {e.get("pid") for e in doc["traceEvents"] if "pid" in e}
+    for evs in chrome_event_lists:
+        own = sorted({e["pid"] for e in evs if "pid" in e})
+        remap = {}
+        for p in own:
+            q = p
+            while q in used:
+                q += 1
+            used.add(q)
+            remap[p] = q
+        for e in evs:
+            e = dict(e)
+            e.setdefault("tid", 0)
+            e["pid"] = remap.get(e.get("pid"), e.get("pid", 0))
+            doc["traceEvents"].append(e)
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-worker span rings into one Chrome trace")
+    ap.add_argument("inputs", nargs="*",
+                    help="snapshot (/tracez?raw=1, TRACE_PULL) or "
+                         "chrome-form json files")
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated worker RPC endpoints to pull "
+                         "span rings from live (TRACE_PULL)")
+    ap.add_argument("-o", "--out", required=True,
+                    help="output Chrome/Perfetto json path")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    if not args.inputs and not args.endpoints:
+        ap.error("need input files and/or --endpoints")
+    snaps, chrome = load_inputs(args.inputs)
+    if args.endpoints:
+        pulled = pull_endpoints(
+            [e for e in args.endpoints.split(",") if e.strip()],
+            timeout=args.timeout)
+        for ep, snap in pulled.items():
+            snaps[ep] = snap
+    doc = stitch(snaps, chrome)
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    n_procs = len({e.get("pid") for e in doc["traceEvents"]})
+    print(f"wrote {args.out}: {n_spans} spans across {n_procs} "
+          f"process(es)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

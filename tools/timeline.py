@@ -17,22 +17,51 @@ import argparse
 import json
 
 
+def _alloc_pid(used, want):
+    pid = want
+    while pid in used:
+        pid += 1
+    used.add(pid)
+    return pid
+
+
 def merge(paths):
+    """Merge trace files into one multi-process timeline.
+
+    Files that already carry ``pid``s — per-process profiler dumps and
+    the stitched multi-process JSON from ``tools/stitch_trace.py`` —
+    keep them (a cross-file collision bumps the later file's pid, same
+    relative layout), so real process identities and their
+    ``process_name`` metadata survive the merge.  Events without a pid
+    (third-party traces, hand markers) are homed per input file, with
+    tid defaulted to 0 (catapult requires both)."""
     events = []
-    for pid, path in enumerate(paths):
+    used = set()
+    for idx, path in enumerate(paths):
         with open(path) as f:
             data = json.load(f)
         evs = data if isinstance(data, list) else data.get("traceEvents", [])
+        own_pids = sorted({e["pid"] for e in evs if "pid" in e})
+        pid_map = {p: _alloc_pid(used, p) for p in own_pids}
+        default_pid = None
+        has_meta = any(e.get("ph") == "M" and e.get("name") == "process_name"
+                       for e in evs)
         for e in evs:
             e = dict(e)
-            # third-party traces (XLA dumps, hand-written markers) may
-            # omit tid/pid; catapult requires both, so default tid to 0
-            # instead of raising (pid is re-homed per input file anyway)
             e.setdefault("tid", 0)
-            e["pid"] = pid
+            if "pid" in e:
+                e["pid"] = pid_map[e["pid"]]
+            else:
+                if default_pid is None:
+                    default_pid = _alloc_pid(used, idx)
+                e["pid"] = default_pid
             events.append(e)
-        events.append({"name": "process_name", "ph": "M", "pid": pid,
-                       "args": {"name": f"profile {path}"}})
+        if not has_meta:
+            for pid in (pid_map.values() if pid_map
+                        else ([default_pid] if default_pid is not None
+                              else [])):
+                events.append({"name": "process_name", "ph": "M", "pid": pid,
+                               "args": {"name": f"profile {path}"}})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

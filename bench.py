@@ -792,6 +792,101 @@ A100_RESNET50_IMG_S = 2500.0
 A100_TRANSFORMER_TOK_S = 50000.0
 
 
+def _compile_cache_child_main():
+    """Grandchild for bench_compile_cache: one fresh process builds the
+    LeNet train program and reports its time-to-first-step (startup →
+    first trained batch readback) plus the persistent-cache counters
+    that explain it.  FLAGS_compile_cache_dir comes in via env."""
+    import os
+    import sys
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid  # noqa: F401
+    from paddle_tpu.core.executor import Executor, Scope
+    from paddle_tpu.models import mnist
+
+    B = 64
+    prog, startup, (feeds, loss, acc) = _fresh(lambda: mnist.build())
+    rng = np.random.RandomState(0)
+    feed = {"pixel": rng.randn(B, 1, 28, 28).astype("float32"),
+            "label": rng.randint(0, 10, (B, 1)).astype("int64")}
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    t0 = time.perf_counter()
+    if os.environ.get("PADDLE_TPU_BENCH_CC_WARMSTART"):
+        # the elastic-rejoin shape: hydrate explicitly, then step
+        exe.warm_start(prog, feed_specs=feed, fetch_list=[loss.name],
+                       scope=scope)
+    (lv,) = exe.run(prog, feed=feed, fetch_list=[loss.name], scope=scope)
+    float(np.asarray(lv))
+    ttfs = time.perf_counter() - t0
+    from paddle_tpu import observability as obs
+    c = obs.stats.default_registry().to_dict()
+    print("CCCHILD=" + json.dumps({
+        "ttfs_s": round(ttfs, 4),
+        "persistent_hits": c.get("executor.persistent_hits", 0),
+        "persistent_misses": c.get("executor.persistent_misses", 0)}),
+        flush=True)
+    sys.stdout.flush()
+
+
+def bench_compile_cache():
+    """Cold-process vs warm-process time-to-first-step for the LeNet
+    train program (CPU backend, no TPU needed): process A compiles with
+    ``FLAGS_compile_cache_dir`` set and serializes its executables;
+    process B — a fresh interpreter, the elastic-restart/bench-respawn
+    shape — hydrates them from disk.  ``baseline`` runs with the cache
+    disabled (the pre-change behavior); cold-vs-baseline bounds the
+    store overhead, cold/warm is the restart win the persistent cache
+    exists for."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def child(cache_dir, warm_start=False):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("FLAGS_compile_cache_dir", None)
+        env.pop("PADDLE_TPU_BENCH_CC_WARMSTART", None)
+        if cache_dir:
+            env["FLAGS_compile_cache_dir"] = cache_dir
+        if warm_start:
+            env["PADDLE_TPU_BENCH_CC_WARMSTART"] = "1"
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--compile-cache-child"],
+            env=env, cwd=here, capture_output=True, text=True, timeout=600)
+        for line in out.stdout.splitlines():
+            if line.startswith("CCCHILD="):
+                return json.loads(line[len("CCCHILD="):])
+        raise RuntimeError(
+            f"compile-cache child failed rc={out.returncode}: "
+            f"{out.stderr[-400:]}")
+
+    with tempfile.TemporaryDirectory(prefix="ptcc_bench_") as d:
+        baseline = child(None)
+        cold = child(d)
+        warm = child(d)
+        warm_api = child(d, warm_start=True)
+
+    assert warm["persistent_hits"] > 0, warm
+    assert cold["persistent_misses"] > 0, cold
+    speedup = cold["ttfs_s"] / max(warm["ttfs_s"], 1e-9)
+    return {
+        "baseline_ttfs_s": baseline["ttfs_s"],
+        "cold_ttfs_s": cold["ttfs_s"],
+        "warm_ttfs_s": warm["ttfs_s"],
+        "warm_api_ttfs_s": warm_api["ttfs_s"],
+        "warm_persistent_hits": warm["persistent_hits"],
+        "cold_vs_warm_speedup": round(speedup, 2),
+    }
+
+
 def bench_scaling():
     """Weak-scaling efficiency on the virtual 8-device CPU mesh (see
     paddle_tpu/parallel/scaling.py — per-device compiled cost, the only
@@ -831,6 +926,7 @@ CONFIG_TABLE = [
     ("stacked_lstm", bench_stacked_lstm, 300, True),
     ("resnet50_datapath", bench_resnet50_datapath, 420, True),
     ("rpc_transport", bench_rpc_transport, 300, False),
+    ("compile_cache", bench_compile_cache, 600, False),
     ("scaling_dp8", bench_scaling, 900, False),
 ]
 
@@ -1184,5 +1280,7 @@ if __name__ == "__main__":
         _probe_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--worker":
         _worker_main(sys.argv[2].split(","))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--compile-cache-child":
+        _compile_cache_child_main()
     else:
         main()

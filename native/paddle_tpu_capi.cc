@@ -89,9 +89,28 @@ PyObject* marshal_inputs(const char* where, const pt_tensor* inputs,
   }
   for (int i = 0; i < n_in; ++i) {
     const pt_tensor& t = inputs[i];
+    if (t.ndim < 0 || t.ndim > 8) {
+      Py_DECREF(ins);
+      g_err = std::string(where) + ": input ndim out of range [0, 8]";
+      return nullptr;
+    }
     PyObject* shape = PyTuple_New(t.ndim);
+    if (shape == nullptr) {
+      Py_DECREF(ins);
+      PyErr_Clear();
+      g_err = std::string(where) + ": input shape alloc";
+      return nullptr;
+    }
     for (int d = 0; d < t.ndim; ++d) {
-      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
+      PyObject* dim = PyLong_FromLongLong(t.shape[d]);
+      if (dim == nullptr) {
+        Py_DECREF(shape);
+        Py_DECREF(ins);
+        PyErr_Clear();
+        g_err = std::string(where) + ": input dim alloc";
+        return nullptr;
+      }
+      PyTuple_SET_ITEM(shape, d, dim);
     }
     PyObject* tup = Py_BuildValue(
         "(ssOy#)", t.name, dtype_name(t.dtype), shape,
@@ -113,6 +132,15 @@ PyObject* marshal_inputs(const char* where, const pt_tensor* inputs,
 // Caller holds the GIL.
 int fill_output(const char* where, PyObject* tup, pt_tensor* o) {
   std::memset(o, 0, sizeof(*o));
+  // a bridge bug (or a user-monkeypatched bridge) must surface as a
+  // -1 + g_err, never as a segfault of the embedding process: validate
+  // the whole (dtype, shape, bytes) tuple shape before touching items
+  if (tup == nullptr || !PyTuple_Check(tup) || PyTuple_Size(tup) < 3) {
+    PyErr_Clear();
+    g_err = std::string(where) +
+            ": output is not a (dtype, shape, bytes) tuple";
+    return -1;
+  }
   const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
   if (dt == nullptr) {
     PyErr_Clear();
@@ -124,6 +152,11 @@ int fill_output(const char* where, PyObject* tup, pt_tensor* o) {
     return -1;
   }
   PyObject* shape = PyTuple_GetItem(tup, 1);
+  if (shape == nullptr || !PyTuple_Check(shape)) {
+    PyErr_Clear();
+    g_err = std::string(where) + ": output shape is not a tuple";
+    return -1;
+  }
   int ndim = static_cast<int>(PyTuple_Size(shape));
   if (ndim > 8) {
     g_err = std::string(where) + ": output rank > 8 unsupported";
@@ -132,6 +165,11 @@ int fill_output(const char* where, PyObject* tup, pt_tensor* o) {
   o->ndim = ndim;
   for (int d = 0; d < ndim; ++d) {
     o->shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+    if (o->shape[d] == -1 && PyErr_Occurred()) {
+      PyErr_Clear();
+      g_err = std::string(where) + ": output shape dim is not an int";
+      return -1;
+    }
   }
   char* buf = nullptr;
   Py_ssize_t len = 0;

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import compile_cache as _compile_cache
 from . import flags as _flags
 from . import host_ops as _host_ops
 from .lowering import analyze_block, build_block_fn
@@ -92,14 +93,26 @@ class _CacheEntry:
     """One compiled-executable cache slot.  ``meta`` memoizes the
     telemetry constants of the executable (program_key string, feed and
     fetch byte totals) so the cached-run record path never re-hashes the
-    big nested cache key or walks array metadata."""
+    big nested cache key or walks array metadata.
 
-    __slots__ = ("plan", "jitted", "meta")
+    Persistent-cache bookkeeping: a dispatch failure of an AOT
+    executable (``from_disk`` set, or ``aot_ms`` not None — avals
+    pinned at build time by disk hydration, inline AOT compile, or
+    warm_start specs) falls back to a fresh lazy jit instead of
+    failing the run (``Executor._recover_disk_entry``);
+    ``fingerprint`` is the disk key; ``aot_ms`` the measured AOT
+    compile cost (0.0 for disk hits — no compile was paid)."""
+
+    __slots__ = ("plan", "jitted", "meta", "from_disk", "fingerprint",
+                 "aot_ms")
 
     def __init__(self, plan, jitted):
         self.plan = plan
         self.jitted = jitted
         self.meta = None
+        self.from_disk = False
+        self.fingerprint = None
+        self.aot_ms = None
 
     def __iter__(self):
         # (plan, jitted) unpacking compatibility for cache introspection
@@ -410,6 +423,10 @@ class Executor:
         _debug_server.maybe_start_from_flags()
         from ..observability import flight as _flight
         _flight.arm_from_flags()
+        # persistent compile cache tier B: point jax's own compilation
+        # cache at FLAGS_compile_cache_dir/xla.  Flag unset (default):
+        # one flag read, nothing else
+        _compile_cache.wire_jax_cache()
 
     # -- public API --------------------------------------------------------
     def run(
@@ -461,25 +478,42 @@ class Executor:
             var = block.var_or_none(n)
             feed_vals.append(self._put_feed(_as_device_array(feed[n], var)))
 
-        sig = tuple((n, v.shape, str(v.dtype)) for n, v in zip(feed_names, feed_vals))
+        sig = self._feed_sig(feed_names, feed_vals)
         base = (id(program), program._version, tuple(fetch_names),
                 self._training)
-        key = (id(program), program._version, sig, tuple(fetch_names),
-               self._training)
+        key = self._mem_key(program, sig, fetch_names)
         entry = self._cache.get(key) if use_program_cache else None
         cache_hit = entry is not None
         lowering_ms = 0.0
         if entry is None:
+            # analysis first: the state-read sets below are the plan's,
+            # and (persistent cache) the state values double as the AOT
+            # lowering's avals
+            t_an0 = time.perf_counter_ns()
+            plan = analyze_block(program, 0, feed_names, fetch_names)
+            lowering_ms = (time.perf_counter_ns() - t_an0) / 1e6
+        else:
+            plan = entry.plan
+
+        donated_state = [self._state_val(scope, block, n) for n in plan.donated_reads]
+        const_state = [self._state_val(scope, block, n) for n in plan.const_reads]
+        rng = scope.find_var(RNG_STATE_VAR)
+        if rng is None:
+            rng = jax.random.PRNGKey(program.random_seed or 0)
+        rng = self._put_rng(rng)
+
+        if entry is None:
             t_low0 = time.perf_counter_ns()
             with _obs_trace.start_span("executor::lower", cat="executor",
                                        root=False):
-                plan = analyze_block(program, 0, feed_names, fetch_names)
-                fn = build_block_fn(program, plan, training=self._training,
-                                    mesh=self._mesh())
-                jitted = jax.jit(fn, donate_argnums=(1,))
+                entry = self._build_entry(
+                    program, plan, sig, tuple(fetch_names), "run",
+                    (feed_vals, donated_state, const_state, rng))
             t_low1 = time.perf_counter_ns()
-            lowering_ms = (t_low1 - t_low0) / 1e6
-            entry = _CacheEntry(plan, jitted)
+            # the AOT compile (entry.aot_ms) reports as compile_ms below;
+            # keep it out of lowering_ms or a cold first step counts it twice
+            lowering_ms += max(
+                0.0, (t_low1 - t_low0) / 1e6 - (entry.aot_ms or 0.0))
             if use_program_cache:
                 self._cache[key] = entry
                 self._evict_cache_overflow()
@@ -491,28 +525,30 @@ class Executor:
             _em().hits.inc()
         plan, jitted = entry.plan, entry.jitted
 
-        donated_state = [self._state_val(scope, block, n) for n in plan.donated_reads]
-        const_state = [self._state_val(scope, block, n) for n in plan.const_reads]
-        rng = scope.find_var(RNG_STATE_VAR)
-        if rng is None:
-            rng = jax.random.PRNGKey(program.random_seed or 0)
-        rng = self._put_rng(rng)
-
         t0 = time.perf_counter() if _flags.get_flags("benchmark") else None
 
         compile_ms = 0.0
         t_disp0 = time.perf_counter_ns() if tel else None
         with _obs_trace.start_span("executor::dispatch", cat="executor",
                                    root=False):
-            fetches, new_state, rng_out = jitted(feed_vals, donated_state,
-                                                 const_state, rng)
+            try:
+                fetches, new_state, rng_out = jitted(feed_vals, donated_state,
+                                                     const_state, rng)
+            except Exception as e:
+                jitted = self._recover_disk_entry(entry, program, e,
+                                                  donated_state)
+                fetches, new_state, rng_out = jitted(feed_vals, donated_state,
+                                                     const_state, rng)
         if tel:
             t_disp1 = time.perf_counter_ns()
             if not cache_hit:
                 # first call of a fresh executable: the synchronous part
                 # is jax trace + XLA compile (execution is async), so this
-                # wall time is the compile cost to within dispatch noise
-                compile_ms = (t_disp1 - t_disp0) / 1e6
+                # wall time is the compile cost to within dispatch noise.
+                # AOT-compiled entries (persistent cache) measured their
+                # compile in the lower phase instead; disk hits paid none.
+                compile_ms = (entry.aot_ms if entry.aot_ms is not None
+                              else (t_disp1 - t_disp0) / 1e6)
             if _obs_trace.enabled():
                 _obs_trace.emit("executor::dispatch", t_disp0, t_disp1)
 
@@ -631,22 +667,99 @@ class Executor:
             steps = [_as_device_array(a, var) for a in arr]
             stacked.append(jax.device_put(np.stack(steps)))
 
-        sig = tuple((n, v.shape, str(v.dtype))
-                    for n, v in zip(feed_names, stacked))
+        sig = self._feed_sig(feed_names, stacked)
         base = (id(program), program._version, tuple(fetch_names),
                 "run_steps", self._training)
-        key = (id(program), program._version, sig, tuple(fetch_names),
-               "run_steps", self._training)
+        key = self._mem_key(program, sig, fetch_names, mode="run_steps")
         entry = self._cache.get(key)
         cache_hit = entry is not None
         lowering_ms = 0.0
-        t_low0 = time.perf_counter_ns() if tel else None
         if entry is None:
+            # analysis timed apart from the state gathering below: the
+            # H2D transfer of params must not inflate lowering_ms
+            t_an0 = time.perf_counter_ns()
             plan = analyze_block(program, 0, feed_names, fetch_names)
+            lowering_ms = (time.perf_counter_ns() - t_an0) / 1e6
+        else:
+            plan = entry.plan
+
+        donated_state = [self._state_val(scope, block, n)
+                         for n in plan.donated_reads]
+        const_state = [self._state_val(scope, block, n)
+                       for n in plan.const_reads]
+        rng = scope.find_var(RNG_STATE_VAR)
+        if rng is None:
+            rng = jax.random.PRNGKey(program.random_seed or 0)
+        rng = self._put_rng(rng)
+
+        if entry is None:
+            t_low0 = time.perf_counter_ns()
+            build = self._make_scan_builder(program, plan)
+            entry = self._build_entry(
+                program, plan, sig, tuple(fetch_names), "run_steps",
+                (stacked, donated_state, const_state, rng), build_fn=build)
+            self._cache[key] = entry
+            self._evict_cache_overflow()
+            if tel:
+                t_low1 = time.perf_counter_ns()
+                # AOT compile time reports as compile_ms, not lowering
+                lowering_ms += max(
+                    0.0, (t_low1 - t_low0) / 1e6 - (entry.aot_ms or 0.0))
+                self._note_cache_miss(base, sig)
+                if _obs_trace.enabled():
+                    _obs_trace.emit("executor::lower", t_low0, t_low1)
+        elif tel:
+            _em().hits.inc()
+        plan, jitted = entry.plan, entry.jitted
+
+        compile_ms = 0.0
+        t_disp0 = time.perf_counter_ns() if tel else None
+        # run_steps admits no host ops, so the K-step dispatch IS the
+        # step: one root span (head-sampled like run()'s)
+        with _obs_trace.start_span("executor::step", cat="executor",
+                                   tags={"k_steps": K}):
+            try:
+                fetches, new_state, rng_out = jitted(stacked, donated_state,
+                                                     const_state, rng)
+            except Exception as e:
+                jitted = self._recover_disk_entry(
+                    entry, program, e, donated_state,
+                    build_fn=self._make_scan_builder(program, entry.plan))
+                fetches, new_state, rng_out = jitted(stacked, donated_state,
+                                                     const_state, rng)
+        if tel:
+            t_disp1 = time.perf_counter_ns()
+            if not cache_hit:
+                compile_ms = (entry.aot_ms if entry.aot_ms is not None
+                              else (t_disp1 - t_disp0) / 1e6)
+            if _obs_trace.enabled():
+                _obs_trace.emit("executor::dispatch", t_disp0, t_disp1)
+        for name, val in zip(plan.persist_writes, new_state):
+            self._note_state_write(name)
+            scope.set_var(name, val)
+        if plan.has_stateful:
+            scope.set_var(RNG_STATE_VAR, rng_out)
+        if return_numpy:
+            out = [np.asarray(v) for v in fetches]
+        else:
+            out = list(fetches)
+        if tel:
+            self._record_step(entry, key, cache_hit, lowering_ms,
+                              compile_ms, stacked, fetches, t_run0, plan,
+                              donated_state)
+        return out
+
+    def _fetch_to_numpy(self, v):
+        return np.asarray(v)
+
+    # -- persistent compile cache (core/compile_cache.py) ------------------
+    def _make_scan_builder(self, program: Program, plan):
+        """Builder for run_steps' K-step ``lax.scan`` wrapper (the
+        executable the cache stores for mode="run_steps")."""
+        def build():
             fn = build_block_fn(program, plan, training=self._training,
                                 mesh=self._mesh())
             refeed = plan.donated_write_indices
-
             n_writes = len(plan.persist_writes)
             extra_idx = [i for i in range(n_writes)
                          if i not in set(refeed)]
@@ -681,60 +794,288 @@ class Executor:
                     final_state[i] = extra[slot]
                 return fetches, final_state, rng
 
-            jitted = jax.jit(multi, donate_argnums=(1,))
-            entry = _CacheEntry(plan, jitted)
-            self._cache[key] = entry
-            self._evict_cache_overflow()
-            if tel:
-                t_low1 = time.perf_counter_ns()
-                lowering_ms = (t_low1 - t_low0) / 1e6
-                self._note_cache_miss(base, sig)
-                if _obs_trace.enabled():
-                    _obs_trace.emit("executor::lower", t_low0, t_low1)
-        elif tel:
-            _em().hits.inc()
-        plan, jitted = entry.plan, entry.jitted
+            return multi
+        return build
 
-        donated_state = [self._state_val(scope, block, n)
-                         for n in plan.donated_reads]
-        const_state = [self._state_val(scope, block, n)
-                       for n in plan.const_reads]
+    @staticmethod
+    def _feed_sig(feed_names, vals) -> tuple:
+        """Feed-signature component of the executable cache key; ``vals``
+        are device arrays or ShapeDtypeStructs (warm_start) — both carry
+        the shape/dtype the compiled executable is pinned to."""
+        return tuple((n, tuple(v.shape), str(v.dtype))
+                     for n, v in zip(feed_names, vals))
+
+    def _mem_key(self, program: Program, sig, fetch_names,
+                 mode: str = "run") -> tuple:
+        """THE in-memory executable-cache key.  warm_start precompiles
+        install entries under this same key, so every component lives
+        here — run()/run_steps()/_warm_one must never reassemble it by
+        hand (a drifted copy silently defeats warm starts)."""
+        if mode == "run":
+            return (id(program), program._version, sig,
+                    tuple(fetch_names), self._training)
+        return (id(program), program._version, sig, tuple(fetch_names),
+                mode, self._training)
+
+    def _build_entry(self, program: Program, plan, sig, fetch_names: tuple,
+                     mode: str, args, build_fn=None,
+                     force_aot: bool = False,
+                     hydrate_only: bool = False) -> _CacheEntry:
+        """Resolve the executable for a fresh cache slot.
+
+        Persistent cache enabled: disk load (tier A hit — no trace, no
+        compile) → AOT ``lower(...).compile()`` + serialize to disk.
+        Disabled (default): lazy ``jax.jit``, byte-for-byte the
+        pre-cache behavior, unless ``force_aot`` (warm_start) asks for
+        an eager compile anyway.  ``hydrate_only`` returns None on a
+        disk miss instead of compiling (a restarting worker that wants
+        the restart win but must not block its startup on cold-cache
+        compiles).  ``args`` are the concrete call args or
+        ShapeDtypeStructs — the AOT lowering's avals; any aval guessed
+        wrong is recovered at dispatch (``_recover_disk_entry``).
+        """
+        make = build_fn or (lambda: build_block_fn(
+            program, plan, training=self._training, mesh=self._mesh()))
+        if _compile_cache.enabled():
+            fp = _compile_cache.fingerprint(program, sig, fetch_names,
+                                            self._training, mode,
+                                            self._mesh())
+            compiled = _compile_cache.load(fp, count_miss=not hydrate_only)
+            if compiled is not None:
+                entry = _CacheEntry(plan, compiled)
+                entry.from_disk = True
+                entry.fingerprint = fp
+                entry.aot_ms = 0.0
+                return entry
+            if hydrate_only:
+                return None
+            jitted = jax.jit(make(), donate_argnums=(1,))
+            t0 = time.perf_counter_ns()
+            compiled = jitted.lower(*args).compile()
+            aot_ms = (time.perf_counter_ns() - t0) / 1e6
+            _compile_cache.store(fp, compiled,
+                                 meta={"mode": mode,
+                                       "fetches": list(fetch_names)})
+            entry = _CacheEntry(plan, compiled)
+            entry.fingerprint = fp
+            entry.aot_ms = aot_ms
+            return entry
+        if hydrate_only:
+            return None
+        jitted = jax.jit(make(), donate_argnums=(1,))
+        if force_aot:
+            t0 = time.perf_counter_ns()
+            jitted = jitted.lower(*args).compile()
+            entry = _CacheEntry(plan, jitted)
+            entry.aot_ms = (time.perf_counter_ns() - t0) / 1e6
+            return entry
+        return _CacheEntry(plan, jitted)
+
+    def _recover_disk_entry(self, entry: _CacheEntry, program: Program,
+                            exc, donated_state, build_fn=None):
+        """An AOT executable whose dispatch fails is replaced in-place
+        by a fresh lazy jit and the call retried: disk-hydrated entries
+        and warm_start precompiles can mismatch the live scope
+        (fingerprint blind spot, stale device assignment, wrong spec),
+        and even a long-validated AOT ``Compiled`` is pinned to state
+        avals the lazy jit would simply have retraced for (a user
+        resizing a persistable var in the scope).  The fault is
+        counted, the entry file evicted (stale for this key either
+        way), and the run proceeds as a plain compile.
+
+        Failures of lazy-jit entries — which already retrace per call —
+        re-raise untouched, as does a fault AFTER execution started
+        (donated buffers already consumed: a retry would read deleted
+        arrays; aval/sharding mismatches raise before any donation)."""
+        if entry.aot_ms is None and not entry.from_disk:
+            raise exc
+        if any(isinstance(v, jax.Array) and v.is_deleted()
+               for v in donated_state):
+            raise exc
+        if entry.fingerprint is not None:
+            # a cache-keyed executable (disk-hydrated or stored): count
+            # the fault against the cache and evict the stale entry.
+            # warm_start force-AOT entries with the cache OFF recompile
+            # silently — there is no cache to blame
+            _compile_cache.dispatch_fault(entry.fingerprint, exc)
+        make = build_fn or (lambda: build_block_fn(
+            program, entry.plan, training=self._training,
+            mesh=self._mesh()))
+        jitted = jax.jit(make(), donate_argnums=(1,))
+        entry.jitted = jitted
+        entry.from_disk = False
+        entry.aot_ms = None
+        return jitted
+
+    def warm_start(self, program: Optional[Program] = None,
+                   feed_specs: Optional[Dict[str, object]] = None,
+                   fetch_list: Optional[Sequence] = None,
+                   scope: Optional[Scope] = None,
+                   hydrate_only: bool = False) -> dict:
+        """AOT-precompile ``(program, feed_specs, fetch_list)`` and
+        hydrate this executor's executable cache *before the first
+        batch* — from the persistent disk cache when
+        ``FLAGS_compile_cache_dir`` is set (an elastic-restarted worker
+        skips the whole compile), else by compiling now (and, with the
+        cache enabled, storing for the next process).
+
+        ``feed_specs`` maps feed names to shape tuples, ``(shape,
+        dtype)`` pairs (shape itself a tuple/list), numpy/jax arrays,
+        or ``jax.ShapeDtypeStruct``s — only shape/dtype are read, no
+        feed data is needed.  Shapes must be concrete.  Names are the
+        post-expansion feed names (a LoD feed contributes its padded
+        array plus the ``<name>@LEN`` length vector).  The scope must
+        already hold the program's persistable state (run the startup
+        program / restore the checkpoint first): state shapes are part
+        of the executable.
+
+        Programs containing host ops (the transpiled trainer program)
+        warm every device segment whose inputs are covered by
+        ``feed_specs`` + scope; segments fed by an earlier host op's
+        runtime output are skipped (reported in ``skipped``).
+
+        ``hydrate_only=True`` takes disk hits but never compiles on a
+        miss — for restart paths that want the warm-cache win without
+        blocking startup on cold-cache compiles (the pserver hydrates
+        before binding its port; a cold cache keeps the old lazy
+        compile-at-first-round behavior).
+
+        Returns {"segments", "warmed", "persistent_hits", "compiled",
+        "skipped": [...], "ms"}.
+        """
+        program = program if program is not None else default_main_program()
+        scope = scope or global_scope()
+        feed_specs = dict(feed_specs or {})
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in (fetch_list or [])]
+        t0 = time.perf_counter()
+        _compile_cache.wire_jax_cache()
+        program = self._prepare_program(program, feed_specs)
+        out = {"segments": 0, "warmed": 0, "persistent_hits": 0,
+               "compiled": 0, "skipped": [], "ms": 0.0}
+
+        if any(_host_ops.is_host_op(op.type)
+               for op in program.global_block.ops):
+            segs = self._segment_plan(program, tuple(sorted(feed_specs)),
+                                      tuple(fetch_names))
+            for i, seg in enumerate(segs):
+                if seg[0] != "device":
+                    continue
+                _, sub, seg_fetches, reads = seg
+                sub_specs = {n: v for n, v in feed_specs.items()
+                             if n in reads}
+                self._warm_one(sub, sub_specs, seg_fetches, scope, out,
+                               label=f"segment[{i}]",
+                               hydrate_only=hydrate_only)
+        else:
+            self._warm_one(program, feed_specs, fetch_names, scope, out,
+                           label="program", hydrate_only=hydrate_only)
+        out["ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        return out
+
+    def _warm_one(self, program: Program, feed_specs: Dict, fetch_names,
+                  scope: Scope, out: dict, label: str,
+                  hydrate_only: bool = False) -> None:
+        out["segments"] += 1
+        feed_names = sorted(feed_specs)
+        block = program.global_block
+        feed_avals = [self._spec_aval(feed_specs[n], block.var_or_none(n))
+                      for n in feed_names]
+        sig = self._feed_sig(feed_names, feed_avals)
+        key = self._mem_key(program, sig, fetch_names)
+        if key in self._cache:
+            out["warmed"] += 1
+            return
+        plan = analyze_block(program, 0, feed_names, fetch_names)
+        try:
+            donated_state = [self._warm_state_aval(scope, block, n)
+                             for n in plan.donated_reads]
+            const_state = [self._warm_state_aval(scope, block, n)
+                           for n in plan.const_reads]
+        except RuntimeError as e:
+            # state produced at runtime by an earlier host op with no
+            # static declaration (and the scope doesn't hold it yet):
+            # nothing to precompile
+            out["skipped"].append(f"{label}: {e}")
+            return
         rng = scope.find_var(RNG_STATE_VAR)
         if rng is None:
             rng = jax.random.PRNGKey(program.random_seed or 0)
         rng = self._put_rng(rng)
-
-        compile_ms = 0.0
-        t_disp0 = time.perf_counter_ns() if tel else None
-        # run_steps admits no host ops, so the K-step dispatch IS the
-        # step: one root span (head-sampled like run()'s)
-        with _obs_trace.start_span("executor::step", cat="executor",
-                                   tags={"k_steps": K}):
-            fetches, new_state, rng_out = jitted(stacked, donated_state,
-                                                 const_state, rng)
-        if tel:
-            t_disp1 = time.perf_counter_ns()
-            if not cache_hit:
-                compile_ms = (t_disp1 - t_disp0) / 1e6
-            if _obs_trace.enabled():
-                _obs_trace.emit("executor::dispatch", t_disp0, t_disp1)
-        for name, val in zip(plan.persist_writes, new_state):
-            self._note_state_write(name)
-            scope.set_var(name, val)
-        if plan.has_stateful:
-            scope.set_var(RNG_STATE_VAR, rng_out)
-        if return_numpy:
-            out = [np.asarray(v) for v in fetches]
+        entry = self._build_entry(
+            program, plan, sig, tuple(fetch_names), "run",
+            (feed_avals, donated_state, const_state, rng), force_aot=True,
+            hydrate_only=hydrate_only)
+        if entry is None:  # hydrate_only + disk miss: leave it lazy
+            out["skipped"].append(f"{label}: persistent-cache miss "
+                                  "(hydrate_only)")
+            return
+        self._cache[key] = entry
+        self._evict_cache_overflow()
+        out["warmed"] += 1
+        if entry.from_disk:
+            out["persistent_hits"] += 1
         else:
-            out = list(fetches)
-        if tel:
-            self._record_step(entry, key, cache_hit, lowering_ms,
-                              compile_ms, stacked, fetches, t_run0, plan,
-                              donated_state)
-        return out
+            out["compiled"] += 1
 
-    def _fetch_to_numpy(self, v):
-        return np.asarray(v)
+    def _warm_state_aval(self, scope: Scope, block, name: str):
+        """State input for a warm_start lowering: the live scope value
+        when present (exact avals), else an abstract aval from the
+        program's static var declaration (a pserver's grad inputs exist
+        only at runtime but are fully declared).  Raises RuntimeError
+        when neither is available."""
+        if scope.find_var(name) is not None:
+            return self._state_val(scope, block, name)
+        var = block.var_or_none(name)
+        from .types import VarType
+        if var is None or var.shape is None or var.dtype is None or \
+                any(s < 0 for s in var.shape) or \
+                var.type != VarType.DENSE_TENSOR:
+            raise RuntimeError(
+                f"variable {name!r} is neither in the scope nor "
+                f"statically declared (shape/dtype) in the program")
+        return jax.ShapeDtypeStruct(
+            tuple(int(s) for s in var.shape),
+            jax.dtypes.canonicalize_dtype(np_dtype(var.dtype)))
+
+    @staticmethod
+    def _spec_aval(spec, var: Optional[Variable]) -> "jax.ShapeDtypeStruct":
+        """Normalize one warm_start feed spec to the aval the real run
+        will produce: the executor casts host arrays to the program
+        var's dtype (``_as_device_array``), so a declared var dtype
+        wins over a host spec's — but a ``jax.Array`` spec is fed
+        through UNCAST by the real path, so its dtype stands."""
+        dtype = None
+        if isinstance(spec, jax.Array):
+            return jax.ShapeDtypeStruct(tuple(spec.shape),
+                                        np.dtype(spec.dtype))
+        if isinstance(spec, jax.ShapeDtypeStruct):
+            shape, dtype = tuple(spec.shape), np.dtype(spec.dtype)
+        elif hasattr(spec, "shape") and hasattr(spec, "dtype"):
+            shape, dtype = tuple(spec.shape), np.dtype(spec.dtype)
+        elif isinstance(spec, (tuple, list)) and len(spec) == 2 and \
+                isinstance(spec[0], (tuple, list)):
+            shape, dtype = tuple(spec[0]), np.dtype(spec[1])
+        elif isinstance(spec, (tuple, list)):
+            shape = tuple(spec)
+        else:
+            raise TypeError(
+                f"warm_start feed spec must be a shape tuple, "
+                f"(shape, dtype) pair, array, or ShapeDtypeStruct; "
+                f"got {spec!r}")
+        shape = tuple(int(s) for s in shape)
+        if any(s < 0 for s in shape):
+            raise ValueError(
+                f"warm_start feed shape {shape} has a dynamic (-1) dim; "
+                "precompilation needs concrete shapes")
+        if var is not None and var.dtype is not None:
+            dtype = np.dtype(np_dtype(var.dtype))
+        elif dtype is None:
+            dtype = np.dtype("float32")
+        # the device array the real run feeds is jnp.asarray's view of
+        # the cast value: canonicalized (x64 off ⇒ int64→int32 etc.)
+        return jax.ShapeDtypeStruct(shape,
+                                    jax.dtypes.canonicalize_dtype(dtype))
 
     # -- host-op segmented execution ---------------------------------------
     # Blocks containing host ops (core/host_ops.py: RPC, pserver loop, IO)

@@ -176,6 +176,22 @@ define_flag("flight_record_dir", "",
             "write a JSON post-mortem (recent + in-flight spans, log "
             "events, step-stats tail) there.  Empty (default) disarms "
             "the recorder — no hooks installed")
+define_flag("compile_cache_dir", "",
+            "directory for the persistent cross-process compilation "
+            "cache (core/compile_cache.py): AOT-compiled executables "
+            "are serialized into content-addressed entry files keyed "
+            "by a canonical program fingerprint (tier A), and "
+            "jax_compilation_cache_dir is pointed at <dir>/xla for "
+            "XLA-level reuse of anything tier A cannot serialize "
+            "(tier B).  A warm process hydrates its executable cache "
+            "from disk instead of recompiling (elastic restarts, "
+            "bench worker respawns).  Empty (default) disables the "
+            "cache entirely — no disk I/O, no new threads")
+define_flag("compile_cache_max_bytes", 2 << 30,
+            "LRU size cap for the persistent compile-cache directory: "
+            "after each store, oldest-used entry files (mtime, touched "
+            "on every hit) are pruned until the tier-A entries fit; "
+            "counted in compile_cache.evictions.  0 = unbounded")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

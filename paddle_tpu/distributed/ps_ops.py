@@ -291,6 +291,38 @@ class PServerLoop:
             with np.load(self._ckpt_path()) as data:
                 for n in data.files:
                     self.scope.set_var(n, data[n])
+        self._warm_start()
+
+    def _warm_start(self) -> None:
+        """Elastic-restart hydration (FLAGS_compile_cache_dir): load
+        the LR + optimize block executables from the persistent compile
+        cache — stored by a previous incarnation of this shard — so a
+        restarted pserver's first round costs a deserialize, not an XLA
+        compile.  ``hydrate_only``: a COLD cache must not block the
+        port bind / heartbeat registration behind serial compiles
+        (trainer wait_server_ready probes would time out), so disk
+        misses keep the old lazy compile-at-first-round behavior (which
+        also stores the entries this hydration reads next restart).
+        Grad inputs that exist only at runtime lower from their static
+        var declarations; a wrong guess degrades to a counted recompile
+        on first dispatch, never a failed round."""
+        from ..core import compile_cache as _compile_cache
+        if not _compile_cache.enabled():
+            return
+        try:
+            warmed = {"persistent_hits": 0, "skipped": 0}
+            progs = [(self.lr_prog, self.lr_fetch)] if self.lr_prog else []
+            progs += [(p, []) for _, p in sorted(self.block_progs.items())]
+            for prog, fetches in progs:
+                res = self.exe.warm_start(prog, feed_specs={},
+                                          fetch_list=fetches,
+                                          scope=self.scope,
+                                          hydrate_only=True)
+                warmed["persistent_hits"] += res["persistent_hits"]
+                warmed["skipped"] += len(res["skipped"])
+            _flight.note("pserver_warm_start", **warmed)
+        except Exception as e:  # warm start is an optimization, never fatal
+            _flight.note("pserver_warm_start_failed", error=repr(e)[:200])
 
     # -- self-profiling (reference FLAGS_rpc_server_profile_period,
     # python/paddle/fluid/__init__.py:121 + rpc_server.cc profiling):

@@ -1033,8 +1033,11 @@ class RPCClient:
             # (and the flight recorder should remember it post-mortem)
             print(f"[rpc-failover] {endpoint} msg={msg_type}: "
                   f"{phys} -> {new_phys}", file=_sys.stderr, flush=True)
+            # field must not be named "msg" — that is note()'s own first
+            # parameter (passing it kwargs-style raised TypeError and
+            # killed the failover instead of retrying)
             _flight.note("rpc_failover", endpoint=endpoint,
-                         msg=MSG_NAMES.get(msg_type, str(msg_type)),
+                         msg_type=MSG_NAMES.get(msg_type, str(msg_type)),
                          old=phys, new=new_phys)
             if new_phys == phys and msg_type not in self._RETRYABLE:
                 # same address answering the probe: could be the SAME live

@@ -112,7 +112,8 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
 
 
 def switch_moe(input, num_experts, d_ffn, capacity_factor=1.25,
-              capacity_per_expert=None, name_prefix=None):
+              capacity_per_expert=None, name_prefix=None,
+              return_aux=False):
     """Switch-style top-1 mixture-of-experts FFN with expert parallelism
     (no reference analogue — the TPU-native §7 extension; GShard-pattern
     dispatch/combine einsums expressed as one-hot matmuls so GSPMD turns
@@ -129,6 +130,19 @@ def switch_moe(input, num_experts, d_ffn, capacity_factor=1.25,
     stacked MoE layers never share weights; pass an explicit prefix to
     share weights across programs (train/infer) — and the SAME prefix to
     :func:`moe_sharding_rules`.
+
+    ``return_aux=True`` returns ``(output, aux_loss, dropped_frac)``:
+
+    - ``aux_loss`` [scalar] — the standard Switch load-balancing loss,
+      ``E * sum_e(f_e * P_e)`` with ``f_e`` the fraction of tokens
+      routed to expert ``e`` (pre-capacity argmax routing) and ``P_e``
+      the mean gate probability of ``e``.  Uniform routing gives 1.0;
+      add a small multiple (Switch uses 0.01) to the training loss to
+      regularize against router collapse.
+    - ``dropped_frac`` [scalar] — the fraction of tokens dropped by the
+      capacity limit this batch (overflow tokens pass through as
+      zeros); a rising value means the router is hot-spotting or
+      ``capacity_factor`` is too small.
     """
     from .core import unique_name
 
@@ -154,6 +168,20 @@ def switch_moe(input, num_experts, d_ffn, capacity_factor=1.25,
         layers.unsqueeze(expert_idx, [1]), E)               # [N, E] f32
     gate = layers.reduce_sum(layers.elementwise_mul(gate_probs, mask),
                              dim=-1, keep_dim=True)         # [N, 1]
+
+    if return_aux:
+        # Switch load-balancing loss over the PRE-capacity routing
+        # decisions (capacity drops are what the loss prevents, they
+        # must not hide from it): E * <f_e, P_e>
+        frac_routed = layers.reduce_mean(mask, dim=0)       # [E]
+        mean_prob = layers.reduce_mean(gate_probs, dim=0)   # [E]
+        aux_loss = layers.scale(
+            layers.reduce_sum(
+                layers.elementwise_mul(frac_routed, mean_prob)),
+            scale=float(E))                                 # scalar
+        # token count as a tensor (the batch dim may be dynamic; the
+        # pre-capacity mask has exactly one 1 per token)
+        total_tokens = layers.reduce_sum(mask)              # scalar
 
     # position of each token within its expert; tokens past capacity drop
     pos = layers.elementwise_mul(
@@ -191,7 +219,15 @@ def switch_moe(input, num_experts, d_ffn, capacity_factor=1.25,
     # combine [N, D] = dispatch @ expert_out, scaled by the gate prob
     out = layers.matmul(disp_flat,
                         layers.reshape(expert_out, [E * C, D]))
-    return layers.elementwise_mul(out, gate)
+    out = layers.elementwise_mul(out, gate)
+    if not return_aux:
+        return out
+    # dropped-token fraction: tokens whose dispatch row zeroed out at
+    # the capacity cut (post-capacity mask sums to kept tokens)
+    kept = layers.reduce_sum(mask)                          # scalar
+    dropped_frac = layers.scale(
+        layers.elementwise_div(kept, total_tokens), scale=-1.0, bias=1.0)
+    return out, aux_loss, dropped_frac
 
 
 def moe_sharding_rules(name_prefix="moe"):

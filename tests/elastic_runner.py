@@ -48,6 +48,14 @@ def main():
 
     tp = t.get_trainer_program()
     exe.run(startup, scope=scope)
+    from paddle_tpu.core import compile_cache
+    if compile_cache.enabled():
+        # elastic rejoin: hydrate the device-segment executables from
+        # the persistent compile cache before the first batch — a
+        # respawned trainer skips the XLA recompile
+        bx, by = batches(1)[0]
+        exe.warm_start(tp, feed_specs={"x": bx, "y": by},
+                       fetch_list=[loss], scope=scope)
     n_steps = int(os.environ.get("DIST_STEPS", "30"))
     progress_path = os.environ["ELASTIC_PROGRESS"]
     losses = []

@@ -5,6 +5,7 @@ parallel_executor_test_base.py — multi-device loss trajectories must match
 single-device, under both reduce strategies.
 """
 import numpy as np
+import pytest
 
 import jax
 import paddle_tpu as fluid
@@ -351,3 +352,75 @@ def test_switch_moe_expert_parallel_matches_single_device():
             assert v is not None, pname
             assert v.sharding.spec[0] == "ep", (pname, v.sharding)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-5)
+
+
+def test_switch_moe_aux_loss_and_dropped_fraction():
+    """The router-collapse instruments (ADVICE r5): aux_loss is the
+    Switch load-balancing loss (E * <fraction-routed, mean-gate-prob>;
+    exactly 1.0 for a perfectly uniform router, >= 1.0 with equality
+    only at uniform), dropped_frac counts capacity overflow.  Also
+    regularizing ON aux_loss must be differentiable end-to-end."""
+    from paddle_tpu import nets
+
+    N, D, E, F = 16, 8, 4, 16
+    rng = np.random.RandomState(0)
+    xv = rng.randn(N, D).astype("float32")
+
+    # capacity >= N/E: nothing drops; random-init router: aux near 1
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [D])
+        out, aux, dropped = nets.switch_moe(
+            x, E, F, capacity_per_expert=16, name_prefix="aux_moe",
+            return_aux=True)
+    scope, exe = Scope(), Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        o, a, dr = exe.run(prog, feed={"x": xv},
+                           fetch_list=[out, aux, dropped], sync=True)
+    assert np.asarray(o).shape == (N, D)
+    assert float(a) >= 1.0 - 1e-5          # lower bound at uniform
+    assert float(a) <= float(E)            # upper bound at full collapse
+    assert float(dr) == pytest.approx(0.0, abs=1e-6)
+
+    # capacity 1 (< N/E): most tokens drop, and the fraction is exact
+    prog2, startup2 = Program(), Program()
+    with program_guard(prog2, startup2), unique_name.guard():
+        x2 = fluid.layers.data("x", [D])
+        out2, aux2, drop2 = nets.switch_moe(
+            x2, E, F, capacity_per_expert=1, name_prefix="aux_moe2",
+            return_aux=True)
+    scope2 = Scope()
+    with scope_guard(scope2):
+        exe.run(startup2)
+        o2, _, d2 = exe.run(prog2, feed={"x": xv}, sync=True,
+                            fetch_list=[out2, aux2, drop2])
+    kept_rows = int((np.abs(np.asarray(o2)).sum(axis=1) > 0).sum())
+    assert float(d2) == pytest.approx(1.0 - kept_rows / N, abs=1e-6)
+    assert float(d2) >= (N - E) / N - 1e-6  # at most E tokens kept
+
+    # training against loss + 0.01*aux_loss drives aux down (the
+    # regularization path has gradients through the router)
+    prog3, startup3 = Program(), Program()
+    prog3.random_seed = 1
+    with program_guard(prog3, startup3), unique_name.guard():
+        x3 = fluid.layers.data("x", [D])
+        y3 = fluid.layers.data("y", [D])
+        out3, aux3, _ = nets.switch_moe(
+            x3, E, F, capacity_per_expert=16, name_prefix="aux_moe3",
+            return_aux=True)
+        task = fluid.layers.mean(fluid.layers.square_error_cost(out3, y3))
+        total = fluid.layers.elementwise_add(
+            task, fluid.layers.scale(aux3, scale=0.01))
+        fluid.optimizer.Adam(5e-3).minimize(total)
+    scope3 = Scope()
+    aux_vals = []
+    with scope_guard(scope3):
+        exe.run(startup3)
+        for _ in range(30):
+            xb = rng.randn(N, D).astype("float32")
+            _, av = exe.run(prog3, feed={"x": xb, "y": np.tanh(xb)},
+                            fetch_list=[total, aux3], sync=True)
+            aux_vals.append(float(np.asarray(av)))
+    assert np.isfinite(aux_vals).all()
+    assert min(aux_vals) < float(E)  # the aux path trained, not NaN'd

@@ -62,6 +62,11 @@ MODULES = [
     "paddle_tpu.contrib.trainer",
     "paddle_tpu.contrib.inferencer",
     "paddle_tpu.contrib.decoder",
+    # the persistent compile-cache surface (entry format, fingerprint,
+    # store/load/prune) + its operator CLI: frozen so on-disk format /
+    # admin-tooling drift is loud
+    "paddle_tpu.core.compile_cache",
+    "cache_admin",  # tools/cache_admin.py (tools/ is on sys.path here)
 ]
 
 

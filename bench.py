@@ -910,6 +910,8 @@ def bench_scaling():
         if line.startswith("SCALING="):
             rep = json.loads(line[len("SCALING="):])
             assert rep["eff_flops"] >= 0.85, rep
+            # analysis-only tagging happens centrally in main() via
+            # ANALYSIS_CONFIGS (one policy point, covers error records)
             return rep
     raise RuntimeError(f"scaling child failed: {out.stderr[-500:]}")
 
@@ -1105,12 +1107,22 @@ def _run_streaming(cmd, handle_line, deadline_for, kill_grace=5.0):
     return p.returncode, timed_out
 
 
+_PROBE_COUNT = 0
+
+
 def _probe(budget_deadline):
     import os
     import sys
+    global _PROBE_COUNT
 
-    probe_timeout = float(os.environ.get(
-        "PADDLE_TPU_BENCH_PROBE_TIMEOUT_S", "240"))
+    # PADDLE_TPU_BENCH_PROBE_TIMEOUT_S may be a comma list consumed one
+    # entry per probe (last entry repeats) — the driver tests script a
+    # fail-then-recover tunnel with "0,240"
+    spec = os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT_S", "240")
+    parts = [p.strip() for p in str(spec).split(",") if p.strip()]
+    probe_timeout = float(parts[min(_PROBE_COUNT, len(parts) - 1)]
+                          if parts else 240.0)
+    _PROBE_COUNT += 1
     deadline = min(time.monotonic() + probe_timeout, budget_deadline)
     result = {}
     tcp = {}
@@ -1127,6 +1139,12 @@ def _probe(budget_deadline):
         result = {"ok": False,
                   "error": "timeout" if timed_out else f"rc={rc}", **tcp}
     return result
+
+
+# analysis-only entries: cost-model/compiled-cost numbers, not on-chip
+# wall time — tagged in the artifact so an all-skip TPU round whose only
+# survivors are analysis entries cannot read as a measured round
+ANALYSIS_CONFIGS = frozenset({"scaling_dp8"})
 
 
 def main():
@@ -1149,15 +1167,64 @@ def main():
 
     configs = {}
     telemetry = {}
+    reprobes = []
     pending = [(n, dl, tpu) for n, _, dl, tpu in _config_table()]
     if not probe.get("ok"):
-        # dead tunnel: don't even try the TPU configs; the CPU-mesh
-        # scaling entry still runs so the artifact is never empty
+        # dead tunnel: don't burn the budget on TPU configs YET — the
+        # CPU-mesh entries still run, and the re-probe loop below keeps
+        # trying the tunnel with backoff for as long as budget remains
+        # (BENCH_r05 threw away 929 s of budget after ONE refused
+        # connect at t=0; never again)
         for name, _, tpu in pending:
             if tpu:
                 configs[name] = {"skipped": "tunnel probe failed"}
                 emit_partial(name, configs[name])
         pending = [p for p in pending if not p[2]]
+
+    _drain_configs(pending, configs, telemetry, budget_deadline,
+                   emit_partial)
+
+    # -- tunnel re-probe with exponential backoff -------------------------
+    # configs skipped because the tunnel was down at their turn get
+    # retried as soon as a later probe succeeds; backoff doubles from
+    # PADDLE_TPU_BENCH_REPROBE_BACKOFF_S (default 20 s, capped 300 s)
+    def _tunnel_skipped():
+        return [(n, dl, tpu) for n, _, dl, tpu in _config_table()
+                if tpu and isinstance(configs.get(n), dict)
+                and str(configs[n].get("skipped", "")).startswith(
+                    ("tunnel probe failed", "2 consecutive"))]
+
+    backoff = float(os.environ.get(
+        "PADDLE_TPU_BENCH_REPROBE_BACKOFF_S", "20"))
+    while backoff > 0 and _tunnel_skipped() and \
+            budget_deadline - time.monotonic() > backoff + 90:
+        time.sleep(backoff)
+        probe2 = _probe(budget_deadline)
+        reprobes.append(probe2)
+        emit_partial("_tunnel_reprobe", probe2)
+        if not probe2.get("ok"):
+            backoff = min(backoff * 2, 300.0)
+            continue
+        probe = probe2            # the artifact reports the LIVE probe
+        retry = _tunnel_skipped()
+        for name, _, _ in retry:
+            configs.pop(name, None)
+        _drain_configs(retry, configs, telemetry, budget_deadline,
+                       emit_partial)
+
+    for name in ANALYSIS_CONFIGS:
+        if isinstance(configs.get(name), dict):
+            configs[name].setdefault("analysis", True)
+
+    _emit_summary(configs, telemetry, probe, reprobes, t_start)
+
+
+def _drain_configs(pending, configs, telemetry, budget_deadline,
+                   emit_partial):
+    """Run the named configs through restartable worker subprocesses
+    (mutates ``configs``/``telemetry``; see main for the contract)."""
+    import os
+    import sys
 
     timeouts_in_a_row = 0
     while pending:
@@ -1243,6 +1310,10 @@ def main():
                 emit_partial(name, configs[name])
             break
 
+
+def _emit_summary(configs, telemetry, probe, reprobes, t_start):
+    import os
+
     # per-config telemetry artifact (cache hits, compile time, transfer
     # bytes — the numbers that EXPLAIN a BENCH trajectory regression);
     # PADDLE_TPU_BENCH_STATS_PATH overrides, empty disables
@@ -1261,12 +1332,20 @@ def main():
     if tfm.get("tokens_per_sec"):
         configs["transformer_seq256"]["vs_a100"] = round(
             tfm["tokens_per_sec"] / A100_TRANSFORMER_TOK_S, 3)
+    # an all-skip/analysis-only round must be legible as one: count the
+    # configs that produced a MEASURED number this round
+    measured = sum(
+        1 for v in configs.values()
+        if isinstance(v, dict) and not v.get("skipped")
+        and not v.get("error") and not v.get("analysis"))
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": primary,
         "unit": "images/sec",
         "vs_baseline": round(primary / A100_RESNET50_IMG_S, 3),
         "tunnel_probe": probe,
+        "reprobes": len(reprobes),
+        "measured_configs": measured,
         "elapsed_s": round(time.monotonic() - t_start, 1),
         "step_stats_path": stats_path or None,
         "configs": configs,

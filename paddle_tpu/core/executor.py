@@ -427,6 +427,24 @@ class Executor:
         # cache at FLAGS_compile_cache_dir/xla.  Flag unset (default):
         # one flag read, nothing else
         _compile_cache.wire_jax_cache()
+        # HA promotion awareness: last fleet-topology epoch this executor
+        # acted on (see _refresh_promoted_endpoints)
+        self._promo_epoch = 0
+
+    def _refresh_promoted_endpoints(self) -> None:
+        """Promotion-aware endpoint refresh: when any RPC client failed
+        over to a NEW physical address since our last host-op dispatch
+        (a backup was promoted / a replacement re-registered — the
+        transport bumps a process-wide epoch), drop every cached
+        logical→physical resolution before running this program's RPC
+        host ops.  Endpoints that did not fail a request yet re-resolve
+        through the registry instead of timing out into serial failovers
+        mid-step.  One int compare when nothing moved."""
+        from ..distributed import transport as _transport
+        epoch = _transport.promotion_epoch()
+        if epoch != self._promo_epoch:
+            self._promo_epoch = epoch
+            _transport.refresh_resolutions()
 
     # -- public API --------------------------------------------------------
     def run(
@@ -479,7 +497,7 @@ class Executor:
             feed_vals.append(self._put_feed(_as_device_array(feed[n], var)))
 
         sig = self._feed_sig(feed_names, feed_vals)
-        base = (id(program), program._version, tuple(fetch_names),
+        base = (program._uid, program._version, tuple(fetch_names),
                 self._training)
         key = self._mem_key(program, sig, fetch_names)
         entry = self._cache.get(key) if use_program_cache else None
@@ -668,7 +686,7 @@ class Executor:
             stacked.append(jax.device_put(np.stack(steps)))
 
         sig = self._feed_sig(feed_names, stacked)
-        base = (id(program), program._version, tuple(fetch_names),
+        base = (program._uid, program._version, tuple(fetch_names),
                 "run_steps", self._training)
         key = self._mem_key(program, sig, fetch_names, mode="run_steps")
         entry = self._cache.get(key)
@@ -812,9 +830,9 @@ class Executor:
         here — run()/run_steps()/_warm_one must never reassemble it by
         hand (a drifted copy silently defeats warm starts)."""
         if mode == "run":
-            return (id(program), program._version, sig,
+            return (program._uid, program._version, sig,
                     tuple(fetch_names), self._training)
-        return (id(program), program._version, sig, tuple(fetch_names),
+        return (program._uid, program._version, sig, tuple(fetch_names),
                 mode, self._training)
 
     def _build_entry(self, program: Program, plan, sig, fetch_names: tuple,
@@ -1086,7 +1104,7 @@ class Executor:
     # (executor.cc:390, operators/send_op.cc:29, listen_and_serv_op.cc:102).
 
     def _segment_plan(self, program: Program, feed_names: tuple, fetch_names: tuple):
-        key = ("seg", id(program), program._version, feed_names, fetch_names)
+        key = ("seg", program._uid, program._version, feed_names, fetch_names)
         segs = self._cache.get(key)
         if segs is not None:
             return segs
@@ -1122,6 +1140,7 @@ class Executor:
         return segs
 
     def _run_segmented(self, program, feed, fetch_names, scope, return_numpy):
+        self._refresh_promoted_endpoints()
         segs = self._segment_plan(program, tuple(sorted(feed)), tuple(fetch_names))
         fetched: Dict[str, object] = {}
         # host ops read their inputs from the scope; make fed values visible

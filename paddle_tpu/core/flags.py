@@ -192,6 +192,18 @@ define_flag("compile_cache_max_bytes", 2 << 30,
             "after each store, oldest-used entry files (mtime, touched "
             "on every hit) are pruned until the tier-A entries fit; "
             "counted in compile_cache.evictions.  0 = unbounded")
+define_flag("fault_inject", "",
+            "chaos-suite fault injection rules (distributed/faults.py): "
+            "semicolon-separated 'kind[:target][:k=v,...]' rules — "
+            "drop_conn (sever a matching request's connection), delay "
+            "(sleep ms before handling), kill_after (os._exit(137) when "
+            "the matching counter reaches n), refuse_accept (slam new "
+            "connections).  Targets are RPC message names or loop "
+            "events (apply_round, lease_grant).  Empty (default) "
+            "disables every injection point — the transport is "
+            "byte-identical to the fault-free build.  Runtime injection "
+            "against a live fleet goes through the debug server's "
+            "/chaosz endpoint (tools/chaos.py)")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import itertools
 import json
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -380,10 +381,18 @@ class Program:
     (reference ProgramDesc, framework.proto:183; Program, framework.py:1404).
     """
 
+    # process-monotonic identity for executor cache keys: id(program) is
+    # REUSED by CPython after GC, and a fresh program landing on a dead
+    # one's address (with an equal _version) silently hit the dead
+    # program's cached executable — the root cause of the intermittently
+    # "zero" numeric gradients in long test runs
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self._current_block_idx = 0
         self._version = 0  # bumped on mutation → invalidates executor caches
+        self._uid = next(Program._uid_counter)
         self.random_seed = 0
         self._op_role = OpRole.Forward
         self._op_role_vars: List[str] = []

@@ -1,0 +1,295 @@
+"""Fault injection for the chaos suite: scripted wire/process failures.
+
+The HA control plane (pserver replication, master failover) is only as
+real as the failures it has survived, so the transport and the pserver
+loop carry *injection points* that a chaos scenario arms with rules —
+via ``FLAGS_fault_inject`` at process start, or at runtime through the
+debug server's ``/chaosz`` endpoint (``tools/chaos.py`` drives a live
+fleet that way).
+
+Rule grammar (semicolon-separated rules)::
+
+    kind[:target][:k=v[,k=v...]]
+
+kinds
+    ``drop_conn``     server: close the connection WITHOUT responding to
+                      a matching request — the lost-response window of a
+                      peer dying mid-request (retry/at-most-once paths).
+    ``delay``         sleep ``ms`` before handling (server side) or
+                      before sending (client side, ``side=client``).
+    ``kill_after``    hard-kill THIS process (``os._exit(137)``) when the
+                      matching request/event counter reaches ``n`` — the
+                      "kill primary pserver after N batches" scenario.
+    ``refuse_accept`` server: close every new connection immediately
+                      (accept-then-slam), bounded by ``for_s``/``times``.
+
+target
+    an RPC message name (``send_vars``, ``batch_barrier``, ``get_task``,
+    ...), a loop event (``apply_round``, ``apply_async``,
+    ``lease_grant``), or ``*`` / empty for any.
+
+params
+    ``n=N``      trigger from the Nth matching hit (default 1)
+    ``p=0.x``    per-hit probability once armed (default 1.0)
+    ``times=K``  stop after K firings (default unlimited; kill fires once)
+    ``ms=X``     delay milliseconds (``delay`` kind; default 100)
+    ``for_s=X``  rule disarms X seconds after installation
+    ``side=client|server|any``  which hook honors it (default any)
+
+Example: kill the primary pserver mid-round after 3 applied rounds::
+
+    FLAGS_fault_inject="kill_after:apply_round:n=3"
+
+Flap the wire under barriers, 30% of them, for 5 seconds::
+
+    FLAGS_fault_inject="drop_conn:batch_barrier:p=0.3,for_s=5"
+
+With the flag unset and no runtime rules installed (the default), every
+hook is one cheap guard — no threads, no RPCs, no wire changes; the
+transport is byte-identical to the fault-free build.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..observability import flight as _flight
+from ..observability import stats as _obs_stats
+from ..observability.trace import flags_on as _telemetry_on
+
+DROP_CONN = "drop_conn"
+DELAY = "delay"
+KILL_AFTER = "kill_after"
+REFUSE_ACCEPT = "refuse_accept"
+_KINDS = (DROP_CONN, DELAY, KILL_AFTER, REFUSE_ACCEPT)
+
+_lock = threading.Lock()
+_runtime_rules: List["Rule"] = []
+_flag_cache: Dict[str, List["Rule"]] = {}
+
+
+class Rule:
+    __slots__ = ("kind", "target", "n", "p", "times", "ms", "for_s",
+                 "side", "source", "armed_at", "hits", "fires")
+
+    def __init__(self, kind: str, target: str = "", n: int = 1,
+                 p: float = 1.0, times: Optional[int] = None,
+                 ms: float = 100.0, for_s: Optional[float] = None,
+                 side: str = "any", source: str = "runtime"):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(one of {', '.join(_KINDS)})")
+        self.kind = kind
+        self.target = "" if target in ("", "*") else target
+        self.n = max(1, int(n))
+        self.p = float(p)
+        self.times = None if times is None else int(times)
+        self.ms = float(ms)
+        self.for_s = None if for_s is None else float(for_s)
+        self.side = side
+        self.source = source
+        self.armed_at = time.monotonic()
+        self.hits = 0
+        self.fires = 0
+
+    def matches(self, target: str, side: str, now: float) -> bool:
+        if self.target and self.target != target:
+            return False
+        if self.side != "any" and self.side != side:
+            return False
+        if self.for_s is not None and now - self.armed_at > self.for_s:
+            return False
+        if self.times is not None and self.fires >= self.times:
+            return False
+        return True
+
+    def fire(self) -> bool:
+        """Count a matching hit; True when the rule actually fires."""
+        self.hits += 1
+        if self.hits < self.n:
+            return False
+        if self.p < 1.0 and random.random() >= self.p:
+            return False
+        self.fires += 1
+        return True
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "target": self.target or "*",
+                "n": self.n, "p": self.p, "times": self.times,
+                "ms": self.ms, "for_s": self.for_s, "side": self.side,
+                "source": self.source, "hits": self.hits,
+                "fires": self.fires}
+
+
+def parse(spec: str, source: str = "runtime") -> List[Rule]:
+    """Parse a rule-spec string; raises ValueError on malformed specs."""
+    rules = []
+    for part in (p.strip() for p in spec.split(";")):
+        if not part:
+            continue
+        fields = part.split(":", 2)
+        kind = fields[0].strip()
+        target = fields[1].strip() if len(fields) > 1 else ""
+        kwargs = {}
+        if len(fields) > 2 and fields[2].strip():
+            for kv in fields[2].split(","):
+                k, _, v = kv.partition("=")
+                k = k.strip()
+                if k not in ("n", "p", "times", "ms", "for_s", "side"):
+                    raise ValueError(f"unknown fault param {k!r} in {part!r}")
+                kwargs[k] = v.strip() if k == "side" else float(v)
+        for k in ("n", "times"):
+            if k in kwargs:
+                kwargs[k] = int(kwargs[k])
+        rules.append(Rule(kind, target, source=source, **kwargs))
+    return rules
+
+
+def _flag_spec() -> str:
+    from ..core import flags
+    try:
+        return str(flags.get_flags("fault_inject") or "")
+    except KeyError:  # pragma: no cover - flag always defined
+        return ""
+
+
+def _flag_rules() -> List[Rule]:
+    spec = _flag_spec()
+    if not spec:
+        return []
+    cached = _flag_cache.get(spec)
+    if cached is None:
+        try:
+            cached = parse(spec, source="flag")
+        except ValueError:
+            # a malformed flag must not take the transport down; loud once
+            _flight.note("fault_inject_parse_error", spec=spec[:200])
+            cached = []
+        _flag_cache.clear()          # flag changed: old parse is garbage
+        _flag_cache[spec] = cached
+    return cached
+
+
+def active() -> bool:
+    """Cheap guard the hot-path hooks call first."""
+    return bool(_runtime_rules) or bool(_flag_spec())
+
+
+def inject(spec: str) -> List[dict]:
+    """Install runtime rules (the /chaosz + tools/chaos.py path)."""
+    rules = parse(spec, source="runtime")
+    with _lock:
+        _runtime_rules.extend(rules)
+    _flight.note("fault_injected", spec=spec[:200])
+    return [r.to_dict() for r in rules]
+
+
+def clear() -> int:
+    """Remove every runtime-injected rule (flag rules persist)."""
+    with _lock:
+        n = len(_runtime_rules)
+        _runtime_rules.clear()
+    if n:
+        _flight.note("faults_cleared", n=n)
+    return n
+
+
+def list_rules() -> List[dict]:
+    with _lock:
+        rules = list(_runtime_rules)
+    return [r.to_dict() for r in rules + _flag_rules()]
+
+
+def _match(target: str, side: str) -> Optional[Rule]:
+    now = time.monotonic()
+    with _lock:
+        rules = list(_runtime_rules)
+    for r in rules + _flag_rules():
+        if r.matches(target, side, now) and r.fire():
+            return r
+    return None
+
+
+def _fired(rule: Rule, target: str) -> None:
+    if _telemetry_on():
+        _obs_stats.counter(
+            "faults.fired." + rule.kind,
+            "injected faults that actually fired, by kind").inc()
+    _flight.note("fault_fired", kind=rule.kind, target=target,
+                 hits=rule.hits)
+
+
+def server_fault(target: str) -> Optional[str]:
+    """Hook for the RPC server request loop.  Returns ``None`` (no
+    fault), ``"drop_conn"`` (close without responding) — delays sleep
+    in place, kills never return."""
+    if not active():
+        return None
+    rule = _match(target, "server")
+    if rule is None:
+        return None
+    return _apply(rule, target)
+
+
+def client_fault(target: str) -> Optional[str]:
+    """Hook before a client sends a request frame.  ``"drop_conn"``
+    asks the caller to sever the connection instead of sending.  Only
+    rules EXPLICITLY marked ``side=client`` fire here — a default
+    (``side=any``) rule belongs to the server hook, so one rule never
+    double-fires on both ends of the same request."""
+    if not active():
+        return None
+    now = time.monotonic()
+    with _lock:
+        rules = list(_runtime_rules)
+    for r in rules + _flag_rules():
+        if r.side == "client" and r.matches(target, "client", now) \
+                and r.fire():
+            return _apply(r, target)
+    return None
+
+
+def event(target: str) -> None:
+    """Count a loop event (``apply_round``, ``lease_grant``, ...) —
+    only ``kill_after`` and ``delay`` rules are meaningful here."""
+    if not active():
+        return
+    rule = _match(target, "server")
+    if rule is not None:
+        _apply(rule, target)
+
+
+def _apply(rule: Rule, target: str) -> Optional[str]:
+    _fired(rule, target)
+    if rule.kind == DELAY:
+        time.sleep(rule.ms / 1e3)
+        return None
+    if rule.kind == KILL_AFTER:
+        # a HARD death (no atexit, no finally, no goodbye): exactly what
+        # a kill -9 / machine loss looks like to the rest of the fleet.
+        # Flush the flight recorder first — a deliberately-killed worker
+        # still leaves its black box (the chaos suite reads it).
+        _flight.note("fault_kill", target=target, hits=rule.hits)
+        _flight.dump(f"fault_kill_{target}")
+        os._exit(137)
+    if rule.kind in (DROP_CONN, REFUSE_ACCEPT):
+        return DROP_CONN
+    return None  # pragma: no cover - all kinds handled
+
+
+def accept_fault() -> bool:
+    """Hook at connection accept: True = slam the connection shut."""
+    if not active():
+        return False
+    now = time.monotonic()
+    with _lock:
+        rules = list(_runtime_rules)
+    for r in rules + _flag_rules():
+        if r.kind == REFUSE_ACCEPT and r.matches("accept", "server", now) \
+                and r.fire():
+            _fired(r, "accept")
+            return True
+    return False

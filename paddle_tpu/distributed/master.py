@@ -50,10 +50,18 @@ class TaskMaster:
     def __init__(self, snapshot_path: Optional[str] = None,
                  lease_timeout: float = 10.0, failure_max: int = 3,
                  snapshot_every: int = 1,
-                 health_source: Optional[Callable[[], Dict]] = None):
+                 health_source: Optional[Callable[[], Dict]] = None,
+                 publish_fn: Optional[Callable[[dict], None]] = None,
+                 leader: bool = True):
         self.snapshot_path = snapshot_path
         self.lease_timeout = lease_timeout
         self.failure_max = failure_max
+        # HA: ``publish_fn(state)`` mirrors every snapshotted transition
+        # into the registry (the per-change etcd put); ``leader=False``
+        # starts the master as a STANDBY that mirrors but refuses task
+        # ops until promoted (serve_master_ha flips it)
+        self.publish_fn = publish_fn
+        self.leader = leader
         # fleet-health integration (observability/health.py): a callable
         # returning {trainer_id: state}; leases owned by DEAD trainers are
         # requeued immediately instead of waiting out lease_timeout
@@ -63,6 +71,14 @@ class TaskMaster:
         self.snapshot_every = max(1, snapshot_every)
         self._transitions = 0
         self.lock = threading.Lock()
+        # publish staging: _snapshot (called with self.lock held) only
+        # STASHES the state; the registry RPC happens in _flush_publish
+        # AFTER the lock is released, so a slow registry can never stall
+        # the task-handout plane.  _pub_pending always holds the NEWEST
+        # full table, so a racing later flush covers an earlier one.
+        self._pub_lock = threading.Lock()
+        self._pub_pending: Optional[dict] = None
+        self._pub_seq = -1
         self.todo: deque = deque()          # [task dict]
         self.pending: Dict[int, dict] = {}  # id -> {task, deadline, owner}
         self.done: List[int] = []
@@ -75,32 +91,77 @@ class TaskMaster:
             self._recover()
 
     # -- persistence (service.go:207 snapshot / :166 recover) --------------
+    def _state_dict(self) -> dict:
+        """Serialized task/lease table (call with self.lock held).
+        ``pending`` keeps each lease's OWNER so a standby mirror can
+        re-issue the exact lease table on takeover; ``seq`` orders
+        mirrors (a stale publish must never overwrite a newer one)."""
+        return {
+            "todo": list(self.todo),
+            "pending": [{"task": e["task"], "owner": e["owner"]}
+                        for e in self.pending.values()],
+            "done": list(self.done),
+            "failures": {str(k): v for k, v in self.failures.items()},
+            "discarded": list(self.discarded),
+            "next_id": self.next_id,
+            "pass_id": self.pass_id,
+            "pass_rolled": self._pass_rolled,
+            "seq": self._transitions,
+        }
+
     def _snapshot(self, force: bool = False) -> None:
-        if not self.snapshot_path:
+        if not self.snapshot_path and self.publish_fn is None:
             return
         self._transitions += 1
         if not force and self._transitions % self.snapshot_every:
             return
-        state = {
-            "todo": list(self.todo),
-            "pending": [e["task"] for e in self.pending.values()],
-            "done": self.done,
-            "failures": {str(k): v for k, v in self.failures.items()},
-            "discarded": self.discarded,
-            "next_id": self.next_id,
-            "pass_id": self.pass_id,
-            "pass_rolled": self._pass_rolled,
-        }
-        tmp = self.snapshot_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self.snapshot_path)  # atomic like the etcd put
+        state = self._state_dict()
+        if self.snapshot_path:
+            tmp = self.snapshot_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, self.snapshot_path)  # atomic like the etcd put
+        if self.publish_fn is not None:
+            self._pub_pending = state   # flushed after the lock drops
+
+    def _flush_publish(self) -> None:
+        """Mirror the latest stashed state into the registry — called by
+        every task op AFTER releasing self.lock (the per-change etcd put,
+        off the hot path).  An op returns only once a table containing
+        its transition has been published (by itself or by a racing
+        later flush, whose state supersedes ours)."""
+        if self.publish_fn is None or self._pub_pending is None:
+            return
+        with self._pub_lock:
+            with self.lock:
+                state, self._pub_pending = self._pub_pending, None
+                publish = self.publish_fn
+            if state is None or publish is None:
+                return
+            if state["seq"] <= self._pub_seq:
+                return               # a newer table already went out
+            try:
+                publish(state)
+                self._pub_seq = state["seq"]
+            except Exception as e:
+                # a briefly-unreachable registry must not fail task ops;
+                # the NEXT transition re-publishes the whole table
+                from ..observability import flight as _flight
+                _flight.note("master_publish_failed", error=repr(e)[:200],
+                             seq=state["seq"])
+
+    @staticmethod
+    def _pending_tasks(state: dict) -> List[dict]:
+        """Tasks of the serialized pending list — entries are rich
+        ({"task","owner"}) since the HA mirror, bare task dicts before."""
+        return [e["task"] if isinstance(e, dict) and "task" in e else e
+                for e in state.get("pending", [])]
 
     def _recover(self) -> None:
         with open(self.snapshot_path) as f:
             state = json.load(f)
         # leases die with the old master: pending goes back to todo
-        self.todo = deque(state["todo"] + state["pending"])
+        self.todo = deque(state["todo"] + self._pending_tasks(state))
         self.done = state["done"]
         self.failures = {int(k): v for k, v in state["failures"].items()}
         self.discarded = state.get("discarded", [])
@@ -108,23 +169,63 @@ class TaskMaster:
         self.pass_id = state.get("pass_id", 0)
         self._pass_rolled = state.get("pass_rolled", not (self.todo or self.pending))
 
+    # -- HA standby mirror / takeover --------------------------------------
+    def adopt_state(self, state: dict, takeover: bool = False) -> bool:
+        """Load a mirrored lease table (REG_SNAPSHOT watch replay).
+
+        While STANDBY this runs repeatedly — newest seq wins, so the
+        mirror is always one publish behind the leader at worst.  On
+        ``takeover`` the outstanding leases are RE-ISSUED idempotently:
+        each stays pending under its original owner with a fresh
+        deadline (deadlines are local monotonic clocks and died with
+        the old leader) — never requeued, so no task is double-granted
+        while its trainer still works it, and never dropped, so a
+        finished/timed-out lease still resolves exactly once."""
+        with self.lock:
+            seq = int(state.get("seq", 0))
+            if not takeover and seq <= self._transitions:
+                return False      # stale mirror: keep the newer table
+            now = time.monotonic()
+            self.todo = deque(state.get("todo", []))
+            self.pending = {}
+            for e in state.get("pending", []):
+                task = e["task"] if isinstance(e, dict) and "task" in e else e
+                owner = e.get("owner", -1) if isinstance(e, dict) else -1
+                self.pending[task["id"]] = {
+                    "task": task, "owner": owner,
+                    "deadline": now + self.lease_timeout}
+            self.done = list(state.get("done", []))
+            self.failures = {int(k): v
+                             for k, v in state.get("failures", {}).items()}
+            self.discarded = list(state.get("discarded", []))
+            self.next_id = int(state.get("next_id", 0))
+            self.pass_id = int(state.get("pass_id", 0))
+            self._pass_rolled = bool(state.get(
+                "pass_rolled", not (self.todo or self.pending)))
+            self._transitions = seq
+            return True
+
     # -- core ops (locked) -------------------------------------------------
     def set_dataset(self, chunks: List) -> None:
         """Partition a chunk list into tasks (service.go:280 SetDataset +
         partition:106).  Idempotent while a pass is in flight; starting a
         new pass prunes the previous pass's bookkeeping."""
-        with self.lock:
-            if self.todo or self.pending:
-                return
-            self.done.clear()
-            self.failures.clear()
-            self.discarded.clear()
-            self._pass_rolled = False
-            for payload in chunks:
-                self.todo.append({"id": self.next_id, "payload": payload,
-                                  "pass": self.pass_id})
-                self.next_id += 1
-            self._snapshot(force=True)
+        try:
+            with self.lock:
+                if self.todo or self.pending:
+                    return
+                self.done.clear()
+                self.failures.clear()
+                self.discarded.clear()
+                self._pass_rolled = False
+                for payload in chunks:
+                    self.todo.append({"id": self.next_id,
+                                      "payload": payload,
+                                      "pass": self.pass_id})
+                    self.next_id += 1
+                self._snapshot(force=True)
+        finally:
+            self._flush_publish()
 
     def set_health_source(self, fn: Optional[Callable[[], Dict]]) -> None:
         self.health_source = fn
@@ -172,35 +273,50 @@ class TaskMaster:
             self.todo.append(task)
 
     def get_task(self, owner: int) -> Optional[dict]:
-        with self.lock:
-            self._requeue_expired()
-            if not self.todo:
-                if not self.pending and not self._pass_rolled:
-                    self.pass_id += 1  # pass finished (rolls over once)
-                    self._pass_rolled = True
-                    self._snapshot(force=True)
-                return None
-            task = self.todo.popleft()
-            self.pending[task["id"]] = {
-                "task": task, "owner": owner,
-                "deadline": time.monotonic() + self.lease_timeout}
-            self._snapshot()
-            return task
+        try:
+            with self.lock:
+                self._requeue_expired()
+                if not self.todo:
+                    if not self.pending and not self._pass_rolled:
+                        self.pass_id += 1  # pass finished (rolls once)
+                        self._pass_rolled = True
+                        self._snapshot(force=True)
+                    return None
+                task = self.todo.popleft()
+                self.pending[task["id"]] = {
+                    "task": task, "owner": owner,
+                    "deadline": time.monotonic() + self.lease_timeout}
+                # chaos injection point: kill_after:lease_grant dies
+                # HERE — lease recorded in memory only, neither
+                # published nor answered (the mid-handout window the HA
+                # tests verify)
+                from . import faults as _faults
+                _faults.event("lease_grant")
+                self._snapshot()
+                return task
+        finally:
+            self._flush_publish()
 
     def task_finished(self, task_id: int) -> None:
-        with self.lock:
-            if task_id in self.pending:
-                self.pending.pop(task_id)
-                self.done.append(task_id)
-                self.failures.pop(task_id, None)
-                self._snapshot()
+        try:
+            with self.lock:
+                if task_id in self.pending:
+                    self.pending.pop(task_id)
+                    self.done.append(task_id)
+                    self.failures.pop(task_id, None)
+                    self._snapshot()
+        finally:
+            self._flush_publish()
 
     def task_failed(self, task_id: int) -> None:
-        with self.lock:
-            entry = self.pending.pop(task_id, None)
-            if entry is not None:
-                self._note_failure(entry["task"])
-                self._snapshot()
+        try:
+            with self.lock:
+                entry = self.pending.pop(task_id, None)
+                if entry is not None:
+                    self._note_failure(entry["task"])
+                    self._snapshot()
+        finally:
+            self._flush_publish()
 
     def state(self) -> dict:
         with self.lock:
@@ -212,6 +328,12 @@ class TaskMaster:
 
     # -- transport glue ----------------------------------------------------
     def handle(self, msg_type, trainer_id, name, payload):
+        if not self.leader and msg_type in (GET_TASK, TASK_FINISHED,
+                                            TASK_FAILED, SET_DATASET):
+            # a STANDBY mirrors but must not act: granting from the
+            # mirror while the leader lives would double-grant.  Only
+            # the registry's promotion (serve_master_ha) flips this.
+            return transport.ERR, b"master standby: not the leader"
         if msg_type == GET_TASK:
             task = self.get_task(trainer_id)
             return OK, json.dumps(task).encode("utf-8")
@@ -293,29 +415,229 @@ def serve_master(endpoint: str, snapshot_path: Optional[str] = None,
     return master, server
 
 
-class MasterClient:
-    """Trainer-side master client (go/master/client.go + c bindings)."""
+MASTER_LOGICAL = "__master__"
 
-    def __init__(self, endpoint: str, trainer_id: int = 0):
+
+class HAMaster:
+    """One master CANDIDATE in the HA control plane (use
+    :func:`serve_master_ha`).
+
+    Election rides the registry's standby machinery
+    (``distributed/registry.py`` "HA layer"): every candidate heartbeats
+    the shared logical key ``__master__`` with ``standby=<candidate_id>,
+    elect=True`` — the first candidate up wins the initial election, and
+    on the leader's lease expiry the lowest-id live standby is promoted.
+    The LEADER publishes its task/lease table into the registry on every
+    snapshotted transition (``TaskMaster.publish_fn`` — the per-change
+    etcd put of go/master/service.go:207); STANDBYS mirror it via
+    REG_SNAPSHOT watch replay (newest seq wins) and refuse task ops.
+    On promotion the new leader re-issues the mirrored in-flight leases
+    idempotently (``adopt_state(takeover=True)``): same task, same
+    owner, fresh deadline — no double-grant, no orphan — and trainers
+    re-resolve ``__master__`` through their normal failover path.
+    """
+
+    def __init__(self, endpoint: str, registry_ep: str, candidate_id: int,
+                 logical: str = MASTER_LOGICAL,
+                 snapshot_path: Optional[str] = None,
+                 lease_timeout: float = 10.0, failure_max: int = 3,
+                 snapshot_every: int = 1,
+                 lease_ttl: Optional[float] = None,
+                 health_source: Optional[Callable[[], Dict]] = None):
+        from . import registry as _registry_mod
+        self._registry_mod = _registry_mod
+        self.logical = logical
+        self.registry_ep = registry_ep
+        self.candidate_id = int(candidate_id)
+        self._client = transport.RPCClient(0)
+        self.master = TaskMaster(snapshot_path, lease_timeout, failure_max,
+                                 snapshot_every=snapshot_every,
+                                 health_source=health_source, leader=False)
+        self.server = RPCServer(endpoint, self.master)
+        from ..observability import debug_server as _debug_server
+        self._provider_key = f"master:{self.server.port}"
+        _debug_server.register_provider(
+            self._provider_key,
+            lambda: {**self.master.state(),
+                     "leader": self.master.leader,
+                     "candidate_id": self.candidate_id})
+        self.server.start()
+        host = endpoint.rsplit(":", 1)[0]
+        self.physical = f"{host}:{self.server.port}"
+        self._stop_evt = threading.Event()
+        self.heartbeat = _registry_mod.Heartbeat(
+            registry_ep, logical, self.physical,
+            ttl=lease_ttl or _registry_mod.DEFAULT_TTL, role="MASTER",
+            standby=self.candidate_id, elect=True,
+            on_promote=self._takeover, on_demote=self._step_down)
+        # may promote synchronously (first candidate up leads)
+        self.heartbeat.start()
+        self._watcher = threading.Thread(
+            target=self._mirror_loop, daemon=True,
+            name=f"master-mirror-{self.candidate_id}")
+        self._watcher.start()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.master.leader
+
+    def _publish(self, state: dict) -> None:
+        self._registry_mod.publish_data(self._client, self.registry_ep,
+                                        self.logical, state)
+
+    def _pull_mirror(self) -> Optional[dict]:
+        snap = self._registry_mod.fetch_snapshot(
+            self._client, self.registry_ep,
+            connect_timeout=min(2.0, self.heartbeat.ttl))
+        return (snap.get("data") or {}).get(self.logical)
+
+    def _takeover(self) -> None:
+        """Registry promoted this candidate: adopt the newest mirrored
+        lease table and start leading (+ publishing)."""
+        from ..observability import flight as _flight
+        try:
+            data = self._pull_mirror()
+            if data:
+                self.master.adopt_state(data, takeover=True)
+        except Exception as e:
+            # lead from the last WATCHED mirror: strictly no worse than
+            # the old master dying with an unreachable registry
+            _flight.note("master_takeover_mirror_pull_failed",
+                         error=repr(e)[:200])
+        self.master.publish_fn = self._publish
+        self.master._pub_seq = -1   # fresh leadership: no stale guard
+        self.master.leader = True
+        if _telemetry_on():
+            _obs_stats.counter(
+                "master.takeovers",
+                "standby masters promoted to leader").inc()
+        st = self.master.state()
+        _flight.note("master_takeover", candidate=self.candidate_id,
+                     physical=self.physical, pending=st["pending"],
+                     todo=st["todo"])
+        # republish immediately so the NEXT standby mirrors the adopted
+        # table (seq re-stamped under our leadership)
+        with self.master.lock:
+            self.master._snapshot(force=True)
+        self.master._flush_publish()
+
+    def _step_down(self) -> None:
+        """The registry FENCED this leader's claim: a standby was
+        promoted over it while it was partitioned/away.  A deposed
+        leader must stop granting immediately — trainers whose TCP
+        connection to it never failed would otherwise keep drawing
+        leases from the stale table while the new leader re-issues the
+        same ones (double-grant).  Flip back to standby duty: refuse
+        task ops, stop publishing (our mirror would clobber the new
+        leader's), re-file candidacy, and resume mirroring."""
+        from ..observability import flight as _flight
+        with self.master.lock:
+            self.master.leader = False
+            self.master.publish_fn = None
+            self.master._pub_pending = None
+        if _telemetry_on():
+            _obs_stats.counter(
+                "master.stepdowns",
+                "deposed leaders that stepped back to standby after "
+                "the registry fenced their claim").inc()
+        _flight.note("master_step_down", candidate=self.candidate_id,
+                     physical=self.physical)
+        # resume candidacy + watch replay (the heartbeat thread is the
+        # caller, so candidacy resumes on its next refresh)
+        self.heartbeat.promoted = False
+        self.heartbeat._demoted = False   # re-arm: fences can recur
+        if not self._watcher.is_alive():
+            self._watcher = threading.Thread(
+                target=self._mirror_loop, daemon=True,
+                name=f"master-mirror-{self.candidate_id}")
+            self._watcher.start()
+
+    def _mirror_loop(self) -> None:
+        """Standby watch replay: poll REG_SNAPSHOT until promoted."""
+        period = max(0.1, min(1.0, self.heartbeat.ttl / 2.0))
+        while not self._stop_evt.wait(period):
+            if self.master.leader:
+                return            # mirroring duty ends at promotion
+            try:
+                data = self._pull_mirror()
+                if data:
+                    self.master.adopt_state(data)
+            except Exception:
+                pass              # registry briefly down: keep trying
+
+    def stop(self, bye: bool = True) -> None:
+        from ..observability import debug_server as _debug_server
+        self._stop_evt.set()
+        self.heartbeat.stop(bye=bye)
+        _debug_server.unregister_provider(self._provider_key)
+        self.server.stop()
+
+
+def serve_master_ha(endpoint: str, registry_ep: str, candidate_id: int,
+                    **kwargs) -> HAMaster:
+    """Start one HA master candidate (see :class:`HAMaster`).  Start
+    several with distinct ``candidate_id``s for a leader + standbys;
+    trainers point their :class:`MasterClient` at the LOGICAL key
+    ``MASTER_LOGICAL`` with the registry configured and follow the
+    leader through promotions via the normal failover path."""
+    return HAMaster(endpoint, registry_ep, candidate_id, **kwargs)
+
+
+class MasterClient:
+    """Trainer-side master client (go/master/client.go + c bindings).
+
+    Point ``endpoint`` at :data:`MASTER_LOGICAL` with a registry
+    (``registry_ep`` or ``FLAGS_pserver_registry``) to follow an HA
+    master fleet through promotions: connection failures re-resolve the
+    logical key (the promoted standby), and the short window where the
+    freshly-promoted master has not yet learned of its promotion (its
+    next lease refresh delivers the news) is absorbed by a bounded
+    retry on the standby's "not the leader" refusal."""
+
+    # how long to ride out the promotion-notification window before
+    # surfacing "not the leader" — a few lease terms on any sane config
+    NOT_LEADER_GRACE_S = 30.0
+
+    def __init__(self, endpoint: str, trainer_id: int = 0,
+                 registry_ep: Optional[str] = None):
         self.endpoint = endpoint
-        self._rpc = transport.get_client(trainer_id)
+        if registry_ep is not None:
+            self._rpc = transport.RPCClient(trainer_id)
+            self._rpc.set_registry(registry_ep)
+        else:
+            self._rpc = transport.get_client(trainer_id)
+
+    def _request(self, msg_type: int, name: str = "", payload=b""):
+        deadline = time.monotonic() + self.NOT_LEADER_GRACE_S
+        while True:
+            try:
+                return self._rpc._request(self.endpoint, msg_type, name,
+                                          payload)
+            except RuntimeError as e:
+                if "not the leader" not in str(e) \
+                        or time.monotonic() > deadline:
+                    raise
+                # a standby answered: promotion is in flight (the
+                # registry routed us here, so it IS the winner — it
+                # just hasn't heard yet).  Brief poll, then retry.
+                time.sleep(0.2)
 
     def set_dataset(self, chunks: List) -> None:
-        self._rpc._request(self.endpoint, SET_DATASET,
-                           payload=json.dumps(chunks).encode("utf-8"))
+        self._request(SET_DATASET,
+                      payload=json.dumps(chunks).encode("utf-8"))
 
     def get_task(self) -> Optional[dict]:
-        out = self._rpc._request(self.endpoint, GET_TASK)
+        out = self._request(GET_TASK)
         return json.loads(bytes(out).decode("utf-8"))
 
     def task_finished(self, task_id: int) -> None:
-        self._rpc._request(self.endpoint, TASK_FINISHED, str(task_id))
+        self._request(TASK_FINISHED, str(task_id))
 
     def task_failed(self, task_id: int) -> None:
-        self._rpc._request(self.endpoint, TASK_FAILED, str(task_id))
+        self._request(TASK_FAILED, str(task_id))
 
     def state(self) -> dict:
-        out = self._rpc._request(self.endpoint, MASTER_STATE)
+        out = self._request(MASTER_STATE)
         return json.loads(bytes(out).decode("utf-8"))
 
 

@@ -14,6 +14,7 @@ op-loop becomes an XLA executable per optimize block.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -26,11 +27,14 @@ from ..core.host_ops import register_host_op
 from ..core.program import Operator, Program, Variable
 from ..core.selected_rows import SelectedRows
 from ..observability import flight as _flight
+from ..observability import stats as _obs_stats
 from ..observability import trace as _trace
+from ..observability.trace import flags_on as _telemetry_on
+from . import faults as _faults
 from . import transport
 from .transport import (BATCH_BARRIER, CHECKPOINT_NOTIFY, COMPLETE,
                         FETCH_BARRIER, GET_VAR, GET_VARS, OK, PREFETCH,
-                        SEND_VAR, SEND_VARS, serde)
+                        REPLICATE, SEND_VAR, SEND_VARS, serde)
 
 
 def _to_host(value):
@@ -114,6 +118,15 @@ def _send(exe, program, op, scope):
 @register_host_op("send_barrier")
 def _send_barrier(exe, program, op, scope):
     client = transport.get_client(op.attr("trainer_id", 0))
+    if op.attr("ha", False):
+        # HA mode (a backup is configured): barriers carry a per-endpoint
+        # round sequence the pserver dedups on, so a retry after a
+        # connection drop or a promotion cannot close a round twice —
+        # which in turn makes the barrier safely retryable
+        client.parallel([(client.batch_barrier, ep,
+                          client.next_barrier_seq(ep))
+                         for ep in op.attr("endpoints")])
+        return
     client.parallel([(client.batch_barrier, ep)
                      for ep in op.attr("endpoints")])
 
@@ -238,6 +251,19 @@ class PServerLoop:
     Async mode (RunAsyncLoop:213): each incoming grad is applied
     immediately through its optimize block under a per-block lock
     (hogwild across params, serialized per param).
+
+    HA replication (the go/pserver fault-tolerance story, survey §2.11):
+    with a ``backup_endpoint`` configured, the PRIMARY forwards every
+    state-bearing frame (SEND_VAR/SEND_VARS/BATCH_BARRIER/COMPLETE) to
+    its backup under a monotonic apply-sequence number BEFORE buffering
+    or applying it locally, so anything a trainer got an OK for also
+    exists at the backup — primary death loses no acknowledged state.
+    The BACKUP (``is_backup``) runs the same loop fed by REPLICATE
+    frames: same barrier accounting, same optimize blocks, identical
+    state evolution.  Promotion is pure routing — the registry flips the
+    logical endpoint to the backup's address on the primary's lease
+    expiry, trainers re-resolve, and the already-warm backup serves
+    their next request (no checkpoint rollback, no replay).
     """
 
     def __init__(self, executor, program: Program, op, scope):
@@ -273,9 +299,37 @@ class PServerLoop:
         self.lr_lock = threading.Lock()
         self._async_sends = 0
 
+        # HA replication state (module docstring "HA replication")
+        self.backup_endpoint = op.attr("backup_endpoint", None) or None
+        self.is_backup = bool(op.attr("is_backup", False))
+        self.repl_lock = threading.Lock()   # seq assignment + wire order
+        self.repl_seq = 0                   # primary: next seq to stream
+        self.repl_last = -1                 # backup: last applied seq
+        self._backup_down = False
+        self._repl_client = None
+        # staleness fencing: a backup that MISSED acknowledged frames
+        # (apply-seq gap, or the primary revoked it after a replication
+        # loss) can never serve primary duty — it withdraws candidacy
+        # (on_stale, wired to Heartbeat.withdraw by listen_and_serv) and
+        # refuses the rest of the stream
+        self.stale = False
+        self.on_stale = None
+        # HA barrier dedup: last round seq seen per trainer (mirrors to
+        # the backup through the replicated barrier, so a post-promotion
+        # retry of the in-flight barrier is recognized there too)
+        self.last_barrier_seq: Dict[int, int] = {}
+
         # periodic self-checkpoint + recovery (go/pserver/service.go:346
         # checkpoint / :175 LoadCheckpoint)
         from ..core import flags as _flags
+        self.logical = op.attr("endpoint")
+        self.registry_ep = op.attr("registry_endpoint", None) or None
+        if self.registry_ep is None:
+            try:
+                self.registry_ep = _flags.get_flags("pserver_registry") \
+                    or None
+            except KeyError:  # pragma: no cover
+                self.registry_ep = None
         try:
             self._profile_period = int(
                 _flags.get_flags("rpc_server_profile_period") or 0)
@@ -375,11 +429,17 @@ class PServerLoop:
         self.exe.run(self.block_progs[block_idx], feed={}, fetch_list=[],
                      scope=self.scope)
 
-    def _merge_grads(self, per_trainer: List[dict]):
+    def _merge_grads(self, per_trainer: List[dict]) -> set:
+        """Merge buffered grads into scope; returns the block indices
+        that actually received gradients this round (a round closed with
+        a grad missing — possible only under faults/promotion windows —
+        must not re-apply that block with its STALE previous grad)."""
+        touched = set()
         for gname, bidx in self.grad_to_block.items():
             vals = [buf[gname] for buf in per_trainer if gname in buf]
             if not vals:
                 continue
+            touched.add(bidx)
             if isinstance(vals[0], SelectedRows):
                 rows = np.concatenate([np.asarray(v.rows) for v in vals])
                 data = np.concatenate([np.asarray(v.values) for v in vals])
@@ -391,8 +451,191 @@ class PServerLoop:
                 if self.dense_merge == "mean":
                     merged = merged / float(self.num_trainers)
             self.scope.set_var(gname, merged)
+        return touched
+
+    # -- HA replication (primary side) -------------------------------------
+    def _replicate(self, kind: str, trainer_id: int, name: str,
+                   payload) -> None:
+        """Stream one state-bearing frame to the backup, in apply order,
+        under a monotonic sequence number.  Synchronous BEFORE the local
+        buffer/apply: anything the trainer gets an OK for exists at the
+        backup first (zero acknowledged-state loss on primary death).
+        A dead backup degrades replication loudly — training continues
+        unreplicated rather than stalling on a lost replica."""
+        if self.backup_endpoint is None or self._backup_down:
+            return
+        hdr = {"kind": kind, "tid": int(trainer_id), "name": name}
+        with self.repl_lock:
+            # the lock covers send+ack so wire order == seq order even
+            # across the striped client connections
+            hdr["seq"] = self.repl_seq
+            if self._repl_client is None:
+                self._repl_client = transport.RPCClient(0)
+            frames = [payload] if not isinstance(payload, list) else payload
+            try:
+                try:
+                    self._repl_client._raw_request(
+                        self.backup_endpoint, REPLICATE, json.dumps(hdr),
+                        frames)
+                except ConnectionError:
+                    # one retry on a fresh connection: a transient TCP
+                    # reset must not permanently degrade replication.
+                    # Safe to re-send — the backup dedups seq==repl_last
+                    # retransmits.  A RuntimeError (the backup REFUSED
+                    # the frame: promoted, or already stale) is
+                    # authoritative and never retried.
+                    self._repl_client._raw_request(
+                        self.backup_endpoint, REPLICATE, json.dumps(hdr),
+                        frames)
+                self.repl_seq += 1
+                if _telemetry_on():
+                    _obs_stats.counter(
+                        "pserver.replicated_frames",
+                        "state-bearing frames streamed to the backup "
+                        "replica").inc()
+            except (ConnectionError, RuntimeError) as e:
+                self._mark_backup_lost(e)
+
+    def _mark_backup_lost(self, e: Exception) -> None:
+        """Give up on the backup (call with repl_lock held): training
+        continues unreplicated — loudly — and, since the backup is now
+        missing frames trainers were acked for, its candidacy is revoked
+        at the registry (the promotion authority) so a later primary
+        death can never promote a silently-rolled-back replica."""
+        self._backup_down = True
+        if _telemetry_on():
+            _obs_stats.counter(
+                "pserver.replication_lost",
+                "backup replicas given up on after a forward "
+                "error (replication degraded, training "
+                "continues)").inc()
+        print(f"[pserver-replication] backup "
+              f"{self.backup_endpoint} lost ({e!r}); continuing "
+              "UNREPLICATED", flush=True)
+        _flight.note("replication_lost",
+                     backup=self.backup_endpoint,
+                     error=repr(e)[:200], seq=self.repl_seq)
+        if self.registry_ep:
+            threading.Thread(
+                target=self._revoke_backup_loop, daemon=True,
+                name="pserver-revoke-backup").start()
+
+    def _revoke_backup_loop(self) -> None:
+        """Background best-effort: strike the lost backup's candidacy at
+        the registry, retrying until it lands or the loop exits (the
+        registry itself may be briefly unreachable in the same fault)."""
+        from . import registry as registry_mod
+        client = transport.RPCClient(0)
+        while not self.exit:
+            try:
+                registry_mod.revoke_standby(
+                    client, self.registry_ep, self.logical,
+                    self.backup_endpoint)
+                if _telemetry_on():
+                    _obs_stats.counter(
+                        "pserver.backup_revokes",
+                        "lost backups whose standby candidacy was "
+                        "revoked at the registry").inc()
+                _flight.note("backup_candidacy_revoked",
+                             backup=self.backup_endpoint,
+                             logical=self.logical)
+                return
+            except Exception:
+                time.sleep(1.0)
+
+    def mark_stale(self, reason: str) -> None:
+        """Backup side of the same invariant: this replica missed
+        acknowledged frames (apply-seq gap, or the primary revoked it)
+        and can never serve primary duty — withdraw standby candidacy
+        and refuse the rest of the stream."""
+        if self.stale:
+            return
+        self.stale = True
+        if _telemetry_on():
+            _obs_stats.counter(
+                "pserver.backup_stale",
+                "backup replicas fenced as stale (missed acknowledged "
+                "frames; candidacy withdrawn)").inc()
+        print(f"[pserver-replication] backup {self.op.attr('endpoint')} "
+              f"is STALE ({reason}); withdrawing candidacy", flush=True)
+        _flight.note("backup_stale", endpoint=self.op.attr("endpoint"),
+                     reason=reason)
+        cb = self.on_stale
+        if cb is not None:
+            try:
+                cb()
+            except Exception as e:
+                _flight.note("on_stale_failed", error=repr(e)[:200])
+
+    def fence(self) -> None:
+        """The registry refused this worker's primary claim: a backup
+        was promoted over it while it was partitioned/away (the zombie-
+        primary case).  A fenced primary must stop serving immediately —
+        still-connected trainers would keep feeding a deposed replica —
+        so the loop exits dirty (flight post-mortem) and a supervisor
+        restarts it as a fresh standby."""
+        if _telemetry_on():
+            _obs_stats.counter(
+                "pserver.fenced",
+                "deposed primaries shut down after the registry "
+                "refused their claim").inc()
+        _flight.note("pserver_fenced", endpoint=self.op.attr("endpoint"))
+        with self.lock:
+            if self.error is None:
+                self.error = RuntimeError(
+                    "fenced: a backup was promoted over this pserver")
+            self.exit = True
+            self.lock.notify_all()
+
+    def promote(self) -> None:
+        """Backup → primary flip (the registry told our heartbeat we now
+        own the logical endpoint).  Routing already changed; this just
+        re-arms the duties a standby holds back (checkpoints)."""
+        if self.stale:
+            # should be unreachable (a stale backup withdrew candidacy
+            # and was revoked at the registry) — but if every fence
+            # failed, say so as loudly as possible: trainers are about
+            # to see silently rolled-back state
+            _flight.note("stale_backup_promoted",
+                         endpoint=self.op.attr("endpoint"),
+                         repl_last=self.repl_last)
+            print(f"[pserver] WARNING: STALE backup "
+                  f"{self.op.attr('endpoint')} promoted — acknowledged "
+                  "state has been lost", flush=True)
+        self.is_backup = False
+        _flight.note("backup_promoted",
+                     endpoint=self.op.attr("endpoint"),
+                     applied_rounds=self.applied_rounds,
+                     repl_last=self.repl_last)
+
+    def _handle_barrier(self, trainer_id: int, name: str) -> None:
+        """Close trainer ``trainer_id``'s round.  ``name`` (HA mode)
+        carries the trainer's round seq: an exact retransmit — a retry
+        after a drop/promotion of a barrier the server already applied —
+        is recognized and ignored, making the barrier idempotent."""
+        if not self.sync_mode:
+            return
+        with self.lock:
+            if name:
+                seq = int(name)
+                if self.last_barrier_seq.get(trainer_id) == seq:
+                    if _telemetry_on():
+                        _obs_stats.counter(
+                            "pserver.barrier_dups",
+                            "retransmitted HA barriers ignored by "
+                            "round-seq dedup").inc()
+                    return
+                self.last_barrier_seq[trainer_id] = seq
+            self.closed[trainer_id].append(
+                self.open_round.pop(trainer_id, {}))
+            self.rounds_sent[trainer_id] += 1
+            ready = all(self.closed[t] for t in range(self.num_trainers))
+            if ready:
+                self._apply_round()
+                self.lock.notify_all()
 
     def _apply_round(self):
+        _faults.event("apply_round")
         per_trainer = [self.closed[t].popleft()
                        for t in range(self.num_trainers) if self.closed[t]]
         try:
@@ -403,9 +646,9 @@ class PServerLoop:
             with _trace.start_span("pserver::apply_round", cat="pserver",
                                    root=False,
                                    tags={"round": self.applied_rounds + 1}):
-                self._merge_grads(per_trainer)
+                touched = self._merge_grads(per_trainer)
                 self._run_lr()
-                for bidx in sorted(set(self.grad_to_block.values())):
+                for bidx in sorted(touched):
                     self._run_block(bidx)
         except Exception as e:
             # record + still advance the round so waiting GETs wake up and
@@ -419,9 +662,11 @@ class PServerLoop:
             self.applied_rounds += 1
             self.lock.notify_all()  # caller holds the condition
         # a failed snapshot must not poison training: in-memory state is
-        # intact, so warn and carry on (next interval retries)
-        if self.ckpt_dir and self.ckpt_every > 0 and \
-                self.applied_rounds % self.ckpt_every == 0:
+        # intact, so warn and carry on (next interval retries).  A
+        # BACKUP holds periodic checkpoints back (the primary owns the
+        # shard file; promotion re-arms them via promote())
+        if self.ckpt_dir and self.ckpt_every > 0 and not self.is_backup \
+                and self.applied_rounds % self.ckpt_every == 0:
             try:
                 self._checkpoint()
             except Exception as e:
@@ -432,6 +677,7 @@ class PServerLoop:
         """Async-mode apply of ONE incoming var (RunAsyncLoop:213
         hogwild): no scaling, no barriers; LR block advances once per
         virtual round."""
+        _faults.event("apply_async")
         bidx = self.grad_to_block.get(name)
         if bidx is None:
             # plain var write (e.g. startup broadcast)
@@ -445,6 +691,7 @@ class PServerLoop:
             self._async_sends += 1
             ckpt_now = (
                 self.ckpt_dir and self.ckpt_every > 0
+                and not self.is_backup
                 and self._async_sends %
                 (n_grads * self.ckpt_every) == 0)
         # child of the SEND_VAR(S) server span: the per-var hogwild
@@ -474,47 +721,59 @@ class PServerLoop:
             raise RuntimeError(
                 f"pserver optimize pass failed: {self.error!r}")
 
+    # -- incoming state-bearing frames (direct AND replicated) -------------
+    def _handle_send_var(self, trainer_id: int, name: str, value) -> None:
+        if self.sync_mode:
+            with self.lock:
+                self.open_round[trainer_id][name] = value
+        else:
+            self._apply_async(name, value)
+
+    def _handle_send_vars(self, trainer_id: int, pairs) -> None:
+        if self.sync_mode:
+            # the whole batch lands under ONE lock acquisition; each
+            # var still counts individually toward the round, so a
+            # batch of N is indistinguishable from N SEND_VARs to
+            # the batch_barrier accounting
+            with self.lock:
+                buf = self.open_round[trainer_id]
+                for n, v in pairs:
+                    buf[n] = v
+        else:
+            for n, v in pairs:
+                self._apply_async(n, v)
+
+    def _handle_complete(self, trainer_id: int) -> None:
+        with self.lock:
+            self.n_complete += 1
+            if self.n_complete >= self.num_trainers:
+                self.exit = True
+            self.lock.notify_all()
+
     # -- service entry (one call per request, many threads) ----------------
     def handle(self, msg_type, trainer_id, name, payload):
         self._profile_tick()
         if msg_type == SEND_VAR:
-            value = serde.loads_value(payload)
-            if self.sync_mode:
-                with self.lock:
-                    self.open_round[trainer_id][name] = value
-            else:
-                self._apply_async(name, value)
+            self._replicate("send_var", trainer_id, name, payload)
+            self._handle_send_var(trainer_id, name,
+                                  serde.loads_value(payload))
             return OK, b""
 
         if msg_type == SEND_VARS:
+            self._replicate("send_vars", trainer_id, name, payload)
             # zero-copy decode: values are views over the recv buffer
             # (pinned by the arrays; merge/apply never mutates in place)
-            pairs = serde.loads_batch(payload, copy=False)
-            if self.sync_mode:
-                # the whole batch lands under ONE lock acquisition; each
-                # var still counts individually toward the round, so a
-                # batch of N is indistinguishable from N SEND_VARs to
-                # the batch_barrier accounting
-                with self.lock:
-                    buf = self.open_round[trainer_id]
-                    for n, v in pairs:
-                        buf[n] = v
-            else:
-                for n, v in pairs:
-                    self._apply_async(n, v)
+            self._handle_send_vars(trainer_id,
+                                   serde.loads_batch(payload, copy=False))
             return OK, b""
 
         if msg_type == BATCH_BARRIER:
-            if self.sync_mode:
-                with self.lock:
-                    self.closed[trainer_id].append(self.open_round.pop(trainer_id, {}))
-                    self.rounds_sent[trainer_id] += 1
-                    ready = all(self.closed[t]
-                                for t in range(self.num_trainers))
-                    if ready:
-                        self._apply_round()
-                        self.lock.notify_all()
+            self._replicate("batch_barrier", trainer_id, name, b"")
+            self._handle_barrier(trainer_id, name)
             return OK, b""
+
+        if msg_type == REPLICATE:
+            return self._handle_replicate(name, payload)
 
         if msg_type == GET_VAR:
             self._wait_round(trainer_id)
@@ -553,14 +812,85 @@ class PServerLoop:
             return OK, b""
 
         if msg_type == COMPLETE:
-            with self.lock:
-                self.n_complete += 1
-                if self.n_complete >= self.num_trainers:
-                    self.exit = True
-                self.lock.notify_all()
+            self._replicate("complete", trainer_id, name, b"")
+            self._handle_complete(trainer_id)
             return OK, b""
 
         raise ValueError(f"unknown message type {msg_type}")
+
+    def _handle_replicate(self, name: str, payload):
+        """Backup side of the replication stream: apply one forwarded
+        frame through the SAME paths a direct frame takes (identical
+        state evolution), guarded by the monotonic apply-seq so a
+        duplicate is ignored and a gap is loud."""
+        if not self.is_backup:
+            # a PROMOTED backup (or any primary) must fence its old
+            # peer's stream: a zombie primary that lost its lease but
+            # can still reach this address would otherwise keep mutating
+            # round/barrier state here, silently diverging the replica.
+            # The refusal surfaces as a RuntimeError at the sender,
+            # which gives up replication (authoritative, never retried).
+            if _telemetry_on():
+                _obs_stats.counter(
+                    "pserver.replication_refused",
+                    "replicated frames refused (receiver is not a "
+                    "backup: promoted, or a misdirected stream)").inc()
+            _flight.note("replication_refused",
+                         endpoint=self.op.attr("endpoint"),
+                         reason="not_backup")
+            raise RuntimeError(
+                "replication refused: not a backup (a promoted primary "
+                "fences its deposed peer's stream)")
+        if self.stale:
+            raise RuntimeError(
+                "replication refused: backup is stale (missed "
+                "acknowledged frames)")
+        hdr = json.loads(name)
+        seq, kind, tid = int(hdr["seq"]), hdr["kind"], int(hdr["tid"])
+        with self.repl_lock:
+            if seq == self.repl_last:
+                # exact retransmit (the primary retried a frame whose
+                # ACK was lost): already applied, idempotently ignored
+                if _telemetry_on():
+                    _obs_stats.counter(
+                        "pserver.replication_dups",
+                        "replicated frames ignored as duplicates by "
+                        "apply-seq").inc()
+                return OK, b""
+            last = self.repl_last
+            gap = seq != last + 1
+            if not gap:
+                self.repl_last = seq
+        if gap:
+            # frames lost between primary and backup (it forwards
+            # synchronously BEFORE acking, so a gap means acknowledged
+            # state this replica will never have — a primary restart,
+            # an epoch anomaly, or an injected fault).  This replica is
+            # permanently stale: withdraw candidacy and refuse, loudly —
+            # a promotion here would silently roll trainers back.
+            if _telemetry_on():
+                _obs_stats.counter(
+                    "pserver.replication_gaps",
+                    "apply-seq gaps observed in the replication "
+                    "stream").inc()
+            _flight.note("replication_gap", last=last, got=seq)
+            self.mark_stale(f"apply-seq gap: last={last} got={seq}")
+            raise RuntimeError(
+                f"replication refused: apply-seq gap (last={last}, "
+                f"got={seq}) — backup is stale")
+        if kind == "send_var":
+            self._handle_send_var(tid, hdr["name"],
+                                  serde.loads_value(payload))
+        elif kind == "send_vars":
+            self._handle_send_vars(tid,
+                                   serde.loads_batch(payload, copy=False))
+        elif kind == "batch_barrier":
+            self._handle_barrier(tid, hdr.get("name", ""))
+        elif kind == "complete":
+            self._handle_complete(tid)
+        else:
+            raise ValueError(f"unknown replicated frame kind {kind!r}")
+        return OK, b""
 
     def wait_exit(self):
         with self.lock:
@@ -586,8 +916,31 @@ def _listen_and_serv(exe, program, op, scope):
                    or flags.get_flags("pserver_registry") or None)
     if registry_ep:
         host = bind_ep.rsplit(":", 1)[0]
-        hb = registry_mod.Heartbeat(registry_ep, op.attr("endpoint"),
-                                    f"{host}:{server.port}")
+        ttl = float(op.attr("lease_ttl", 0) or registry_mod.DEFAULT_TTL)
+        if loop.is_backup:
+            # a BACKUP heartbeats as a standby under the SAME logical
+            # key: invisible to trainers while the primary's lease is
+            # live; on the primary's lease expiry the registry promotes
+            # it and the next refresh response flips this loop to
+            # primary duty (promotion rides the keepalive — no new RPC)
+            hb = registry_mod.Heartbeat(
+                registry_ep, op.attr("endpoint"),
+                f"{host}:{server.port}", ttl=ttl, role="PSERVER",
+                standby=int(op.attr("replica_id", 1)),
+                on_promote=loop.promote,
+                on_revoke=lambda: loop.mark_stale(
+                    "candidacy revoked by the registry"))
+            # a gap-fenced backup withdraws its own candidacy
+            loop.on_stale = hb.withdraw
+        else:
+            # on_demote: the zombie-primary fence — if a backup was
+            # promoted over this worker while it was partitioned, stop
+            # serving instead of feeding still-connected trainers from
+            # a deposed replica
+            hb = registry_mod.Heartbeat(registry_ep, op.attr("endpoint"),
+                                        f"{host}:{server.port}", ttl=ttl,
+                                        role="PSERVER",
+                                        on_demote=loop.fence)
         hb.start()
     clean = False
     try:

@@ -37,13 +37,26 @@ class DistributeTranspilerConfig:
     """Reference DistributeTranspilerConfig (distribute_transpiler.py:125).
 
     ``checkpoint_dir``/``checkpoint_every_rounds`` enable periodic pserver
-    self-checkpoints with restart recovery (go/pserver/service.go:346)."""
+    self-checkpoints with restart recovery (go/pserver/service.go:346).
+
+    ``backup_endpoints`` (comma list aligned with ``pservers``; empty
+    slots allowed) arms HA replication: each named endpoint becomes the
+    PHYSICAL address of a backup replica for the same-position pserver.
+    The primary's ``listen_and_serv`` streams applied batches there
+    (ps_ops.PServerLoop "HA replication"), ``get_backup_program``
+    builds the replica's program, trainer barriers carry round seqs
+    (idempotent retries), and the registry promotes the backup on the
+    primary's lease expiry.  ``lease_ttl`` (seconds; 0 = registry
+    default) bounds how long a death stays unnoticed — promotion and
+    health transitions are measured in these lease terms."""
 
     slice_var_up: bool = True
     min_block_size: int = 8192
     split_method: str = "RoundRobin"  # or "HashName"
     checkpoint_dir: Optional[str] = None
     checkpoint_every_rounds: int = 0
+    backup_endpoints: str = ""
+    lease_ttl: float = 0.0
 
 
 class _Section:
@@ -116,6 +129,17 @@ class DistributeTranspiler:
         self.endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
         self.trainers = trainers
         self.sync_mode = sync_mode
+        # HA replication: position-aligned backup physical endpoints
+        self.backup_map: Dict[str, str] = {}
+        if self.config.backup_endpoints:
+            baks = [b.strip()
+                    for b in self.config.backup_endpoints.split(",")]
+            if len(baks) > len(self.endpoints):
+                raise ValueError(
+                    f"backup_endpoints names {len(baks)} entries for "
+                    f"{len(self.endpoints)} pservers")
+            self.backup_map = {ep: bak for ep, bak
+                               in zip(self.endpoints, baks) if bak}
 
         block0 = self.origin_program.global_block
         self.opt_ops = [op for op in block0.ops if _is_optimize_op(op)]
@@ -266,8 +290,14 @@ class DistributeTranspiler:
              "ep_groups": _ep_groups([s.gname for s in send_secs],
                                      [s.endpoint for s in send_secs])})
         if self.sync_mode:
-            block.append_op("send_barrier", {}, {},
-                            {**rpc_attrs, "endpoints": self.endpoints})
+            barrier_attrs = {**rpc_attrs, "endpoints": self.endpoints}
+            if self.backup_map:
+                # HA mode: barriers carry per-endpoint round seqs so the
+                # pserver (or its promoted backup) dedups retransmits —
+                # emitted ONLY when a backup exists, keeping the
+                # no-backup wire byte-identical
+                barrier_attrs["ha"] = True
+            block.append_op("send_barrier", {}, {}, barrier_attrs)
 
         # host: recv param sections ← pservers
         for p, secs in self.param_sections.items():
@@ -458,8 +488,33 @@ class DistributeTranspiler:
                     s.param: {"var": s.pname, "offset": s.offset,
                               "rows": s.rows}
                     for s in secs if s.is_table},
+                "backup_endpoint": self.backup_map.get(endpoint),
+                "lease_ttl": self.config.lease_ttl,
                 OP_ROLE_ATTR: OpRole.RPC,
             })
+        return prog
+
+    def get_backup_program(self, endpoint: str) -> Program:
+        """The HA backup replica's program for ``endpoint``: identical
+        optimize blocks (replication replays applied batches through
+        them, so primary and backup state evolve in lockstep), but the
+        ``listen_and_serv`` binds the backup's OWN physical address,
+        heartbeats as a registry standby for the primary's logical key,
+        and holds back primary-only duties (checkpoints, onward
+        replication) until promoted.  Initialize it with the SAME
+        ``get_startup_program(endpoint)`` — bit-identical named draws
+        put both replicas at the same starting state."""
+        bak = self.backup_map.get(endpoint)
+        if not bak:
+            raise ValueError(f"no backup configured for {endpoint!r} "
+                             "(DistributeTranspilerConfig.backup_endpoints)")
+        prog = self.get_pserver_program(endpoint)
+        for op in prog.global_block.ops:
+            if op.type == "listen_and_serv":
+                op.attrs["bind_endpoint"] = bak
+                op.attrs["is_backup"] = True
+                op.attrs["replica_id"] = 1
+                op.attrs["backup_endpoint"] = None
         return prog
 
     def get_startup_program(self, endpoint: str) -> Program:

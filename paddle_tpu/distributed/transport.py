@@ -67,10 +67,15 @@ CHECKPOINT_NOTIFY = 7
 # the round-trip-per-variable cost of SEND_VAR/GET_VAR amortized to one
 # RPC per pserver per round (the reference's async completion-queue
 # pipelining, collapsed into explicit batch frames).  Message-type ids
-# share ONE namespace across every service (registry.py holds 8-10,
-# master.py 16-20, STATS_PULL 24) so telemetry labels stay unambiguous
+# share ONE namespace across every service (registry.py holds 8-10 and
+# 13, master.py 16-20, STATS_PULL 24) so telemetry labels stay
+# unambiguous
 SEND_VARS = 11
 GET_VARS = 12
+# HA pserver replication (ps_ops.PServerLoop): the primary streams every
+# applied SEND_VARS batch / barrier to its backup under a monotonic
+# apply-sequence number; only flows when a backup is configured
+REPLICATE = 14
 # fleet observability (observability/aggregate.py): answered centrally by
 # _serve_io for EVERY service object, so any RPCServer — pserver, master,
 # registry — can be scraped for its process-local metric snapshot
@@ -89,6 +94,7 @@ MSG_NAMES = {SEND_VAR: "send_var", GET_VAR: "get_var",
              BATCH_BARRIER: "batch_barrier", FETCH_BARRIER: "fetch_barrier",
              COMPLETE: "complete", PREFETCH: "prefetch",
              CHECKPOINT_NOTIFY: "checkpoint_notify",
+             REPLICATE: "replicate",
              STATS_PULL: "stats_pull", TRACE_PULL: "trace_pull"}
 
 _HDR = struct.Struct("<BiH")  # msg_type, trainer_id, name_len
@@ -427,6 +433,9 @@ def _serve_io(io, service) -> None:
     — the cross-process half of the Dapper stitch; the span covers the
     WHOLE handle (including any sync-barrier block, which is exactly
     the wait a stitched timeline needs to show)."""
+    from . import faults as _faults
+    if _faults.active() and _faults.accept_fault():
+        return               # injected refuse_accept: slam the connection
     while True:
         body = io.recv_frame()
         if body is None:
@@ -434,6 +443,12 @@ def _serve_io(io, service) -> None:
         tel = _telemetry_on()
         t0 = time.perf_counter() if tel else None
         msg_type, tid, name, payload, wctx = _unpack_body_ext(body)
+        if _faults.active() and _faults.server_fault(
+                MSG_NAMES.get(msg_type, str(msg_type))) is not None:
+            # injected drop_conn: sever before the handler runs — to the
+            # peer this is indistinguishable from the server dying with
+            # the request in flight (the retry/at-most-once paths' case)
+            return
         sctx = _trace.ctx_from_wire(wctx) if wctx else None
         try:
             if sctx is not None:
@@ -584,7 +599,8 @@ def _ready_file_present(ready_dir: str, endpoint: str) -> bool:
 def wait_server_ready(endpoints, timeout: float = 90.0,
                       ready_dir: Optional[str] = None,
                       log_every: float = 2.0,
-                      probe_grace: Optional[float] = None) -> None:
+                      probe_grace: Optional[float] = None,
+                      registry_ep: Optional[str] = None) -> None:
     """Block until every endpoint's server is listening.
 
     With ``PADDLE_READY_DIR`` set (the deterministic path — every
@@ -604,6 +620,14 @@ def wait_server_ready(endpoints, timeout: float = 90.0,
     pending increments ``rpc.wait_server.retries``, and a progress line
     goes to stderr every ``log_every`` seconds — a launcher stuck here
     for 90 s used to look identical to a hang.
+
+    With a registry (``registry_ep`` or ``FLAGS_pserver_registry``), the
+    endpoints are treated as LOGICAL keys re-resolved each round: when a
+    key's resolution flips mid-wait (a backup was promoted, a
+    replacement re-registered), the probe retargets the new physical
+    address immediately and the grace clock restarts — instead of
+    waiting out the full grace against the dead address.  Every flip is
+    counted in ``rpc.wait_server.repromotes``.
     """
     t_start = time.monotonic()
     deadline = t_start + timeout
@@ -613,15 +637,56 @@ def wait_server_ready(endpoints, timeout: float = 90.0,
         probe_grace = min(5.0, timeout / 2.0)
     probe_after = t_start + probe_grace
     pending = [e.strip() for e in endpoints]
+    if registry_ep is None:
+        from ..core import flags as _flags
+        try:
+            registry_ep = _flags.get_flags("pserver_registry") or None
+        except KeyError:  # pragma: no cover
+            registry_ep = None
+    resolved: Dict[str, str] = {}
+    reg_client = None
+    next_resolve = t_start
     while pending:
+        if registry_ep and time.monotonic() >= next_resolve:
+            next_resolve = time.monotonic() + 0.5
+            from . import registry as _registry_mod
+            if reg_client is None:
+                reg_client = RPCClient(0)
+            for ep in pending:
+                if ep == registry_ep:
+                    continue
+                try:
+                    phys = _registry_mod.resolve(reg_client, registry_ep, ep)
+                except ConnectionError:
+                    break         # registry itself not up yet: keep probing
+                if phys is None:
+                    continue
+                old = resolved.get(ep)
+                resolved[ep] = phys
+                if old is not None and old != phys:
+                    # the endpoint flipped under us (backup promoted /
+                    # replacement registered): retarget and restart the
+                    # grace instead of riding out the dead address
+                    probe_after = time.monotonic() + probe_grace
+                    if _telemetry_on():
+                        _obs_stats.counter(
+                            "rpc.wait_server.repromotes",
+                            "wait_server_ready probe retargets after a "
+                            "mid-wait promotion/re-registration").inc()
+                    print(f"[wait_server_ready] {ep} re-resolved "
+                          f"{old} -> {phys}; restarting probe round",
+                          file=_sys.stderr, flush=True)
         still = []
         for ep in pending:
+            target = resolved.get(ep, ep)
             if ready_dir:
-                ok = _ready_file_present(ready_dir, ep)
+                ok = _ready_file_present(ready_dir, target)
+                if not ok and target != ep:
+                    ok = _ready_file_present(ready_dir, ep)
                 if not ok and time.monotonic() >= probe_after:
                     # grace expired: trust a live listener over a
                     # missing announcement file
-                    ok = RPCClient._probe(ep, 1.0)
+                    ok = RPCClient._probe(target, 1.0)
                     if ok and _telemetry_on():
                         _obs_stats.counter(
                             "rpc.wait_server.probe_fallbacks",
@@ -629,7 +694,7 @@ def wait_server_ready(endpoints, timeout: float = 90.0,
                             "fallback after the ready-file grace "
                             "period").inc()
             else:
-                ok = RPCClient._probe(ep, 1.0)
+                ok = RPCClient._probe(target, 1.0)
             if not ok:
                 still.append(ep)
         pending = still
@@ -803,6 +868,11 @@ class RPCClient:
         except KeyError:  # pragma: no cover
             self._registry = None
         self._resolved: Dict[str, str] = {}
+        # HA barrier sequencing: one monotonic round counter per logical
+        # endpoint (the dedup key the pserver uses to make barriers
+        # idempotent); only touched when the transpiler emitted ha mode
+        self._barrier_seq: Dict[str, int] = {}
+        self._barrier_seq_lock = threading.Lock()
 
     def set_registry(self, endpoint: Optional[str]) -> None:
         self._registry = endpoint or None
@@ -848,11 +918,13 @@ class RPCClient:
 
     @staticmethod
     def _probe(endpoint: str, timeout: float = 1.0) -> bool:
-        host, port = endpoint.rsplit(":", 1)
         try:
+            host, port = endpoint.rsplit(":", 1)
             socket.create_connection((host, int(port)), timeout).close()
             return True
-        except OSError:
+        except (OSError, ValueError):
+            # ValueError: a LOGICAL key (no host:port shape) that has no
+            # physical resolution yet — not probeable, so not ready
             return False
 
     def _conn(self, endpoint: str, timeout: float = _CONNECT_TIMEOUT) -> _Conn:
@@ -962,6 +1034,13 @@ class RPCClient:
 
     def _raw_request_framed(self, endpoint, msg_type, name, payload,
                             retry_all, connect_timeout, n_vars, tel, t0, sc):
+        from . import faults as _faults
+        if _faults.active() and _faults.client_fault(
+                MSG_NAMES.get(msg_type, str(msg_type))) is not None:
+            # injected client-side drop: behave exactly like the wire
+            # dying before the first byte (the retry discipline decides)
+            raise ConnectionError(
+                f"injected fault: connection to {endpoint} dropped")
         req_bufs = _pack_body_vec(msg_type, self.trainer_id, name,
                                   payload if isinstance(payload, list)
                                   else [payload], ctx=_trace.inject())
@@ -1016,11 +1095,15 @@ class RPCClient:
         return rpayload
 
     def _request(self, endpoint: str, msg_type: int, name: str = "",
-                 payload=b"", n_vars: int = 0):
+                 payload=b"", n_vars: int = 0, idempotent: bool = False):
+        """``idempotent=True`` marks a normally-non-retryable message as
+        safe to re-send (the HA barrier carries a round sequence number
+        the server dedups on), so a failover or transient drop retries
+        it instead of surfacing the error."""
         phys = self._resolve(endpoint)
         try:
             return self._raw_request(phys, msg_type, name, payload,
-                                     n_vars=n_vars)
+                                     n_vars=n_vars, retry_all=idempotent)
         except ConnectionError:
             if self._registry is None or endpoint == self._registry:
                 raise
@@ -1029,6 +1112,12 @@ class RPCClient:
             new_phys = self._resolve(endpoint, refresh=True, avoid=phys)
             if _telemetry_on():
                 _obs_stats.scope("rpc.client").counter("failovers").inc()
+            if new_phys != phys:
+                # a promotion/re-registration happened: bump the global
+                # epoch so OTHER cached resolutions (this client's and
+                # every other client's) re-resolve before their next use
+                # — correlated failures move whole hosts, not one port
+                bump_promotion_epoch()
             # loud by design: operators should see every elastic failover
             # (and the flight recorder should remember it post-mortem)
             print(f"[rpc-failover] {endpoint} msg={msg_type}: "
@@ -1039,6 +1128,9 @@ class RPCClient:
             _flight.note("rpc_failover", endpoint=endpoint,
                          msg_type=MSG_NAMES.get(msg_type, str(msg_type)),
                          old=phys, new=new_phys)
+            if idempotent:
+                return self._raw_request(new_phys, msg_type, name, payload,
+                                         n_vars=n_vars, retry_all=True)
             if new_phys == phys and msg_type not in self._RETRYABLE:
                 # same address answering the probe: could be the SAME live
                 # server after a transient drop — re-sending a SEND_VAR or
@@ -1158,8 +1250,26 @@ class RPCClient:
         return serde.loads_value(
             self._request(endpoint, PREFETCH, table_name, serde.dumps_value(ids)))
 
-    def batch_barrier(self, endpoint: str) -> None:
-        self._request(endpoint, BATCH_BARRIER)
+    def next_barrier_seq(self, endpoint: str) -> int:
+        """The next HA barrier round number for ``endpoint`` (1-based,
+        monotonic per logical endpoint for this client's lifetime)."""
+        with self._barrier_seq_lock:
+            seq = self._barrier_seq.get(endpoint, 0) + 1
+            self._barrier_seq[endpoint] = seq
+            return seq
+
+    def batch_barrier(self, endpoint: str, seq: Optional[int] = None) -> None:
+        """Close this trainer's round.  ``seq`` (HA mode — the transpiler
+        emits it only when a backup is configured) rides in the name
+        field as a per-trainer round number the pserver dedups on,
+        making the barrier idempotent: a retry after a connection drop
+        or a promotion can no longer close a round twice.  ``seq=None``
+        keeps the PR-5 wire byte-identical."""
+        if seq is None:
+            self._request(endpoint, BATCH_BARRIER)
+        else:
+            self._request(endpoint, BATCH_BARRIER, str(int(seq)),
+                          idempotent=True)
 
     def fetch_barrier(self, endpoint: str) -> None:
         self._request(endpoint, FETCH_BARRIER)
@@ -1214,3 +1324,37 @@ def get_client(trainer_id: int = 0) -> RPCClient:
             c = RPCClient(trainer_id)
             _clients[trainer_id] = c
         return c
+
+
+# ---------------------------------------------------------------------------
+# promotion epoch: a process-wide "the fleet topology moved" counter
+# ---------------------------------------------------------------------------
+# Bumped whenever a failover lands on a DIFFERENT physical address (a
+# pserver replacement re-registered, or a backup was promoted).  The
+# executor compares it before dispatching RPC host ops and drops every
+# client's logical→physical cache on change, so endpoints that did NOT
+# fail a request yet still re-resolve promptly after a promotion instead
+# of timing out into their own failovers one by one.
+
+_promotion_epoch = 0
+_promotion_lock = threading.Lock()
+
+
+def promotion_epoch() -> int:
+    return _promotion_epoch
+
+
+def bump_promotion_epoch() -> int:
+    global _promotion_epoch
+    with _promotion_lock:
+        _promotion_epoch += 1
+        return _promotion_epoch
+
+
+def refresh_resolutions() -> None:
+    """Drop every client's cached logical→physical resolution (they
+    rebuild lazily from the registry on next use)."""
+    with _clients_lock:
+        clients = list(_clients.values())
+    for c in clients:
+        c._resolved.clear()

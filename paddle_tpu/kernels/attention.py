@@ -119,7 +119,8 @@ def _sds(shape, dtype, like):
     shard_map's varying-mesh-axes (vma) checking: outputs vary over the
     same mesh axes as the operand ``like`` (ring attention calls the
     kernels per shard inside shard_map)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    typeof = getattr(jax, "typeof", None)   # absent before jax 0.6
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -828,6 +829,15 @@ def _ring_fwd(q, k, v, kv_mask, axis_name, causal, sm_scale,
 
         if causal:
             o_p, lse_p = lax.cond(kv_i < idx, compute, skip, None)
+        elif jax.default_backend() != "tpu":
+            # interpret-mode pallas: a BARE pallas call inside this scan
+            # makes XLA's SPMD partitioner reject the module with
+            # "PartitionId instruction is not supported" (the causal
+            # branch never hits it because its call sits under lax.cond).
+            # Route through a cond with a traced always-true predicate so
+            # the off-TPU lowering matches the shape XLA accepts; TPU
+            # keeps the straight-line call.
+            o_p, lse_p = lax.cond(kv_i >= 0, compute, skip, None)
         else:
             o_p, lse_p = compute(None)
         o_a, lse_a = _merge_partial(o_a, lse_a, o_p, lse_p)

@@ -45,7 +45,11 @@ if _HAVE_PALLAS:
     # w + dW output + dW scratch are ~4 MB each at H=512 — past the 16 MB
     # default scoped-vmem limit with double-buffered blocks; v5e has
     # 128 MB physical VMEM, so raise the cap for these kernels.
-    _VMEM_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+    # (jax renamed TPUCompilerParams -> CompilerParams; accept either
+    # spelling so the kernel loads across the supported jax range)
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    _VMEM_PARAMS = _CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 else:  # pragma: no cover
     _VMEM_PARAMS = None
 

@@ -179,12 +179,37 @@ class _Handler(BaseHTTPRequestHandler):
                             "application/json")
             elif path == "/tracez":
                 self._reply(200, self._tracez(query), "application/json")
+            elif path == "/chaosz":
+                # fault-injection control plane (distributed/faults.py):
+                # ?inject=<spec> arms rules, ?clear=1 removes runtime
+                # rules, bare GET lists what's armed.  tools/chaos.py is
+                # the operator CLI over this endpoint.
+                from urllib.parse import parse_qs, unquote
+                from ..distributed import faults as _faults
+                q = parse_qs(query)
+                if q.get("inject"):
+                    try:
+                        added = _faults.inject(unquote(q["inject"][0]))
+                    except ValueError as e:
+                        self._reply(400, json.dumps(
+                            {"error": str(e)}) + "\n", "application/json")
+                        return
+                    self._reply(200, json.dumps(
+                        {"injected": added}, indent=2), "application/json")
+                elif q.get("clear"):
+                    self._reply(200, json.dumps(
+                        {"cleared": _faults.clear()}), "application/json")
+                else:
+                    self._reply(200, json.dumps(
+                        {"rules": _faults.list_rules()}, indent=2),
+                        "application/json")
             elif path == "/":
                 self._reply(200, "\n".join(
                     ["paddle_tpu debug server", "",
                      "/metrics  /healthz  /statusz  /stepz",
                      "/tracez  (?raw=1 span snapshot, ?recent=1 flight "
-                     "recorder)", ""]),
+                     "recorder)",
+                     "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),
                     "text/plain")
             else:
                 sc.counter("not_found").inc()

@@ -135,6 +135,20 @@ def dump(reason: str, exc_info=None,
         return None
 
 
+def export_events(path: str, role: str = "") -> str:
+    """Write this process's event ring as one JSON record
+    ``{"role", "pid", "events"}`` (atomic rename).  The chaos suite's
+    runners call it on the way out so a test can stitch the
+    cross-process note chain without arming the full dump hooks."""
+    rec = {"role": role or os.environ.get("PADDLE_TRAINING_ROLE", ""),
+           "pid": os.getpid(), "events": events()}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, default=repr)
+    os.replace(tmp, path)
+    return path
+
+
 def dirty_exit(reason: str) -> Optional[str]:
     """A worker leaving without a goodbye (``Heartbeat.stop(bye=False)``
     and friends): dump if armed, no-op otherwise."""

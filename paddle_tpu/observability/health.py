@@ -44,7 +44,7 @@ def _flag(name: str, default: float) -> float:
 
 class _WorkerEntry:
     __slots__ = ("name", "role", "step", "last_error", "trainer_id",
-                 "ttl", "last_seen", "heartbeats")
+                 "ttl", "last_seen", "heartbeats", "standby")
 
     def __init__(self, name: str):
         self.name = name
@@ -55,6 +55,10 @@ class _WorkerEntry:
         self.ttl = 0.0
         self.last_seen = 0.0
         self.heartbeats = 0
+        # HA: candidate id while this worker is a STANDBY replica for
+        # its logical key (None = primary / not replicated); cleared on
+        # promotion, so the fleet view shows who is warm-sparing whom
+        self.standby = None
 
 
 class HealthTable:
@@ -100,7 +104,8 @@ class HealthTable:
     def observe(self, name: str, ttl: float, role: str = "",
                 step: Optional[int] = None,
                 last_error: Optional[str] = None,
-                trainer_id: Optional[int] = None) -> None:
+                trainer_id: Optional[int] = None,
+                standby=None) -> None:
         """File one heartbeat (idempotent re-registration included)."""
         with self._lock:
             e = self._workers.get(name)
@@ -114,6 +119,9 @@ class HealthTable:
             e.last_error = last_error
             if trainer_id is not None:
                 e.trainer_id = int(trainer_id)
+            # always assigned (not only when present): a promoted
+            # backup's next heartbeat clears its standby marker
+            e.standby = standby
             e.last_seen = time.monotonic()
             e.heartbeats += 1
 
@@ -166,6 +174,7 @@ class HealthTable:
                 "ttl": e.ttl,
                 "age_s": round(now - e.last_seen, 3),
                 "heartbeats": e.heartbeats,
+                "standby": e.standby,
             }
         sc = _stats.scope("health")
         sc.gauge("workers_healthy").set(tallies[HEALTHY])

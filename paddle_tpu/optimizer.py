@@ -41,9 +41,9 @@ class Optimizer:
     def _create_global_learning_rate(self):
         program = default_main_program()
         if isinstance(self._learning_rate, Variable):
-            self._learning_rate_map[id(program)] = self._learning_rate
+            self._learning_rate_map[program._uid] = self._learning_rate
             return
-        if id(program) in self._learning_rate_map:
+        if program._uid in self._learning_rate_map:
             return
         helper = LayerHelper("learning_rate")
         lr = helper.create_global_variable(
@@ -51,10 +51,10 @@ class Optimizer:
             name=unique_name.generate("learning_rate"))
         helper.set_variable_initializer(
             lr, ConstantInitializer(float(self._learning_rate)))
-        self._learning_rate_map[id(program)] = lr
+        self._learning_rate_map[program._uid] = lr
 
     def _global_learning_rate(self):
-        return self._learning_rate_map[id(default_main_program())]
+        return self._learning_rate_map[default_main_program()._uid]
 
     def _create_param_lr(self, param: Variable):
         mult = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)

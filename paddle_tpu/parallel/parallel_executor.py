@@ -223,7 +223,9 @@ class ParallelExecutor(Executor):
         gs = self._build_strategy.gradient_scale_strategy
         if gs == GradientScaleStrategy.kCoeffNumDevice or self._loss_name is None:
             return program
-        key = (id(program), program._version)
+        # _uid, not id(): a GC'd program's reused address must never hit
+        # another program's cached rewrite (see Program._uid_counter)
+        key = (program._uid, program._version)
         cached = self._scaled_programs.get(key)
         if cached is not None:
             return cached

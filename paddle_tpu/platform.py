@@ -17,6 +17,29 @@ from typing import Dict, List, Union
 
 import jax
 
+# jax version shim: ``jax.shard_map`` is the modern spelling; on older
+# jax only ``jax.experimental.shard_map.shard_map`` exists.  Alias it so
+# kernel code (and tests) can use one spelling across the supported
+# range.
+if not hasattr(jax, "shard_map"):  # pragma: no cover - version-dependent
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+        import functools as _functools
+
+        @_functools.wraps(_shard_map)
+        def _shard_map_compat(*args, **kwargs):
+            # the old experimental shard_map has no replication rule for
+            # pallas_call and rejects kernels under its check_rep=True
+            # default; the modern jax.shard_map handles this via vma.
+            # Default the check off so kernel-bearing bodies work the
+            # same across versions (callers may still pass it).
+            kwargs.setdefault("check_rep", False)
+            return _shard_map(*args, **kwargs)
+
+        jax.shard_map = _shard_map_compat
+    except Exception:
+        pass
+
 
 class CPUPlace:
     """Host-device tag (place.h:36)."""

@@ -1,0 +1,126 @@
+"""Subprocess entry for the chaos suite (tests/test_chaos.py): HA
+pserver/backup/trainer/master roles driven by PADDLE_*/CHAOS_* env vars.
+
+Faults are armed per process via ``FLAGS_fault_inject`` in the child's
+environment (the flags registry bootstraps from env at import — no code
+path differs from production).  Every role appends its flight-recorder
+event ring to ``CHAOS_EVENTS`` on the way out, so the test can assert
+the cross-process note chain (death → promotion → re-resolution) that
+the acceptance bar demands.
+
+Roles (PADDLE_TRAINING_ROLE):
+- ``PSERVER``  primary for PADDLE_CURRENT_ENDPOINT; CHAOS_BACKUP names
+  its backup replica's physical endpoint (arms HA replication).
+- ``BACKUP``   backup replica for PADDLE_CURRENT_ENDPOINT, bound at
+  CHAOS_BACKUP; registers as a registry standby and promotes on the
+  primary's lease expiry.
+- ``TRAINER``  sync-mode trainer; writes per-step losses to
+  CHAOS_PROGRESS (atomic json) and exits cleanly at DIST_STEPS.
+- ``MASTER``   one HA master candidate (CHAOS_CANDIDATE id); serves
+  until killed or told to stop via CHAOS_STOP_FILE.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _dump_events(tag):
+    """Write this process's flight ring next to CHAOS_EVENTS (one file
+    per process — the test stitches the cross-process story)."""
+    path = os.environ.get("CHAOS_EVENTS")
+    if not path:
+        return
+    from paddle_tpu.observability import flight
+    flight.export_events(f"{path}.{os.getpid()}", role=tag)
+
+
+def _build_transpiler():
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed.transpiler import DistributeTranspilerConfig
+    from dist_model import build
+
+    endpoints = os.environ["PADDLE_PSERVER_ENDPOINTS"].split(",")
+    prog, startup, loss = build(lr=0.05)
+    cfg = DistributeTranspilerConfig()
+    cfg.backup_endpoints = os.environ.get("CHAOS_BACKUPS", "")
+    cfg.lease_ttl = float(os.environ.get("CHAOS_LEASE_TTL", "0") or 0)
+    cfg.checkpoint_dir = os.environ.get("CHAOS_CKPT_DIR") or None
+    if cfg.checkpoint_dir:
+        cfg.checkpoint_every_rounds = 1
+    t = fluid.DistributeTranspiler(config=cfg)
+    t.transpile(trainer_id=0, program=prog, pservers=",".join(endpoints),
+                trainers=1, sync_mode=True, startup_program=startup)
+    return t, startup, loss
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+
+    if role == "MASTER":
+        from paddle_tpu.distributed.master import serve_master_ha
+        ha = serve_master_ha(
+            os.environ["PADDLE_CURRENT_ENDPOINT"],
+            os.environ["FLAGS_pserver_registry"],
+            int(os.environ["CHAOS_CANDIDATE"]),
+            lease_ttl=float(os.environ.get("CHAOS_LEASE_TTL", "1.0")),
+            lease_timeout=float(os.environ.get("CHAOS_LEASE_TIMEOUT",
+                                               "3.0")))
+        stop_file = os.environ.get("CHAOS_STOP_FILE")
+        try:
+            while not (stop_file and os.path.exists(stop_file)):
+                time.sleep(0.1)
+        finally:
+            _dump_events(f"master-{os.environ['CHAOS_CANDIDATE']}")
+            ha.stop()
+        return
+
+    from paddle_tpu.core.executor import Executor, Scope
+    from paddle_tpu.distributed import notify_complete
+
+    t, startup, loss = _build_transpiler()
+    endpoints = os.environ["PADDLE_PSERVER_ENDPOINTS"].split(",")
+    scope = Scope()
+    exe = Executor()
+
+    if role in ("PSERVER", "BACKUP"):
+        ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+        # bit-identical named draws: primary and backup start from the
+        # SAME parameter state (replication keeps them in lockstep after)
+        exe.run(t.get_startup_program(ep), scope=scope)
+        ps_prog = (t.get_backup_program(ep) if role == "BACKUP"
+                   else t.get_pserver_program(ep))
+        try:
+            exe.run(ps_prog, scope=scope)
+        finally:
+            _dump_events(role.lower())
+        return
+
+    # TRAINER
+    tp = t.get_trainer_program()
+    exe.run(startup, scope=scope)
+    from dist_model import batches
+    n_steps = int(os.environ.get("DIST_STEPS", "20"))
+    progress_path = os.environ["CHAOS_PROGRESS"]
+    losses = []
+    try:
+        for i, (x, y) in enumerate(batches(n_steps)):
+            (l,) = exe.run(tp, feed={"x": x, "y": y}, fetch_list=[loss],
+                           scope=scope)
+            losses.append(float(np.asarray(l)))
+            with open(progress_path + ".tmp", "w") as f:
+                json.dump({"step": i + 1, "losses": losses}, f)
+            os.replace(progress_path + ".tmp", progress_path)
+        notify_complete(endpoints, trainer_id=0)
+    finally:
+        _dump_events("trainer")
+
+
+if __name__ == "__main__":
+    main()

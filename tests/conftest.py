@@ -25,3 +25,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration test (subprocess clusters, "
         "convergence runs)")
+    config.addinivalue_line(
+        "markers", "chaos_lite: tier-1-safe chaos scenarios (one "
+        "kill-promote pserver run + master lease-replay); the full flap "
+        "matrix stays slow")

@@ -78,15 +78,21 @@ def check_grad(
             n = flat.size
             coords = rng.choice(n, size=min(max_coords, n), replace=False)
             for c in coords:
+                # each perturbation hands the scope its OWN copy: the CPU
+                # backend's device_put may alias a numpy buffer zero-copy
+                # (alignment-dependent), so mutating one array in place
+                # between runs let a later poke reach an EARLIER run's
+                # input — lp == lm exactly, numeric gradient 0, and the
+                # infamous intermittent op-grad failures
                 orig = flat[c]
                 flat[c] = orig + eps
-                scope.set_var(name, arr.reshape(inputs[name].shape))
+                scope.set_var(name, arr.copy())
                 (lp,) = exe.run(prog, fetch_list=[loss.name])
                 flat[c] = orig - eps
-                scope.set_var(name, arr.reshape(inputs[name].shape))
+                scope.set_var(name, arr.copy())
                 (lm,) = exe.run(prog, fetch_list=[loss.name])
                 flat[c] = orig
-                scope.set_var(name, arr.reshape(inputs[name].shape))
+                scope.set_var(name, arr.copy())
                 numeric = (float(lp) - float(lm)) / (2 * eps)
                 got = float(np.asarray(analytic[name]).reshape(-1)[c])
                 np.testing.assert_allclose(

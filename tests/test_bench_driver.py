@@ -119,10 +119,47 @@ CONFIG_TABLE = [
 ]
 """
     partials, final = _run_bench(
-        tmp_path, table, {"PADDLE_TPU_BENCH_PROBE_TIMEOUT_S": "0"})
+        tmp_path, table, {"PADDLE_TPU_BENCH_PROBE_TIMEOUT_S": "0",
+                          "PADDLE_TPU_BENCH_REPROBE_BACKOFF_S": "0"})
     cfg = final["configs"]
     assert cfg["needs_chip"] == {"skipped": "tunnel probe failed"}
     assert cfg["cpu_only"] == {"v": 4}
+
+
+def test_orchestrator_reprobe_recovers_skipped_configs(tmp_path):
+    """A tunnel that refuses at t=0 but recovers: the orchestrator
+    re-probes with backoff for as long as budget remains and RETRIES
+    the configs skipped earlier — a BENCH_r05-style all-skip round can
+    no longer happen while the tunnel merely blinked.  Analysis-only
+    entries (scaling_dp8) carry an explicit analysis: true tag."""
+    table = """
+def chip():
+    return {"v": 7}
+
+
+def scaling():
+    return {"eff_flops": 1.0}
+
+
+CONFIG_TABLE = [
+    ("needs_chip", chip, 60, True),
+    ("scaling_dp8", scaling, 60, False),
+]
+"""
+    partials, final = _run_bench(
+        tmp_path, table,
+        {"PADDLE_TPU_BENCH_PROBE_TIMEOUT_S": "0,240",
+         "PADDLE_TPU_BENCH_REPROBE_BACKOFF_S": "1",
+         "PADDLE_TPU_BENCH_BUDGET_S": "150"}, timeout=170)
+    cfg = final["configs"]
+    assert final["tunnel_probe"]["ok"] is True   # the RECOVERED probe
+    assert final["reprobes"] >= 1
+    assert cfg["needs_chip"] == {"v": 7}, cfg    # retried after recovery
+    assert cfg["scaling_dp8"]["analysis"] is True
+    # the skip, then the recovery, both streamed as partials
+    names = [p["config"] for p in partials]
+    assert "_tunnel_reprobe" in names
+    assert final["measured_configs"] == 1        # scaling is analysis-only
 
 
 def test_step_stats_artifact_written(tmp_path):

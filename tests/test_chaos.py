@@ -1,0 +1,500 @@
+"""Chaos suite: deliberate fault injection against the HA control plane.
+
+The acceptance bar (ISSUE 6): training converges to parity with the
+no-fault run AND the flight recorder explains every recovery as a
+legible note chain — death → promotion → endpoint re-resolution.
+
+``chaos_lite`` scenarios run in tier-1 (one kill-promote pserver
+scenario and the master replay tests); the wider flap matrix is
+``slow``.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from dist_model import free_ports, retry_flaky, run_local
+from paddle_tpu.distributed import faults, transport
+from paddle_tpu.distributed.master import (MASTER_LOGICAL, MasterClient,
+                                           serve_master_ha)
+from paddle_tpu.distributed.registry import (Heartbeat, RegistryServer,
+                                             fetch_snapshot, register,
+                                             resolve)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUNNER = os.path.join(HERE, "chaos_runner.py")
+
+
+def _spawn(role, env, **extra):
+    return subprocess.Popen(
+        [sys.executable, RUNNER],
+        env={**env, "PADDLE_TRAINING_ROLE": role, **extra},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def _events_of(tmp, role):
+    for path in glob.glob(os.path.join(tmp, "events.*")):
+        rec = json.load(open(path))
+        if rec["role"] == role:
+            return rec["events"]
+    return []
+
+
+@pytest.mark.chaos_lite
+@retry_flaky()
+def test_kill_primary_pserver_mid_round():
+    """THE tier-1 chaos scenario: primary pserver hard-killed mid-round
+    (fault-injected at its Nth apply), backup promotes from replicated
+    state — no checkpoint anywhere, so recovery without rollback is the
+    only way the run can finish — and the loss curve matches the
+    no-fault local run within tolerance.  The flight recorder must name
+    the death, the promotion and the re-resolution, in order."""
+    n_steps = 12
+    kill_round = 4
+    (ps_port, bak_port) = free_ports(2)
+    logical = f"127.0.0.1:{ps_port}"
+    backup_phys = f"127.0.0.1:{bak_port}"
+
+    registry = RegistryServer("127.0.0.1:0")
+    registry.start()
+    reg_ep = f"127.0.0.1:{registry.port}"
+
+    with tempfile.TemporaryDirectory() as tmp:
+        flight_dir = os.path.join(tmp, "flight")
+        progress = os.path.join(tmp, "progress.json")
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_PSERVER_ENDPOINTS": logical,
+            "FLAGS_pserver_registry": reg_ep,
+            "CHAOS_BACKUPS": backup_phys,
+            "CHAOS_LEASE_TTL": "0.5",
+            "CHAOS_EVENTS": os.path.join(tmp, "events"),
+            "PADDLE_READY_DIR": os.path.join(tmp, "ready"),
+            "PYTHONPATH": os.pathsep.join(
+                [os.path.dirname(HERE), HERE,
+                 os.environ.get("PYTHONPATH", "")]),
+        }
+        procs = []
+        try:
+            primary = _spawn(
+                "PSERVER", env, PADDLE_CURRENT_ENDPOINT=logical,
+                FLAGS_fault_inject=f"kill_after:apply_round:n={kill_round}",
+                FLAGS_flight_record_dir=flight_dir)
+            procs.append(primary)
+            backup = _spawn("BACKUP", env, PADDLE_CURRENT_ENDPOINT=logical)
+            procs.append(backup)
+            transport.wait_server_ready([logical, backup_phys], timeout=300,
+                                        ready_dir=env["PADDLE_READY_DIR"])
+            # the backup must be a REGISTERED standby before the kill,
+            # or the death window has nobody to promote
+            client = transport.RPCClient(0)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                snap = fetch_snapshot(client, reg_ep)
+                if snap["standbys"].get(logical):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail(f"backup never registered standby: {snap}")
+
+            trainer = _spawn("TRAINER", env, CHAOS_PROGRESS=progress,
+                             DIST_STEPS=str(n_steps))
+            procs.append(trainer)
+            out, err = trainer.communicate(timeout=600)
+            assert trainer.returncode == 0, (
+                "trainer failed:\n" + err.decode()[-2000:])
+            # the fault really fired: primary died hard with exit 137
+            assert primary.wait(timeout=60) == 137
+            prog = json.load(open(progress))
+            assert prog["step"] == n_steps, prog
+
+            # -- loss parity with the no-fault run -----------------------
+            # sync mode, one trainer: the distributed run IS the local
+            # run modulo transport, so the chaos run must match the
+            # local curve closely — the kill cost NO state
+            from dist_model import build
+            local_losses, _ = run_local(
+                n_steps, build_fn=lambda: build(lr=0.05))
+            np.testing.assert_allclose(prog["losses"], local_losses,
+                                       rtol=1e-4, atol=1e-5)
+
+            # -- the flight-recorder note chain --------------------------
+            # 1. the death: the killed primary's dump names the fault
+            dumps = glob.glob(os.path.join(flight_dir, "flight_*.json"))
+            assert dumps, "primary left no flight dump"
+            dump = json.load(open(dumps[0]))
+            kill_notes = [e for e in dump["events"]
+                          if e["msg"] == "fault_kill"]
+            assert kill_notes and kill_notes[0]["target"] == "apply_round"
+            # 2. the promotion: the registry's ordered log
+            promos = registry.service.snapshot()["promotions"]
+            assert len(promos) == 1, promos
+            assert promos[0]["logical"] == logical
+            assert promos[0]["new"] == backup_phys
+            # 3. the re-resolution: the trainer's failover note points
+            # old primary -> promoted backup
+            t_events = _events_of(tmp, "trainer")
+            fails = [e for e in t_events if e["msg"] == "rpc_failover"]
+            assert fails and fails[0]["new"] == backup_phys, t_events
+            assert fails[0]["old"] != backup_phys
+            # ... in order: death before promotion before re-resolution
+            assert kill_notes[0]["ts"] <= promos[0]["ts"] <= fails[0]["ts"]
+            # promoted backup recorded its side of the story too
+            b_events = _events_of(tmp, "backup")
+            assert any(e["msg"] == "heartbeat_promoted" for e in b_events)
+            assert any(e["msg"] == "backup_promoted" for e in b_events)
+            assert backup.wait(timeout=120) == 0  # clean exit after COMPLETE
+        finally:
+            registry.stop()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+
+
+@pytest.mark.chaos_lite
+@retry_flaky()
+def test_master_kill_standby_reissues_lease_table():
+    """Leader master killed mid-lease-handout (fault-injected inside
+    get_task): the standby — which has been mirroring the lease table
+    via REG_SNAPSHOT replay — takes over within a lease term and honors
+    every outstanding lease exactly once: no double-grant, no orphan."""
+    registry = RegistryServer("127.0.0.1:0")
+    registry.start()
+    reg_ep = f"127.0.0.1:{registry.port}"
+    ttl = 0.5
+    stop_file = None
+    leader = None
+    standby = None
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            stop_file = os.path.join(tmp, "stop")
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "FLAGS_pserver_registry": reg_ep,
+                "CHAOS_LEASE_TTL": str(ttl),
+                "CHAOS_LEASE_TIMEOUT": "2.0",
+                "CHAOS_STOP_FILE": stop_file,
+                "CHAOS_EVENTS": os.path.join(tmp, "events"),
+                "PYTHONPATH": os.pathsep.join(
+                    [os.path.dirname(HERE), HERE,
+                     os.environ.get("PYTHONPATH", "")]),
+            }
+            # leader: subprocess armed to die on its 3rd lease handout
+            leader = _spawn(
+                "MASTER", env, PADDLE_CURRENT_ENDPOINT="127.0.0.1:0",
+                CHAOS_CANDIDATE="0",
+                FLAGS_fault_inject="kill_after:lease_grant:n=3")
+            # wait for it to win the initial election
+            client = transport.RPCClient(0)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if resolve(client, reg_ep, MASTER_LOGICAL):
+                    break
+                time.sleep(0.1)
+            else:
+                _, err = leader.communicate(timeout=10)
+                pytest.fail("leader never elected: " + err.decode()[-800:])
+            # standby: in-process, candidate 1, mirroring
+            standby = serve_master_ha("127.0.0.1:0", reg_ep, 1,
+                                      lease_ttl=ttl, lease_timeout=2.0)
+            assert not standby.is_leader
+
+            mc = MasterClient(MASTER_LOGICAL, trainer_id=3,
+                              registry_ep=reg_ep)
+            chunks = [[f"chunk-{i}"] for i in range(6)]
+            mc.set_dataset(chunks)
+
+            # the standby's mirror converges to the leader's table
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if standby.master.state()["todo"] == len(chunks):
+                    break
+                time.sleep(0.1)
+            assert standby.master.state()["todo"] == len(chunks), \
+                "standby never mirrored the dataset"
+
+            processed = []
+            t_kill = None
+            t_takeover = None
+            while True:
+                task = mc.get_task()   # 3rd grant kills the leader
+                # mid-handout; the client fails over to the standby
+                if t_kill is None and leader.poll() is not None:
+                    t_kill = time.monotonic()
+                if t_takeover is None and standby.is_leader:
+                    t_takeover = time.monotonic()
+                if task is None:
+                    st = mc.state()
+                    if st["pending"] == 0 and st["todo"] == 0:
+                        break
+                    time.sleep(0.2)
+                    continue
+                processed.append(task["payload"])
+                mc.task_finished(task["id"])
+
+            assert leader.wait(timeout=30) == 137  # the fault really fired
+            assert standby.is_leader
+            # every chunk processed EXACTLY once — the mid-handout lease
+            # (granted in the dying master's memory, never delivered)
+            # was re-issued to nobody until its timeout requeued it
+            assert sorted(map(tuple, processed)) == \
+                sorted(map(tuple, chunks)), processed
+            st = standby.master.state()
+            assert len(st["done"]) == len(chunks), st
+            assert st["discarded"] == [], st
+            # takeover came within ~a lease term of the death (generous
+            # wall bound for a loaded 1-core CI host)
+            if t_kill is not None and t_takeover is not None:
+                assert t_takeover - t_kill < 30.0
+    finally:
+        if standby is not None:
+            standby.stop()
+        registry.stop()
+        if leader is not None and leader.poll() is None:
+            leader.kill()
+            leader.communicate()
+
+
+@pytest.mark.chaos_lite
+def test_registry_snapshot_replay_mirrors_and_reissues():
+    """Satellite: standby master mirrors leases through REG_SNAPSHOT
+    replay; leader death re-issues the IDENTICAL lease table (same
+    task ids, same owners, nothing duplicated or dropped)."""
+    registry = RegistryServer("127.0.0.1:0")
+    registry.start()
+    reg_ep = f"127.0.0.1:{registry.port}"
+    m0 = m1 = None
+    try:
+        m0 = serve_master_ha("127.0.0.1:0", reg_ep, 0, lease_ttl=0.5,
+                             lease_timeout=5.0)
+        m1 = serve_master_ha("127.0.0.1:0", reg_ep, 1, lease_ttl=0.5,
+                             lease_timeout=5.0)
+        assert m0.is_leader and not m1.is_leader
+
+        mc = MasterClient(MASTER_LOGICAL, trainer_id=4,
+                          registry_ep=reg_ep)
+        mc.set_dataset([[i] for i in range(5)])
+        granted = [mc.get_task() for _ in range(2)]
+        mc.task_finished(granted[0]["id"])
+
+        # standby mirror converges to the leader's exact table
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with m0.master.lock:
+                lead_state = m0.master._state_dict()
+            with m1.master.lock:
+                mirror_state = m1.master._state_dict()
+            if (lead_state["seq"] == mirror_state["seq"]
+                    and lead_state["done"] == mirror_state["done"]):
+                break
+            time.sleep(0.1)
+        assert lead_state["seq"] == mirror_state["seq"], (lead_state,
+                                                          mirror_state)
+        assert lead_state["todo"] == mirror_state["todo"]
+        assert lead_state["pending"] == mirror_state["pending"]
+
+        # dirty leader death (no goodbye): lease expires, standby leads
+        m0.heartbeat._stop.set()
+        m0.server.stop()
+        deadline = time.monotonic() + 15
+        while not m1.is_leader and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert m1.is_leader, "standby never took over"
+        with m1.master.lock:
+            new_state = m1.master._state_dict()
+        # identical lease table: the outstanding lease is still pending
+        # under its original owner; done/todo/failures all carried over
+        assert new_state["done"] == lead_state["done"]
+        assert [e["task"]["id"] for e in new_state["pending"]] == \
+            [e["task"]["id"] for e in lead_state["pending"]]
+        assert [e["owner"] for e in new_state["pending"]] == \
+            [e["owner"] for e in lead_state["pending"]]
+        assert new_state["todo"] == lead_state["todo"]
+        # and the survivors resolve exactly once: finish the leased one,
+        # drain the rest — no id repeats, none lost
+        leased = [e["task"]["id"] for e in new_state["pending"]]
+        for tid in leased:
+            mc.task_finished(tid)
+        seen = list(new_state["done"]) + leased
+        while True:
+            task = mc.get_task()
+            if task is None:
+                break
+            assert task["id"] not in seen, (task, seen)
+            seen.append(task["id"])
+            mc.task_finished(task["id"])
+        assert sorted(seen) == [0, 1, 2, 3, 4]
+    finally:
+        for m in (m0, m1):
+            if m is not None:
+                try:
+                    m.stop()
+                except Exception:
+                    pass
+        registry.stop()
+
+
+def test_heartbeat_goodbye_vs_dirty_exit_under_drops():
+    """Satellite: a clean goodbye removes the lease even when the wire
+    is flaky (deregister rides retry_all), while a goodbye whose REG_SET
+    is dropped hard leaves the lease to age out — i.e. the registry can
+    only ever err toward 'worker looks dead', never toward forgetting a
+    live one.  A dirty exit (bye=False) leaves the lease AND files a
+    dirty_exit note in the flight ring."""
+    from paddle_tpu.observability import flight
+    registry = RegistryServer("127.0.0.1:0")
+    registry.start()
+    reg_ep = f"127.0.0.1:{registry.port}"
+    try:
+        client = transport.RPCClient(0)
+        # clean goodbye under a single injected drop: REG_SET retries
+        # (retry_all) and the lease is gone
+        hb = Heartbeat(reg_ep, "w-clean", "127.0.0.1:9001", ttl=5.0,
+                       role="TRAINER", trainer_id=11)
+        hb.start()
+        assert resolve(client, reg_ep, "w-clean") == "127.0.0.1:9001"
+        faults.inject("drop_conn:reg_set:times=1")
+        try:
+            hb.stop(bye=True)
+        finally:
+            faults.clear()
+        assert resolve(client, reg_ep, "w-clean") is None
+        snap = fetch_snapshot(client, reg_ep)
+        assert "w-clean" not in snap["leases"]
+
+        # dirty exit: lease stays (ages toward SUSPECT/DEAD) and the
+        # flight ring holds the dirty_exit note
+        hb2 = Heartbeat(reg_ep, "w-dirty", "127.0.0.1:9002", ttl=5.0,
+                        role="TRAINER", trainer_id=12)
+        hb2.start()
+        flight.clear_events()
+        hb2.stop(bye=False)
+        assert resolve(client, reg_ep, "w-dirty") == "127.0.0.1:9002"
+        notes = [e for e in flight.events() if e["msg"] == "dirty_exit"]
+        assert notes and "w-dirty" in notes[0]["reason"]
+
+        # goodbye dropped EVERY time: the lease survives (the registry
+        # never saw the bye) — it will age out rather than linger live
+        hb3 = Heartbeat(reg_ep, "w-lost-bye", "127.0.0.1:9003", ttl=0.4,
+                        role="TRAINER", trainer_id=13)
+        hb3.start()
+        faults.inject("drop_conn:reg_set:p=1.0")
+        try:
+            hb3.stop(bye=True)
+        finally:
+            faults.clear()
+        # not deregistered, so it expires on its own TTL clock
+        time.sleep(0.6)
+        assert resolve(client, reg_ep, "w-lost-bye") is None
+    finally:
+        registry.stop()
+
+
+def test_wait_server_ready_retargets_on_promotion():
+    """Satellite: an endpoint that flips backup→promoted-primary while
+    a launcher waits is re-probed at its NEW address immediately (grace
+    restarted) instead of timing out against the dead one, counted in
+    rpc.wait_server.repromotes."""
+    import socket
+    import threading
+    from paddle_tpu.observability import stats as obs_stats
+
+    registry = RegistryServer("127.0.0.1:0")
+    registry.start()
+    reg_ep = f"127.0.0.1:{registry.port}"
+    live = socket.socket()
+    live.bind(("127.0.0.1", 0))
+    live.listen(1)
+    live_ep = f"127.0.0.1:{live.getsockname()[1]}"
+    (dead_port,) = free_ports(1)
+    dead_ep = f"127.0.0.1:{dead_port}"
+    try:
+        client = transport.RPCClient(0)
+        # primary registered at a DEAD address with a short lease
+        register(client, reg_ep, "ps-ha", dead_ep, ttl=0.5)
+        # backup standing by at the LIVE address
+        register(client, reg_ep, "ps-ha", live_ep, ttl=5.0, standby=1)
+        before = obs_stats.counter("rpc.wait_server.repromotes").value
+        # the primary's lease expires mid-wait and the registry promotes
+        # the standby — exactly the backup→promoted-primary flip
+        t0 = time.monotonic()
+        transport.wait_server_ready(["ps-ha"], timeout=30,
+                                    registry_ep=reg_ep, probe_grace=20.0)
+        took = time.monotonic() - t0
+        after = obs_stats.counter("rpc.wait_server.repromotes").value
+        assert after == before + 1, (before, after)
+        # returned via the promoted address well inside the old grace
+        assert took < 20.0, took
+    finally:
+        live.close()
+        registry.stop()
+
+
+@pytest.mark.slow
+@retry_flaky()
+def test_network_flap_during_batch_barrier():
+    """The flap matrix (slow): barriers' connections dropped repeatedly
+    while an HA pair serves — the seq-dedup makes every retry safe and
+    the run still converges to parity with the no-fault run."""
+    n_steps = 10
+    (ps_port, bak_port) = free_ports(2)
+    logical = f"127.0.0.1:{ps_port}"
+    backup_phys = f"127.0.0.1:{bak_port}"
+    registry = RegistryServer("127.0.0.1:0")
+    registry.start()
+    reg_ep = f"127.0.0.1:{registry.port}"
+    with tempfile.TemporaryDirectory() as tmp:
+        progress = os.path.join(tmp, "progress.json")
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_PSERVER_ENDPOINTS": logical,
+            "FLAGS_pserver_registry": reg_ep,
+            "CHAOS_BACKUPS": backup_phys,
+            "CHAOS_LEASE_TTL": "1.0",
+            "CHAOS_EVENTS": os.path.join(tmp, "events"),
+            "PADDLE_READY_DIR": os.path.join(tmp, "ready"),
+            "PYTHONPATH": os.pathsep.join(
+                [os.path.dirname(HERE), HERE,
+                 os.environ.get("PYTHONPATH", "")]),
+        }
+        procs = []
+        try:
+            # the PRIMARY drops the connection on 3 of the trainer's
+            # barriers (every other one up to 3 fires)
+            primary = _spawn(
+                "PSERVER", env, PADDLE_CURRENT_ENDPOINT=logical,
+                FLAGS_fault_inject="drop_conn:batch_barrier:n=2,times=3")
+            procs.append(primary)
+            backup = _spawn("BACKUP", env, PADDLE_CURRENT_ENDPOINT=logical)
+            procs.append(backup)
+            transport.wait_server_ready([logical, backup_phys], timeout=300,
+                                        ready_dir=env["PADDLE_READY_DIR"])
+            trainer = _spawn("TRAINER", env, CHAOS_PROGRESS=progress,
+                             DIST_STEPS=str(n_steps))
+            procs.append(trainer)
+            out, err = trainer.communicate(timeout=600)
+            assert trainer.returncode == 0, (
+                "trainer failed:\n" + err.decode()[-2000:])
+            prog = json.load(open(progress))
+            assert prog["step"] == n_steps
+            from dist_model import build
+            local_losses, _ = run_local(
+                n_steps, build_fn=lambda: build(lr=0.05))
+            np.testing.assert_allclose(prog["losses"], local_losses,
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            registry.stop()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()

@@ -105,3 +105,56 @@ def test_pserver_startup_init_matches_local():
             want = np.asarray(local_scope.find_var(sec.param))[
                 sec.offset:sec.offset + sec.rows]
             np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+def test_backup_config_emits_ha_program():
+    """HA replication config: the primary's listen_and_serv names its
+    backup, the backup program binds the backup address as a standby,
+    trainer barriers carry the ha round-seq attr — and with NO backups
+    configured none of those attrs appear (wire stays PR-5 identical)."""
+    prog, startup, loss = build()
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.backup_endpoints = "127.0.0.1:8164,127.0.0.1:8165"
+    cfg.lease_ttl = 0.7
+    t = fluid.DistributeTranspiler(config=cfg)
+    t.transpile(trainer_id=0, program=prog, pservers=EPS, trainers=1,
+                sync_mode=True, startup_program=startup)
+
+    pp = t.get_pserver_program(t.endpoints[0])
+    ls = pp.global_block.ops[0]
+    assert ls.attr("backup_endpoint") == "127.0.0.1:8164"
+    assert ls.attr("lease_ttl") == 0.7
+    assert not ls.attr("is_backup", False)
+
+    bp = t.get_backup_program(t.endpoints[1])
+    bls = bp.global_block.ops[0]
+    assert bls.attr("is_backup") is True
+    assert bls.attr("bind_endpoint") == "127.0.0.1:8165"
+    assert bls.attr("backup_endpoint") is None
+    assert bls.attr("endpoint") == t.endpoints[1]   # logical identity
+    # identical optimize blocks for the SAME shard: replication replays
+    # through the same executables, so primary and backup evolve in
+    # lockstep
+    pp1 = t.get_pserver_program(t.endpoints[1])
+    ls1 = pp1.global_block.ops[0]
+    assert ls1.attr("grad_to_block_id") == bls.attr("grad_to_block_id")
+    assert len(pp1.blocks) == len(bp.blocks)
+
+    tp = t.get_trainer_program()
+    barriers = [op for op in tp.global_block.ops
+                if op.type == "send_barrier"]
+    assert barriers and barriers[0].attr("ha") is True
+
+    # no backups → no HA attrs anywhere
+    prog2, startup2, _ = build()
+    t2 = fluid.DistributeTranspiler()
+    t2.transpile(trainer_id=0, program=prog2, pservers=EPS, trainers=1,
+                 sync_mode=True, startup_program=startup2)
+    pp2 = t2.get_pserver_program(t2.endpoints[0])
+    assert pp2.global_block.ops[0].attr("backup_endpoint") is None
+    tp2 = t2.get_trainer_program()
+    b2 = [op for op in tp2.global_block.ops if op.type == "send_barrier"]
+    assert b2 and not b2[0].attr("ha", False)
+    import pytest
+    with pytest.raises(ValueError):
+        t2.get_backup_program(t2.endpoints[0])

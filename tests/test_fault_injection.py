@@ -1,0 +1,410 @@
+"""Unit coverage for the fault-injection plane (distributed/faults.py)
+and the registry's HA standby/promotion semantics — the primitives the
+chaos scenarios (test_chaos.py) compose."""
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.core import flags
+from paddle_tpu.distributed import faults, transport
+from paddle_tpu.distributed.registry import (RegistryServer, fetch_health,
+                                             fetch_snapshot, publish_data,
+                                             register, resolve)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+    flags.set_flags({"fault_inject": ""})
+
+
+# -- rule grammar ---------------------------------------------------------
+
+def test_parse_grammar_and_defaults():
+    rules = faults.parse(
+        "drop_conn:send_vars:n=2,p=0.5,times=3;"
+        "delay:get_task:ms=250;"
+        "kill_after:apply_round:n=5;"
+        "refuse_accept::for_s=2.5,side=server")
+    kinds = [r.kind for r in rules]
+    assert kinds == ["drop_conn", "delay", "kill_after", "refuse_accept"]
+    assert rules[0].target == "send_vars" and rules[0].n == 2
+    assert rules[0].p == 0.5 and rules[0].times == 3
+    assert rules[1].ms == 250.0
+    assert rules[2].n == 5
+    assert rules[3].for_s == 2.5 and rules[3].target == ""
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults.parse("explode:everything")
+    with pytest.raises(ValueError):
+        faults.parse("delay:x:bogus_param=1")
+
+
+def test_rule_semantics_n_times_for_s():
+    faults.inject("drop_conn:send_vars:n=2,times=1")
+    assert faults.server_fault("send_vars") is None       # hit 1 < n
+    assert faults.server_fault("send_vars") == "drop_conn"  # fires
+    assert faults.server_fault("send_vars") is None       # times spent
+    assert faults.server_fault("get_vars") is None        # wrong target
+    faults.clear()
+    faults.inject("drop_conn::for_s=0.15")
+    assert faults.server_fault("anything") == "drop_conn"
+    time.sleep(0.2)
+    assert faults.server_fault("anything") is None        # rule expired
+
+
+def test_client_side_requires_explicit_side():
+    faults.inject("drop_conn:reg_set")          # side=any → server hook
+    assert faults.client_fault("reg_set") is None
+    assert faults.server_fault("reg_set") == "drop_conn"
+    faults.clear()
+    faults.inject("drop_conn:reg_set:side=client")
+    assert faults.server_fault("reg_set") is None
+    assert faults.client_fault("reg_set") == "drop_conn"
+
+
+def test_flag_sourced_rules_and_zero_cost_when_unset():
+    assert not faults.active()
+    flags.set_flags({"fault_inject": "delay:x:ms=1"})
+    assert faults.active()
+    assert faults.list_rules()[0]["source"] == "flag"
+    flags.set_flags({"fault_inject": ""})
+    assert not faults.active()
+
+
+def test_injected_drop_severs_a_live_rpc():
+    """End to end through the real transport: a drop_conn rule on the
+    server makes the matching request surface ConnectionError (the
+    at-most-once discipline's path), and the NEXT request succeeds."""
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        client = transport.RPCClient(0)
+        register(client, ep, "k", "10.0.0.1:1", ttl=5.0)
+        faults.inject("drop_conn:reg_get:times=1")
+        # REG_GET is retryable: the drop costs one retry, not an error
+        assert resolve(client, ep, "k") == "10.0.0.1:1"
+        assert [r for r in faults.list_rules()][0]["fires"] == 1
+    finally:
+        faults.clear()
+        srv.stop()
+
+
+def test_chaosz_endpoint_and_cli(tmp_path):
+    from paddle_tpu.observability import debug_server
+    srv = debug_server.start(0)
+    try:
+        ep = srv.address
+        out = subprocess.run(
+            [sys.executable, "tools/chaos.py", "--endpoints", ep,
+             "inject", "delay:get_task:ms=5"],
+            capture_output=True, text=True, cwd=".")
+        assert out.returncode == 0, out.stderr
+        assert faults.active()
+        out = subprocess.run(
+            [sys.executable, "tools/chaos.py", "--endpoints", ep, "list"],
+            capture_output=True, text=True)
+        rules = json.loads(out.stdout)[ep]["rules"]
+        assert rules and rules[0]["kind"] == "delay"
+        out = subprocess.run(
+            [sys.executable, "tools/chaos.py", "--endpoints", ep, "clear"],
+            capture_output=True, text=True)
+        assert json.loads(out.stdout)[ep]["cleared"] == 1
+        assert not faults.active()
+        # malformed spec → 400, reported, nonzero exit
+        out = subprocess.run(
+            [sys.executable, "tools/chaos.py", "--endpoints", ep,
+             "inject", "explode:everything"],
+            capture_output=True, text=True)
+        assert out.returncode == 1
+    finally:
+        debug_server.stop()
+
+
+# -- registry HA semantics ------------------------------------------------
+
+def test_standby_promotion_lowest_id_wins():
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        client = transport.RPCClient(0)
+        register(client, ep, "ps0", "10.0.0.1:7000", ttl=0.4)
+        r = register(client, ep, "ps0", "10.0.0.3:7002", ttl=5.0, standby=2)
+        assert r.get("leader") == "10.0.0.1:7000"
+        register(client, ep, "ps0", "10.0.0.2:7001", ttl=5.0, standby=1)
+        assert resolve(client, ep, "ps0") == "10.0.0.1:7000"
+        time.sleep(0.6)            # primary lease expires
+        assert resolve(client, ep, "ps0") == "10.0.0.2:7001"  # lowest id
+        # the winner learns through its next refresh; the loser stays
+        # a standby for the NEW primary
+        r = register(client, ep, "ps0", "10.0.0.2:7001", ttl=5.0, standby=1)
+        assert r.get("promoted") is True
+        r = register(client, ep, "ps0", "10.0.0.3:7002", ttl=5.0, standby=2)
+        assert r.get("leader") == "10.0.0.2:7001"
+        promos = fetch_snapshot(client, ep)["promotions"]
+        assert [p["new"] for p in promos] == ["10.0.0.2:7001"]
+    finally:
+        srv.stop()
+
+
+def test_plain_standby_never_steals_unclaimed_key():
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        client = transport.RPCClient(0)
+        register(client, ep, "psX", "10.0.0.9:7100", ttl=5.0, standby=0)
+        assert resolve(client, ep, "psX") is None
+        # elect candidates DO win an initial election (master HA)
+        r = register(client, ep, "m", "10.1.0.1:1", ttl=5.0, standby=0,
+                     elect=True)
+        assert r.get("promoted") is True
+        assert resolve(client, ep, "m") == "10.1.0.1:1"
+    finally:
+        srv.stop()
+
+
+def test_zombie_primary_is_fenced_after_promotion():
+    """Split-brain guard: the address deposed by a promotion cannot
+    reclaim the key while the promoted holder is live (it is told
+    'demoted'); a FRESH replacement address still can once the holder
+    itself dies — and the fence lifts when nobody is left."""
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        client = transport.RPCClient(0)
+        register(client, ep, "ps0", "10.0.0.1:7000", ttl=0.4)
+        register(client, ep, "ps0", "10.0.0.2:7001", ttl=0.8, standby=1)
+        time.sleep(0.5)            # primary lease expires → promotion
+        assert resolve(client, ep, "ps0") == "10.0.0.2:7001"
+        # the zombie's re-claim is refused while the backup holds a
+        # live lease...
+        r = register(client, ep, "ps0", "10.0.0.1:7000", ttl=5.0)
+        assert r.get("demoted") is True and r["leader"] == "10.0.0.2:7001"
+        assert resolve(client, ep, "ps0") == "10.0.0.2:7001"
+        # ...but once the promoted holder dies with no standby left,
+        # the fence lifts (better the zombie than nobody)
+        time.sleep(1.0)
+        r = register(client, ep, "ps0", "10.0.0.1:7000", ttl=5.0)
+        assert not r.get("demoted")
+        assert resolve(client, ep, "ps0") == "10.0.0.1:7000"
+    finally:
+        srv.stop()
+
+
+def test_snapshot_data_mirror_and_seq():
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        client = transport.RPCClient(0)
+        s0 = fetch_snapshot(client, ep)["seq"]
+        publish_data(client, ep, "__master__", {"todo": [1, 2]})
+        snap = fetch_snapshot(client, ep)
+        assert snap["seq"] > s0
+        assert snap["data"]["__master__"] == {"todo": [1, 2]}
+        # standby registrations are visible to the snapshot (with their
+        # candidate ids), not to REG_GET
+        register(client, ep, "ps0", "10.0.0.2:7001", ttl=5.0, standby=1)
+        snap = fetch_snapshot(client, ep)
+        assert snap["standbys"]["ps0"]["1"]["endpoint"] == "10.0.0.2:7001"
+    finally:
+        srv.stop()
+
+
+def test_health_view_shows_standby_markers():
+    from paddle_tpu.distributed.registry import Heartbeat
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        client = transport.RPCClient(0)
+        register(client, ep, "ps0", "10.0.0.1:7000", ttl=5.0)
+        hb = Heartbeat(ep, "ps0", "10.0.0.2:7001", ttl=5.0,
+                       role="PSERVER", standby=1)
+        hb.start()
+        health = fetch_health(client, ep)
+        assert health["ps0"]["standby"] == 1
+        hb.stop(bye=True)
+    finally:
+        srv.stop()
+
+
+# -- staleness / zombie fencing (the replication-loss invariants) ---------
+
+def _bare_backup(num_trainers=2, **extra):
+    from paddle_tpu.core.executor import Executor, Scope
+    from paddle_tpu.core.program import Program
+    from paddle_tpu.distributed.ps_ops import PServerLoop
+
+    class _FakeOp:
+        def __init__(self, **attrs):
+            self._attrs = attrs
+
+        def attr(self, name, default=None):
+            return self._attrs.get(name, default)
+
+    op = _FakeOp(sync_mode=True, Fanin=num_trainers, grad_to_block={},
+                 lr_block=-1, lr_fetch=[], dense_merge="mean",
+                 persist_names=[], dist_tables={}, checkpoint_dir=None,
+                 checkpoint_every_rounds=0, endpoint="127.0.0.1:0",
+                 is_backup=True, **extra)
+    return PServerLoop(Executor(), Program(), op, Scope())
+
+
+def _repl_frame(loop, seq, kind="batch_barrier", tid=0):
+    from paddle_tpu.distributed.transport import REPLICATE
+    hdr = json.dumps({"seq": seq, "kind": kind, "tid": tid, "name": ""})
+    return loop.handle(REPLICATE, 0, hdr, b"")
+
+
+def test_promoted_backup_refuses_zombie_replication():
+    """A promoted backup FENCES its deposed peer's stream: a zombie
+    primary that lost its lease but still reaches this address must not
+    keep mutating round/barrier state here (silent divergence)."""
+    loop = _bare_backup()
+    _repl_frame(loop, 0)
+    assert loop.repl_last == 0
+    loop.promote()
+    with pytest.raises(RuntimeError, match="not a backup"):
+        _repl_frame(loop, 1)
+    assert loop.repl_last == 0          # nothing applied past the fence
+
+
+def test_backup_seq_gap_marks_stale_and_withdraws():
+    """A backup that observes an apply-seq gap is missing acknowledged
+    frames FOREVER (no resync protocol): it must withdraw candidacy
+    (on_stale) and refuse the rest of the stream — a promotion here
+    would silently roll trainers back."""
+    loop = _bare_backup()
+    withdrew = []
+    loop.on_stale = lambda: withdrew.append(True)
+    _repl_frame(loop, 0)
+    # exact retransmit (lost-ACK retry) is idempotently ignored
+    assert _repl_frame(loop, 0)[0] == transport.OK
+    assert not loop.stale
+    with pytest.raises(RuntimeError, match="gap"):
+        _repl_frame(loop, 2)
+    assert loop.stale and withdrew == [True]
+    with pytest.raises(RuntimeError, match="stale"):
+        _repl_frame(loop, 3)            # refused even without a gap
+
+
+def test_revoked_standby_is_never_promoted():
+    """The registry is the promotion authority: a primary that lost
+    replication revokes its backup's candidacy there, so the stale
+    replica cannot win the promotion when the primary later dies."""
+    from paddle_tpu.distributed.registry import revoke_standby
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        client = transport.RPCClient(0)
+        register(client, ep, "ps0", "10.0.0.1:7000", ttl=0.4)
+        register(client, ep, "ps0", "10.0.0.2:7001", ttl=5.0, standby=1)
+        revoke_standby(client, ep, "ps0", "10.0.0.2:7001")
+        snap = fetch_snapshot(client, ep)
+        assert snap["revoked"]["ps0"] == ["10.0.0.2:7001"]
+        assert "ps0" not in snap["standbys"]    # candidacy struck NOW
+        time.sleep(0.6)                 # primary lease expires
+        assert resolve(client, ep, "ps0") is None   # nobody promoted
+        # the revoked replica's re-registration is refused for good
+        r = register(client, ep, "ps0", "10.0.0.2:7001", ttl=5.0,
+                     standby=1)
+        assert r.get("revoked") is True
+        assert resolve(client, ep, "ps0") is None
+        # a FRESH (resynced) replacement address still works: file it
+        # under a live primary, then let that primary die
+        register(client, ep, "ps0", "10.0.0.4:7003", ttl=0.3)
+        register(client, ep, "ps0", "10.0.0.3:7002", ttl=5.0, standby=2)
+        time.sleep(0.5)
+        assert resolve(client, ep, "ps0") == "10.0.0.3:7002"
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_withdraw_strikes_own_candidacy():
+    """A gap-fenced backup withdraws ITSELF: the standby entry is struck
+    immediately and future refreshes become health-only (never renewing
+    a candidacy)."""
+    from paddle_tpu.distributed.registry import Heartbeat
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        client = transport.RPCClient(0)
+        register(client, ep, "ps0", "10.0.0.1:7000", ttl=0.5)
+        hb = Heartbeat(ep, "ps0", "10.0.0.2:7001", ttl=5.0,
+                       role="PSERVER", standby=1)
+        hb.start()
+        assert "ps0" in fetch_snapshot(client, ep)["standbys"]
+        hb.withdraw()
+        assert "ps0" not in fetch_snapshot(client, ep).get("standbys", {})
+        hb._register_once()             # observe-mode refresh
+        snap = fetch_snapshot(client, ep)
+        assert "ps0" not in snap.get("standbys", {})
+        time.sleep(0.7)                 # primary dies: nobody promoted
+        assert resolve(client, ep, "ps0") is None
+        # the withdrawn replica keeps its fleet-health presence
+        assert fetch_health(client, ep)["ps0"]["standby"] == 1
+        hb.stop()
+    finally:
+        srv.stop()
+
+
+def test_demoted_master_steps_down_and_rejoins_as_standby():
+    """The deposed-leader fence: when the registry refuses a partitioned
+    leader's re-claim (a standby was promoted over it), the old leader
+    must STOP GRANTING — trainers whose TCP connection to it never
+    failed would otherwise draw leases from the stale table while the
+    new leader re-issues the same ones (double-grant)."""
+    from paddle_tpu.distributed.master import GET_TASK, serve_master_ha
+    srv = RegistryServer("127.0.0.1:0")
+    srv.start()
+    reg_ep = f"127.0.0.1:{srv.port}"
+    m0 = m1 = None
+    try:
+        m0 = serve_master_ha("127.0.0.1:0", reg_ep, 0, lease_ttl=0.4,
+                             lease_timeout=5.0)
+        m1 = serve_master_ha("127.0.0.1:0", reg_ep, 1, lease_ttl=0.4,
+                             lease_timeout=5.0)
+        assert m0.is_leader and not m1.is_leader
+        m0.master.set_dataset([[i] for i in range(3)])
+        assert m0.master.get_task(7) is not None
+        # partition m0 from the registry: its lease expires, m1 leads
+        m0.heartbeat._stop.set()
+        deadline = time.monotonic() + 15
+        while not m1.is_leader and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert m1.is_leader, "standby never took over"
+        assert m0.is_leader             # the zombie still THINKS it leads
+        # partition heals: m0's next refresh is refused ('demoted') and
+        # the step-down fence flips it back to a refusing standby
+        m0.heartbeat._register_once()
+        assert not m0.is_leader
+        rtype, body = m0.master.handle(GET_TASK, 7, "", b"")
+        assert rtype == transport.ERR and b"not the leader" in bytes(body)
+        # and it re-files candidacy under the new leader
+        m0.heartbeat._register_once()
+        snap = fetch_snapshot(transport.RPCClient(0), reg_ep)
+        standbys = snap["standbys"].get("__master__", {})
+        assert any(s["endpoint"] == m0.physical
+                   for s in standbys.values())
+    finally:
+        for m in (m0, m1):
+            if m is not None:
+                try:
+                    m.stop()
+                except Exception:
+                    pass
+        srv.stop()

@@ -1,0 +1,79 @@
+"""Operator CLI for the fault-injection plane: arm/list/clear faults on
+a LIVE fleet through each worker's debug server (``/chaosz``).
+
+Start workers with ``FLAGS_debug_server_port=<port>`` (the PR-2
+observability plane), then:
+
+    # arm a 30%-barrier-drop flap on two pservers for 10 seconds
+    python tools/chaos.py --endpoints 127.0.0.1:8085,127.0.0.1:8086 \
+        inject 'drop_conn:batch_barrier:p=0.3,for_s=10'
+
+    # kill the primary pserver after its 5th applied round
+    python tools/chaos.py --endpoints 127.0.0.1:8085 \
+        inject 'kill_after:apply_round:n=5'
+
+    # what's armed where?
+    python tools/chaos.py --endpoints 127.0.0.1:8085,127.0.0.1:8086 list
+
+    # stand the fleet back up
+    python tools/chaos.py --endpoints 127.0.0.1:8085,127.0.0.1:8086 clear
+
+Rule grammar is documented in ``paddle_tpu/distributed/faults.py``
+(kinds: drop_conn, delay, kill_after, refuse_accept; params n/p/times/
+ms/for_s/side).  Stdlib only — runs on any host that can reach the
+ports, no paddle_tpu import needed.  A worker that cannot be reached is
+reported and skipped (its process may already be a casualty of the
+scenario — that is not this tool's failure).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def _fetch(endpoint: str, query: str, timeout: float) -> dict:
+    url = f"http://{endpoint}/chaosz" + (f"?{query}" if query else "")
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="inject/list/clear chaos faults on a live fleet "
+                    "via the workers' debug servers")
+    ap.add_argument("--endpoints", required=True,
+                    help="comma-separated debug-server host:port list")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_inject = sub.add_parser("inject", help="arm fault rules")
+    p_inject.add_argument("spec", help="rule spec, e.g. "
+                          "'drop_conn:send_vars:p=0.3;delay:get_task:ms=250'")
+    sub.add_parser("list", help="show armed rules per worker")
+    sub.add_parser("clear", help="remove runtime-injected rules")
+    args = ap.parse_args(argv)
+
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    query = ""
+    if args.cmd == "inject":
+        query = "inject=" + urllib.parse.quote(args.spec)
+    elif args.cmd == "clear":
+        query = "clear=1"
+
+    rc = 0
+    out = {}
+    for ep in endpoints:
+        try:
+            out[ep] = _fetch(ep, query, args.timeout)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            out[ep] = {"unreachable": str(e)}
+            rc = 1
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,14 @@ MODULES = [
     # scatter-gather serde): frozen so wire-format/API drift is loud
     "paddle_tpu.distributed.serde",
     "paddle_tpu.distributed.transport",
+    # the HA control plane (standby registration/promotion/REG_SNAPSHOT,
+    # replicated pserver loop, leader-elected master, fault-injection
+    # rule grammar) + its operator CLI: frozen so failover/wire drift
+    # is loud
+    "paddle_tpu.distributed.registry",
+    "paddle_tpu.distributed.master",
+    "paddle_tpu.distributed.faults",
+    "chaos",        # tools/chaos.py (tools/ is on sys.path here)
     "paddle_tpu.parallel",
     "paddle_tpu.inference",
     "paddle_tpu.contrib.trainer",

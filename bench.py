@@ -22,6 +22,16 @@ as a Chrome/Perfetto trace, so the wire spans are inspectable per run.
 Role analogue: the reference benchmark driver emits numbers as it goes
 (benchmark/fluid/fluid_benchmark.py:295 print_train_time), not at exit.
 
+Round 7 adds the perf-attribution chain: each bench_program config AOT
+lower()+compile()s its executable (the same one jax.jit would build) so
+XLA ``cost_analysis()`` flops/bytes land next to the measured rate as a
+``roofline`` entry (achieved vs peak FLOP/s and GB/s,
+compute-vs-memory-bound — observability/perf.py arithmetic), and the
+final summary auto-compares against the last *measured* BENCH_r*.json
+round via tools/bench_compare.py, recording per-config deltas with
+noise bands and a regression verdict under ``comparison``
+(PADDLE_TPU_BENCH_COMPARE_PREV pins a baseline, empty disables).
+
 Primary metric (the BASELINE.json headline): ResNet-50 train images/sec/
 chip (bf16, batch 256) vs an A100 mixed-precision baseline (~2,500
 img/s).  The ``configs`` field carries the other four:
@@ -55,6 +65,39 @@ import numpy as np
 V5E_BF16_PEAK = 197e12
 WARMUP = 3
 STEPS = 12
+
+# set by bench_program from the AOT-compiled executable's XLA
+# cost_analysis + the measured dispatch time; _take_roofline() moves it
+# into the finishing config's result so every BENCH_r*.json throughput
+# number ships with flops/bytes attribution and a roofline position
+_LAST_ROOFLINE = None
+
+
+def _take_roofline():
+    global _LAST_ROOFLINE
+    r, _LAST_ROOFLINE = _LAST_ROOFLINE, None
+    return r
+
+
+def _harvest_roofline(compiled, seconds_per_dispatch):
+    """XLA cost attribution for one timed executable: flops + bytes
+    accessed from ``cost_analysis()`` and the achieved-vs-peak roofline
+    numbers (observability/perf.py arithmetic — per-dispatch flops over
+    per-dispatch seconds, so the K-step scan normalization cancels).
+    Attribution must never take the bench down."""
+    global _LAST_ROOFLINE
+    try:
+        from paddle_tpu.observability import perf as _perf
+        cost = _perf.cost_dict(compiled)
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        bytes_acc = float(cost.get("bytes accessed", 0.0) or 0.0)
+        rf = {"flops_per_dispatch": flops,
+              "bytes_per_dispatch": bytes_acc}
+        rf.update(_perf.roofline_numbers(flops, bytes_acc,
+                                         seconds_per_dispatch))
+        _LAST_ROOFLINE = rf
+    except Exception:
+        _LAST_ROOFLINE = None
 
 
 def two_point_fit(timed):
@@ -133,12 +176,16 @@ def bench_program(prog, startup, feed, fetch_names, steps=STEPS,
                     one, (donated, rng), None, length=K)
                 return ls[-1], donated, rng
 
-            jitted = jax.jit(multi, donate_argnums=(1,))
+            # AOT lower+compile the SAME executable jax.jit would build:
+            # the compiled handle exposes cost_analysis() for the
+            # roofline attribution the summary carries per config
+            compiled = jax.jit(multi, donate_argnums=(1,)).lower(
+                feeds, donated, const, rng).compile()
 
             def step(donated, rng):
-                return jitted(feeds, donated, const, rng)
+                return compiled(feeds, donated, const, rng)
 
-            l, donated, rng = step(donated, rng)  # warmup: compile + K steps
+            l, donated, rng = step(donated, rng)  # warmup: settle + K steps
             float(np.asarray(l))
 
             def timed(n):
@@ -150,12 +197,15 @@ def bench_program(prog, startup, feed, fetch_names, steps=STEPS,
                 float(np.asarray(l))
                 return time.perf_counter() - t0
 
-            return K / two_point_fit(timed)
+            dt = two_point_fit(timed)
+            _harvest_roofline(compiled, dt)
+            return K / dt
 
-        jitted = jax.jit(fn, donate_argnums=(1,))
+        compiled = jax.jit(fn, donate_argnums=(1,)).lower(
+            feeds, donated, const, rng).compile()  # AOT: analyzable handle
 
         def step(donated, rng):
-            fetches, new_state, rng = jitted(feeds, donated, const, rng)
+            fetches, new_state, rng = compiled(feeds, donated, const, rng)
             return fetches[0], [new_state[i] for i in refeed], rng
 
         l = None
@@ -168,6 +218,7 @@ def bench_program(prog, startup, feed, fetch_names, steps=STEPS,
             l, donated, rng = step(donated, rng)
         float(np.asarray(l))
         dt = time.perf_counter() - t0
+        _harvest_roofline(compiled, dt / steps)
     return steps / dt
 
 
@@ -1041,10 +1092,14 @@ def _worker_main(names):
         print("BENCHSTART=" + name, flush=True)
         if _obs is not None:
             _obs.reset()
+        _take_roofline()  # a previous config's attribution must not leak
         try:
             result = fns[name]()
         except Exception as e:  # broken config must not hide the rest
             result = {"error": repr(e)[:200]}
+        rf = _take_roofline()
+        if rf and isinstance(result, dict) and "error" not in result:
+            result.setdefault("roofline", rf)
         print("BENCHRESULT=" + json.dumps({"name": name, "result": result}),
               flush=True)
         if _obs is not None:
@@ -1311,6 +1366,37 @@ def _drain_configs(pending, configs, telemetry, budget_deadline,
             break
 
 
+def _auto_compare(configs):
+    """Regression gate on the freshly completed round: compare against
+    the last round that actually measured something (BENCH_r04 timed
+    out, r05 was all-skip — those are passed over) and record the
+    verdict in the summary JSON (tools/bench_compare.py is also the
+    standalone CI gate).  PADDLE_TPU_BENCH_COMPARE_PREV names a
+    specific baseline; set it empty to disable.  Comparison failures
+    are recorded, never fatal — the measured numbers always land."""
+    import os
+    import sys
+
+    prev = os.environ.get("PADDLE_TPU_BENCH_COMPARE_PREV")
+    if prev == "":
+        return None
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tools"))
+    try:
+        import bench_compare
+        base_path = prev or bench_compare.find_baseline(here)
+        if not base_path:
+            return {"skipped": "no measured baseline round found"}
+        old = bench_compare.load_round(base_path)
+        cmp = bench_compare.compare(old, {"configs": configs})
+        cmp["baseline"] = os.path.basename(base_path)
+        return cmp
+    except Exception as e:
+        return {"error": repr(e)[:200]}
+    finally:
+        sys.path.pop(0)
+
+
 def _emit_summary(configs, telemetry, probe, reprobes, t_start):
     import os
 
@@ -1338,6 +1424,7 @@ def _emit_summary(configs, telemetry, probe, reprobes, t_start):
         1 for v in configs.values()
         if isinstance(v, dict) and not v.get("skipped")
         and not v.get("error") and not v.get("analysis"))
+    comparison = _auto_compare(configs)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": primary,
@@ -1348,6 +1435,7 @@ def _emit_summary(configs, telemetry, probe, reprobes, t_start):
         "measured_configs": measured,
         "elapsed_s": round(time.monotonic() - t_start, 1),
         "step_stats_path": stats_path or None,
+        "comparison": comparison,
         "configs": configs,
     }), flush=True)
 

@@ -32,11 +32,18 @@ from .program import EMPTY_VAR, Program, Variable, default_main_program
 from .selected_rows import SelectedRows
 from .types import np_dtype
 from ..observability import debug_server as _debug_server
+from ..observability import perf as _obs_perf
+from ..observability import runlog as _obs_runlog
 from ..observability import stats as _obs_stats
 from ..observability import step_stats as _obs_step
 from ..observability import trace as _obs_trace
 
 RNG_STATE_VAR = "@RNG_STATE@"
+
+# depth > 0 while _run_segmented drives per-segment inner runs on this
+# thread: those runs suppress their own runlog records (the segmented
+# step logs ONE aggregate record) — thread-local, executors are shared
+_SEGMENT_TLS = threading.local()
 
 _exec_metrics = None
 
@@ -89,6 +96,38 @@ def _em():
     return m
 
 
+_numerics_metrics = None
+
+
+def _nm():
+    """Cached numerics-sentinel metric handles (see ``_em``)."""
+    global _numerics_metrics
+    m = _numerics_metrics
+    if m is None:
+        sc = _obs_stats.scope("numerics")
+        import types as _t
+        m = _t.SimpleNamespace(
+            nan=sc.counter("nan", "variables with NaN values caught by "
+                           "the FLAGS_numerics_check post-step sentinel"),
+            inf=sc.counter("inf", "variables with Inf values caught by "
+                           "the FLAGS_numerics_check post-step sentinel"),
+            checked=sc.counter("checked_steps"),
+        )
+        _numerics_metrics = m
+    return m
+
+
+def _numerics_mode() -> str:
+    """'' (off) / 'warn' / 'fatal' from ``FLAGS_numerics_check``."""
+    try:
+        v = str(_flags.get_flags("numerics_check") or "").strip().lower()
+    except KeyError:  # pragma: no cover - flag always defined
+        return ""
+    if v in ("", "0", "false", "off", "no", "none"):
+        return ""
+    return "fatal" if v == "fatal" else "warn"
+
+
 class _CacheEntry:
     """One compiled-executable cache slot.  ``meta`` memoizes the
     telemetry constants of the executable (program_key string, feed and
@@ -104,7 +143,7 @@ class _CacheEntry:
     compile cost (0.0 for disk hits — no compile was paid)."""
 
     __slots__ = ("plan", "jitted", "meta", "from_disk", "fingerprint",
-                 "aot_ms")
+                 "aot_ms", "perf")
 
     def __init__(self, plan, jitted):
         self.plan = plan
@@ -113,6 +152,9 @@ class _CacheEntry:
         self.from_disk = False
         self.fingerprint = None
         self.aot_ms = None
+        # cost/memory attribution record (observability/perf.py) when
+        # FLAGS_perf_attribution harvested this executable; else None
+        self.perf = None
 
     def __iter__(self):
         # (plan, jitted) unpacking compatibility for cache introspection
@@ -487,7 +529,16 @@ class Executor:
             return self._run_segmented(program, feed, fetch_names, scope, return_numpy)
 
         tel = _obs_trace.flags_on()
-        t_run0 = time.perf_counter_ns() if tel else None
+        rl = _obs_runlog.enabled() and \
+            not getattr(_SEGMENT_TLS, "depth", 0)
+        if rl:
+            # before this dispatch donates buffers: queued records
+            # whose fetches alias persistable state land while those
+            # buffers are still alive (previous dispatch has
+            # typically completed by now, so no blocking)
+            _obs_runlog.drain_pending()
+        pf = _obs_perf.enabled()
+        t_run0 = time.perf_counter_ns() if (tel or rl or pf) else None
 
         feed_names = sorted(feed)
         block = program.global_block
@@ -545,6 +596,15 @@ class Executor:
 
         t0 = time.perf_counter() if _flags.get_flags("benchmark") else None
 
+        nc = _numerics_mode()
+        state_backup = None
+        if nc == "fatal":
+            # the dispatch DONATES the state buffers, so "raise before
+            # the poisoned step applies" needs a pre-step copy to
+            # restore into the scope (fatal is an opt-in debugging
+            # mode; one state copy per step is its price)
+            state_backup = [self._copy_state_val(v) for v in donated_state]
+
         compile_ms = 0.0
         t_disp0 = time.perf_counter_ns() if tel else None
         with _obs_trace.start_span("executor::dispatch", cat="executor",
@@ -569,6 +629,9 @@ class Executor:
                               else (t_disp1 - t_disp0) / 1e6)
             if _obs_trace.enabled():
                 _obs_trace.emit("executor::dispatch", t_disp0, t_disp1)
+
+        self._numerics_guard(nc, state_backup, fetch_names, fetches,
+                             plan, new_state, scope)
 
         for name, val in zip(plan.persist_writes, new_state):
             self._note_state_write(name)
@@ -624,6 +687,22 @@ class Executor:
             self._record_step(entry, key, cache_hit, lowering_ms,
                               compile_ms, feed_vals, fetches, t_run0, plan,
                               donated_state)
+        if pf and entry.perf is not None and t_run0 is not None:
+            # feed the measured wall back into the cost/memory record
+            # (roofline position) and sample the live device-memory
+            # gauges — both ride the FLAGS_perf_attribution opt-in.
+            # A cold step's wall subtracts the one-time lowering/compile
+            # cost so the roofline rates reflect execution, not build
+            _obs_perf.observe_step(
+                entry.perf, self._program_key(key),
+                self._perf_wall_ms(t_run0, cache_hit, lowering_ms,
+                                   compile_ms, entry))
+            _obs_perf.sample_device_memory()
+        if rl:
+            _obs_runlog.log_run(
+                fetch_names, out,
+                wall_ms=(time.perf_counter_ns() - t_run0) / 1e6,
+                batch=_obs_runlog.batch_of(feed_vals))
         return out
 
     def run_steps(
@@ -669,7 +748,16 @@ class Executor:
                 "use run() per step")
 
         tel = _obs_trace.flags_on()
-        t_run0 = time.perf_counter_ns() if tel else None
+        rl = _obs_runlog.enabled() and \
+            not getattr(_SEGMENT_TLS, "depth", 0)
+        if rl:
+            # before this dispatch donates buffers: queued records
+            # whose fetches alias persistable state land while those
+            # buffers are still alive (previous dispatch has
+            # typically completed by now, so no blocking)
+            _obs_runlog.drain_pending()
+        pf = _obs_perf.enabled()
+        t_run0 = time.perf_counter_ns() if (tel or rl or pf) else None
 
         feed_names = sorted(feed)
         block = program.global_block
@@ -718,17 +806,25 @@ class Executor:
                 (stacked, donated_state, const_state, rng), build_fn=build)
             self._cache[key] = entry
             self._evict_cache_overflow()
+            t_low1 = time.perf_counter_ns()
+            # AOT compile time reports as compile_ms, not lowering.
+            # Unconditional like run()'s: _perf_wall_ms subtracts
+            # lowering_ms from cold perf-record walls even when tel off
+            lowering_ms += max(
+                0.0, (t_low1 - t_low0) / 1e6 - (entry.aot_ms or 0.0))
             if tel:
-                t_low1 = time.perf_counter_ns()
-                # AOT compile time reports as compile_ms, not lowering
-                lowering_ms += max(
-                    0.0, (t_low1 - t_low0) / 1e6 - (entry.aot_ms or 0.0))
                 self._note_cache_miss(base, sig)
                 if _obs_trace.enabled():
                     _obs_trace.emit("executor::lower", t_low0, t_low1)
         elif tel:
             _em().hits.inc()
         plan, jitted = entry.plan, entry.jitted
+
+        nc = _numerics_mode()
+        state_backup = None
+        if nc == "fatal":
+            # donation consumes the pre-step buffers; see run()
+            state_backup = [self._copy_state_val(v) for v in donated_state]
 
         compile_ms = 0.0
         t_disp0 = time.perf_counter_ns() if tel else None
@@ -752,6 +848,8 @@ class Executor:
                               else (t_disp1 - t_disp0) / 1e6)
             if _obs_trace.enabled():
                 _obs_trace.emit("executor::dispatch", t_disp0, t_disp1)
+        self._numerics_guard(nc, state_backup, fetch_names, fetches,
+                             plan, new_state, scope)
         for name, val in zip(plan.persist_writes, new_state):
             self._note_state_write(name)
             scope.set_var(name, val)
@@ -765,6 +863,19 @@ class Executor:
             self._record_step(entry, key, cache_hit, lowering_ms,
                               compile_ms, stacked, fetches, t_run0, plan,
                               donated_state)
+        if pf and entry.perf is not None and t_run0 is not None:
+            # dispatch wall covers K steps, and so does the record's
+            # flops/bytes — the roofline rates normalize consistently
+            _obs_perf.observe_step(
+                entry.perf, self._program_key(key),
+                self._perf_wall_ms(t_run0, cache_hit, lowering_ms,
+                                   compile_ms, entry))
+            _obs_perf.sample_device_memory()
+        if rl:
+            _obs_runlog.log_run_steps(
+                fetch_names, out if return_numpy else fetches, K,
+                wall_ms=(time.perf_counter_ns() - t_run0) / 1e6,
+                batch=_obs_runlog.batch_of(stacked, axis=1))
         return out
 
     def _fetch_to_numpy(self, v):
@@ -864,6 +975,8 @@ class Executor:
                 entry.from_disk = True
                 entry.fingerprint = fp
                 entry.aot_ms = 0.0
+                entry.perf = _obs_perf.harvest(compiled, "disk", mode,
+                                               compile_ms=0.0)
                 return entry
             if hydrate_only:
                 return None
@@ -877,15 +990,24 @@ class Executor:
             entry = _CacheEntry(plan, compiled)
             entry.fingerprint = fp
             entry.aot_ms = aot_ms
+            entry.perf = _obs_perf.harvest(compiled, "compile", mode,
+                                           compile_ms=aot_ms)
             return entry
         if hydrate_only:
             return None
         jitted = jax.jit(make(), donate_argnums=(1,))
-        if force_aot:
+        if force_aot or _obs_perf.enabled():
+            # perf attribution needs the compiled handle (cost/memory
+            # analysis lives on jax.stages.Compiled): compile the SAME
+            # executable eagerly instead of at first dispatch.  A
+            # dispatch fault of this AOT entry recovers to a lazy jit
+            # like every other AOT entry (_recover_disk_entry)
             t0 = time.perf_counter_ns()
             jitted = jitted.lower(*args).compile()
             entry = _CacheEntry(plan, jitted)
             entry.aot_ms = (time.perf_counter_ns() - t0) / 1e6
+            entry.perf = _obs_perf.harvest(jitted, "compile", mode,
+                                           compile_ms=entry.aot_ms)
             return entry
         return _CacheEntry(plan, jitted)
 
@@ -1141,6 +1263,42 @@ class Executor:
 
     def _run_segmented(self, program, feed, fetch_names, scope, return_numpy):
         self._refresh_promoted_endpoints()
+        rl = _obs_runlog.enabled()
+        t_seg0 = time.perf_counter_ns() if rl else None
+        backup = None
+        if _numerics_mode() == "fatal":
+            # the per-segment sentinel restore only covers ONE segment's
+            # donated state; 'scope restored intact' needs every
+            # persistable snapshotted before the FIRST segment runs
+            backup = [
+                (v.name, self._copy_state_val(scope.find_var(v.name)))
+                for v in program.global_block.vars.values()
+                if getattr(v, "persistable", False)
+                and scope.find_var(v.name) is not None]
+        _SEGMENT_TLS.depth = getattr(_SEGMENT_TLS, "depth", 0) + 1
+        try:
+            out = self._run_segments(program, feed, fetch_names, scope,
+                                     return_numpy)
+        except FloatingPointError:
+            if backup is not None:
+                for name, val in backup:
+                    scope.set_var(name, val)
+            raise
+        finally:
+            _SEGMENT_TLS.depth -= 1
+        if rl:
+            # ONE record per step: the inner per-segment runs suppressed
+            # theirs (per-segment step_ms/boundary fetches would corrupt
+            # the series), this one carries the user's fetches and the
+            # whole-step wall including host ops
+            _obs_runlog.log_run(
+                fetch_names, out,
+                wall_ms=(time.perf_counter_ns() - t_seg0) / 1e6,
+                batch=_obs_runlog.batch_of(list(feed.values())))
+        return out
+
+    def _run_segments(self, program, feed, fetch_names, scope,
+                      return_numpy):
         segs = self._segment_plan(program, tuple(sorted(feed)), tuple(fetch_names))
         fetched: Dict[str, object] = {}
         # host ops read their inputs from the scope; make fed values visible
@@ -1227,7 +1385,7 @@ class Executor:
             # jax metadata property chains) dominated the cached-run
             # telemetry cost
             nbytes = _obs_step.approx_nbytes
-            meta = (f"{key[0]:x}v{key[1]}:{abs(hash(key)) % (16 ** 8):08x}",
+            meta = (self._program_key(key),
                     sum(nbytes(v) for v in feed_vals),
                     sum(nbytes(v) for v in fetches))
             entry.meta = meta
@@ -1252,6 +1410,111 @@ class Executor:
 
     def _post_step_telemetry(self, ss, plan, donated_state) -> None:
         """Hook for subclasses (ParallelExecutor adds mesh-level stats)."""
+
+    @staticmethod
+    def _perf_wall_ms(t_run0, cache_hit, lowering_ms, compile_ms,
+                      entry) -> float:
+        """Wall time for the perf-record roofline: the full run wall
+        minus the one-time build costs a COLD step paid (lowering,
+        in-dispatch first-call XLA compile, AOT compile) — otherwise a
+        1–2-step run's achieved FLOP/s is dominated by the compile,
+        understating the roofline by orders of magnitude."""
+        wall = (time.perf_counter_ns() - t_run0) / 1e6
+        if not cache_hit:
+            # compile_ms REPORTS entry.aot_ms for AOT entries (see the
+            # dispatch block) — max(), not sum, or it subtracts twice
+            wall -= (lowering_ms or 0.0) + max(compile_ms or 0.0,
+                                               entry.aot_ms or 0.0)
+        return max(wall, 0.0)
+
+    @staticmethod
+    def _program_key(key) -> str:
+        """Short telemetry id of an executable-cache key (the StepStats
+        ``program_key`` and the /profilez record key share it)."""
+        return f"{key[0]:x}v{key[1]}:{abs(hash(key)) % (16 ** 8):08x}"
+
+    # -- numerics sentinel (FLAGS_numerics_check) --------------------------
+    @staticmethod
+    def _copy_state_val(v):
+        """Device copy of one donated-state value (fatal-mode pre-step
+        snapshot — the original buffer is consumed by donation)."""
+        if isinstance(v, SelectedRows):
+            return SelectedRows(jnp.asarray(v.rows).copy(),
+                                jnp.asarray(v.values).copy(), v.height)
+        cp = getattr(v, "copy", None)
+        return cp() if callable(cp) else v
+
+    def _numerics_guard(self, mode: str, state_backup, fetch_names,
+                        fetches, plan, new_state, scope) -> None:
+        """Run the sentinel BEFORE the state writes (run and run_steps
+        share this): a fatal verdict keeps the poisoned post-optimizer
+        state out of the scope — the pre-step copy goes back in, since
+        donation consumed the live buffers."""
+        if not mode:
+            return
+        try:
+            self._check_numerics(fetch_names, fetches,
+                                 plan.persist_writes, new_state, mode)
+        except FloatingPointError:
+            if state_backup is not None:
+                for name, val in zip(plan.donated_reads, state_backup):
+                    scope.set_var(name, val)
+            raise
+
+    def _check_numerics(self, fetch_names, fetches, persist_names,
+                        new_state, mode: str) -> None:
+        """Post-dispatch NaN/Inf sentinel over every float fetch and
+        updated persistable var.  Device-side ``jnp.isnan``/``jnp.isinf``
+        reductions, ONE batched readback of the tiny flags — never a
+        full-tensor host scan (that is FLAGS_check_nan_inf's job).
+
+        Runs BEFORE the state writes: at ``mode='fatal'`` a poisoned
+        step dumps a flight record and raises while the scope still
+        holds the pre-step parameters — the optimizer never applies the
+        poison.  ``mode='warn'`` names the variables, bumps
+        ``numerics.{nan,inf}`` and notes the flight ring, then lets the
+        step land (the counters make a slow-motion blow-up visible
+        without killing a run that might recover)."""
+        names: List[str] = []
+        flags = []
+        seen = set()
+        for name, val in list(zip(fetch_names, fetches)) + \
+                list(zip(persist_names, new_state)):
+            if name in seen:  # a fetched persistable counts once
+                continue
+            v = val.values if isinstance(val, SelectedRows) else val
+            dt = getattr(v, "dtype", None)
+            if dt is None or not jnp.issubdtype(dt, jnp.floating):
+                continue
+            seen.add(name)
+            names.append(name)
+            flags.append(jnp.any(jnp.isnan(v)))
+            flags.append(jnp.any(jnp.isinf(v)))
+        m = _nm()
+        m.checked.inc()
+        if not names:
+            return
+        host = jax.device_get(flags)  # one batched tiny-flag readback
+        nan_vars = [n for n, f in zip(names, host[0::2]) if bool(f)]
+        inf_vars = [n for n, f in zip(names, host[1::2]) if bool(f)]
+        if not nan_vars and not inf_vars:
+            return
+        m.nan.inc(len(nan_vars))
+        m.inf.inc(len(inf_vars))
+        from ..observability import flight as _flight
+        _flight.note("numerics_sentinel", mode=mode,
+                     nan_vars=nan_vars[:16], inf_vars=inf_vars[:16])
+        msg = (f"numerics sentinel (FLAGS_numerics_check={mode}): "
+               f"NaN in {nan_vars or '[]'}, Inf in {inf_vars or '[]'}")
+        if mode == "fatal":
+            # full post-mortem BEFORE the raise (the step's spans and
+            # the poisoned-step note are still in the rings)
+            _flight.dump("numerics_fatal")
+            raise FloatingPointError(
+                msg + " — step NOT applied (the pre-step state snapshot "
+                "is restored into the scope)")
+        import sys as _sys
+        print("[numerics] " + msg, file=_sys.stderr, flush=True)
 
     # -- placement hooks (overridden by ParallelExecutor) ------------------
     def _prepare_program(self, program: Program, feed: Dict) -> Program:

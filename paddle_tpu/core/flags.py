@@ -204,6 +204,49 @@ define_flag("fault_inject", "",
             "byte-identical to the fault-free build.  Runtime injection "
             "against a live fleet goes through the debug server's "
             "/chaosz endpoint (tools/chaos.py)")
+define_flag("perf_attribution", False,
+            "harvest XLA cost_analysis() (flops, bytes accessed) and "
+            "memory_analysis() (argument/output/temp bytes) on every "
+            "executable build (fresh compile, AOT warm start, or "
+            "compile-cache hydrate) into per-executable perf records "
+            "(observability/perf.py), combine them with measured step "
+            "wall time into roofline positions vs the platform peak "
+            "table (platform.PLATFORM_PEAKS), and sample live "
+            "device-memory gauges per step.  Served on /profilez and "
+            "/memz.  Forces ahead-of-time lower().compile() (same "
+            "executable, eager compile) so the compiled handle is "
+            "analyzable; off (default) keeps the lazy-jit path "
+            "byte-identical")
+define_flag("run_log_dir", "",
+            "directory for the append-only run-scalar JSONL log "
+            "(observability/runlog.py): each Executor.run/run_steps "
+            "appends one record per step — step index, wall clock, "
+            "every scalar fetch by name (loss, ...), grad global norm "
+            "over fetched @GRAD vars, step_ms, samples/sec — with "
+            "atomic size-capped rotation.  tools/runlog_report.py "
+            "renders/compares logs.  Empty (default): zero new I/O")
+define_flag("run_log_max_mb", 64,
+            "rotation cap for one run-scalar log file in MB: when an "
+            "append would exceed it, the file atomically rotates into "
+            "a generation chain (<name>.1 newest .. .8 oldest, older "
+            "ages out) and a fresh file starts.  0 = never rotate")
+define_flag("numerics_check", "",
+            "post-step NaN/Inf sentinel (observability plane): after "
+            "each executor dispatch, device-side jnp.isfinite "
+            "reductions over every float fetch and updated persistable "
+            "var are read back as tiny flags (never a full-tensor host "
+            "scan like FLAGS_check_nan_inf).  Offending variables are "
+            "NAMED, numerics.{nan,inf} counters increment, and the "
+            "flight recorder gets a note.  'warn' (or any truthy "
+            "value) logs and continues; 'fatal' dumps a full flight "
+            "record and raises BEFORE the poisoned state is applied "
+            "to the scope (fatal keeps a pre-step device copy of the "
+            "donated state so the scope is restored intact — one "
+            "state copy per step is its price).  Either mode's flag "
+            "readback waits on the dispatch, so with async fetches "
+            "(sync=False) the sentinel serializes each step — the "
+            "cost of a verdict before the next apply.  Empty "
+            "(default) disables the pass")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

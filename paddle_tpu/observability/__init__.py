@@ -21,6 +21,15 @@ Three cooperating pieces (see each module's docstring):
   post-mortem dumps (recent/in-flight spans, events, step tail) to
   ``FLAGS_flight_record_dir`` on unhandled exceptions, SIGTERM and
   dirty exits.
+- :mod:`perf` — the perf/numerics attribution plane
+  (``FLAGS_perf_attribution``): XLA ``cost_analysis``/
+  ``memory_analysis`` per executable, roofline positions vs the
+  platform peak table, live device-memory gauges; served on
+  ``/profilez`` + ``/memz``.
+- :mod:`runlog` — append-only JSONL per-step scalar log
+  (``FLAGS_run_log_dir``): loss/any scalar fetch, grad global norm,
+  step_ms, samples/s, with atomic rotation and a ``watch()`` tail;
+  ``tools/runlog_report.py`` renders/compares.
 
 The export/aggregation half (this package's fleet plane):
 
@@ -43,6 +52,8 @@ from . import (  # noqa: F401
     debug_server,
     flight,
     health,
+    perf,
+    runlog,
     stats,
     step_stats,
     trace,

@@ -21,6 +21,10 @@ Endpoints (all GET):
   queue depths, ...).
 - ``/stepz``    JSON ``observability.export()`` (metrics snapshot +
   step-stats summary/tail).
+- ``/memz``     live device-memory snapshot (PJRT ``memory_stats()``
+  per device + host RSS); ``/profilez`` the per-executable XLA
+  cost/memory attribution records with roofline positions
+  (:mod:`perf`).  Both JSON by default, ``?text=1`` human text.
 
 Built on stdlib ``http.server`` (ThreadingHTTPServer, daemon threads):
 no new dependencies, safe to leave running in tests and serving
@@ -179,6 +183,24 @@ class _Handler(BaseHTTPRequestHandler):
                             "application/json")
             elif path == "/tracez":
                 self._reply(200, self._tracez(query), "application/json")
+            elif path in ("/memz", "/profilez"):
+                # the perf/numerics plane (observability/perf.py): live
+                # device-memory stats and per-executable cost/memory
+                # attribution + rooflines.  JSON by default, ?text=1 for
+                # the human rendering (tools/dump_metrics.py --memz /
+                # --profilez is the operator CLI)
+                from urllib.parse import parse_qs
+                from . import perf as _perf
+                q = parse_qs(query)
+                text = q.get("text", ["0"])[0] not in ("0", "", "false")
+                if path == "/memz":
+                    body = (_perf.memz_text() if text
+                            else json.dumps(_perf.memz(), indent=2))
+                else:
+                    body = (_perf.profilez_text() if text
+                            else json.dumps(_perf.profilez(), indent=2))
+                self._reply(200, body,
+                            "text/plain" if text else "application/json")
             elif path == "/chaosz":
                 # fault-injection control plane (distributed/faults.py):
                 # ?inject=<spec> arms rules, ?clear=1 removes runtime
@@ -209,6 +231,7 @@ class _Handler(BaseHTTPRequestHandler):
                      "/metrics  /healthz  /statusz  /stepz",
                      "/tracez  (?raw=1 span snapshot, ?recent=1 flight "
                      "recorder)",
+                     "/memz  /profilez  (?text=1 human rendering)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),
                     "text/plain")
             else:

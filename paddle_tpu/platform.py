@@ -156,6 +156,76 @@ class DeviceContextPool:
         return self._ctxs[place]
 
 
+# ---------------------------------------------------------------------------
+# Platform peak table (observability/perf.py rooflines)
+# ---------------------------------------------------------------------------
+# device_kind substring (lowercased, spaces stripped) → (dense bf16 peak
+# FLOP/s, HBM bandwidth bytes/s).  Vendor datasheet numbers for TPU
+# generations; the "cpu" row is a NOMINAL host envelope (labeled
+# nominal=True in platform_peaks) so rooflines still compute on the CPU
+# backend dev loop — positions there are relative, not absolute.
+PLATFORM_PEAKS: Dict[str, tuple] = {
+    "v6": (918e12, 1640e9),       # Trillium
+    "v5p": (459e12, 2765e9),
+    "v5e": (197e12, 819e9),
+    "v5lite": (197e12, 819e9),    # "TPU v5 lite" device_kind spelling
+    "v4": (275e12, 1228e9),
+    "v3": (123e12, 900e9),
+    "v2": (46e12, 700e9),
+}
+_CPU_NOMINAL_PEAKS = (0.5e12, 50e9)
+
+
+def platform_peaks(device=None) -> dict:
+    """Peak FLOP/s + HBM bytes/s for ``device`` (default: first local
+    device) from :data:`PLATFORM_PEAKS`; ``{"flops": None, ...}`` when
+    the device kind is unknown (rooflines then report intensity only)."""
+    if device is None:
+        devs = jax.local_devices()
+        if not devs:
+            return {"device_kind": "none", "platform": "none",
+                    "flops": None, "hbm_bytes_per_s": None}
+        device = devs[0]
+    kind = str(getattr(device, "device_kind", "") or "")
+    plat = str(getattr(device, "platform", "") or "")
+    norm = kind.lower().replace(" ", "").replace("-", "")
+    out = {"device_kind": kind, "platform": plat,
+           "flops": None, "hbm_bytes_per_s": None, "nominal": False}
+    for tag, (fl, bw) in PLATFORM_PEAKS.items():
+        if tag in norm:
+            out["flops"], out["hbm_bytes_per_s"] = fl, bw
+            return out
+    if plat == "cpu":
+        out["flops"], out["hbm_bytes_per_s"] = _CPU_NOMINAL_PEAKS
+        out["nominal"] = True
+    return out
+
+
+def device_inventory() -> dict:
+    """Hardware card for /statusz: platform, device kind/count, and the
+    per-device memory limit — so fleet dashboards can label perf series
+    by what they ran on.  Never raises (an uninitializable backend
+    reports as an error field)."""
+    try:
+        devs = jax.local_devices()
+    except Exception as e:  # pragma: no cover - backend init failure
+        return {"error": repr(e)[:200]}
+    out = {"platform": devs[0].platform if devs else "none",
+           "device_count": len(jax.devices()),
+           "local_device_count": len(devs),
+           "devices": []}
+    for d in devs:
+        rec = {"id": d.id, "kind": str(getattr(d, "device_kind", "")),
+               "process_index": getattr(d, "process_index", 0)}
+        try:
+            ms = d.memory_stats() if hasattr(d, "memory_stats") else None
+        except Exception:
+            ms = None
+        rec["memory_limit_bytes"] = (ms or {}).get("bytes_limit")
+        out["devices"].append(rec)
+    return out
+
+
 def device_count() -> int:
     """Visible accelerator count (init.cc device discovery)."""
     return len(jax.devices())

@@ -187,6 +187,58 @@ CONFIG_TABLE = [
         assert key in summ
 
 
+def test_auto_compare_records_verdict_in_summary(tmp_path):
+    """A completed round auto-compares against the pinned (or newest
+    measured) baseline via tools/bench_compare.py and records the
+    per-config deltas + verdict under ``comparison`` in the summary
+    JSON — the regression gate rides every future BENCH_r*.json."""
+    table = """
+def fast():
+    return {"images_per_sec": 80.0}
+
+
+def steady():
+    return {"tokens_per_sec": 1010.0}
+
+
+CONFIG_TABLE = [
+    ("fast", fast, 60, True),
+    ("steady", steady, 60, True),
+]
+"""
+    baseline = {
+        "metric": "x", "value": 1.0,
+        "configs": {"fast": {"images_per_sec": 100.0},
+                    "steady": {"tokens_per_sec": 1000.0}}}
+    base = tmp_path / "BENCH_prev.json"
+    base.write_text(json.dumps(baseline))
+    partials, final = _run_bench(
+        tmp_path, table, {"PADDLE_TPU_BENCH_COMPARE_PREV": str(base)})
+    cmp = final["comparison"]
+    assert cmp["baseline"] == "BENCH_prev.json"
+    assert cmp["verdict"] == "regression"          # fast fell 20%
+    assert cmp["configs"]["fast"]["status"] == "regression"
+    assert cmp["configs"]["fast"]["delta"] == -0.2
+    assert cmp["configs"]["steady"]["status"] == "within_noise"
+
+
+def test_auto_compare_empty_env_disables(tmp_path):
+    """PADDLE_TPU_BENCH_COMPARE_PREV= (empty) opts the round out of the
+    auto-comparison entirely — comparison is null, never an error."""
+    table = """
+def ok():
+    return {"images_per_sec": 5.0}
+
+
+CONFIG_TABLE = [
+    ("ok", ok, 60, True),
+]
+"""
+    partials, final = _run_bench(
+        tmp_path, table, {"PADDLE_TPU_BENCH_COMPARE_PREV": ""})
+    assert final["comparison"] is None
+
+
 def test_scan_driver_matches_eager_steps():
     import bench
     import paddle_tpu as fluid

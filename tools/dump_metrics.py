@@ -11,6 +11,9 @@ Operator companion to ``paddle_tpu/observability/debug_server.py``
     python tools/dump_metrics.py 8085 --tracez        # Chrome trace json
     python tools/dump_metrics.py 8085 --tracez --raw  # span snapshot
     python tools/dump_metrics.py 8085 --flight        # flight recorder
+    python tools/dump_metrics.py 8085 --memz          # device memory
+    python tools/dump_metrics.py 8085 --profilez      # cost/roofline
+    python tools/dump_metrics.py 8085 --memz --text   # human rendering
 
 JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
 through (optionally filtered with ``--grep``) so the output pastes
@@ -19,7 +22,10 @@ worker's span ring as a directly-loadable Chrome/Perfetto trace (add
 ``--raw`` for the snapshot form ``tools/stitch_trace.py`` merges);
 ``--flight`` fetches the live flight-recorder view
 (``/tracez?recent=1`` — recent + in-flight spans, log events, step
-tail).  Stdlib only — runs on any host that can reach the port, no
+tail); ``--memz`` / ``--profilez`` pull the perf plane (live
+device-memory stats; per-executable XLA cost/memory attribution with
+roofline positions), JSON by default, ``--text`` for the human
+rendering.  Stdlib only — runs on any host that can reach the port, no
 paddle_tpu import needed.
 """
 from __future__ import annotations
@@ -68,6 +74,14 @@ def main(argv=None) -> int:
     ap.add_argument("--flight", action="store_true",
                     help="fetch the live flight-recorder view "
                          "(/tracez?recent=1)")
+    ap.add_argument("--memz", action="store_true",
+                    help="fetch the live device-memory snapshot (/memz)")
+    ap.add_argument("--profilez", action="store_true",
+                    help="fetch the perf-attribution records + "
+                         "rooflines (/profilez)")
+    ap.add_argument("--text", action="store_true",
+                    help="with --memz/--profilez: the human text "
+                         "rendering (?text=1) instead of JSON")
     ap.add_argument("port", type=int,
                     help="the worker's FLAGS_debug_server_port")
     ap.add_argument("pages", nargs="*", default=list(DEFAULT_PAGES),
@@ -76,12 +90,17 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     rc = 0
-    if args.tracez or args.flight:
+    if args.tracez or args.flight or args.memz or args.profilez:
         pages = []
         if args.tracez:
             pages.append("tracez?raw=1" if args.raw else "tracez")
         if args.flight:
             pages.append("tracez?recent=1")
+        suffix = "?text=1" if args.text else ""
+        if args.memz:
+            pages.append("memz" + suffix)
+        if args.profilez:
+            pages.append("profilez" + suffix)
         for page in pages:
             try:
                 body = fetch(args.host, args.port, page,

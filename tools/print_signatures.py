@@ -41,6 +41,13 @@ MODULES = [
     # drift in the trace layer is loud
     "paddle_tpu.observability.trace",
     "paddle_tpu.observability.flight",
+    # the perf/numerics attribution plane (cost/memory records,
+    # rooflines, device-memory sampling, run-scalar log) + its operator
+    # CLIs: frozen so record/log-format drift is loud
+    "paddle_tpu.observability.perf",
+    "paddle_tpu.observability.runlog",
+    "bench_compare",   # tools/bench_compare.py (tools/ on sys.path here)
+    "runlog_report",   # tools/runlog_report.py
     "paddle_tpu.lod_tensor",
     "paddle_tpu.transpiler",
     "paddle_tpu.data_feeder",

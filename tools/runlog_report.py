@@ -1,0 +1,226 @@
+"""Render / compare run-scalar logs (observability/runlog.py JSONL).
+
+Operator companion to ``FLAGS_run_log_dir``: every ``Executor.run`` /
+``run_steps`` appends one JSON object per step (scalar fetches by name,
+grad global norm, step_ms, samples/sec).  This tool turns those files
+into something a human or a dashboard ingests:
+
+    python tools/runlog_report.py run_1234.jsonl             # text summary
+    python tools/runlog_report.py run_1234.jsonl --csv       # CSV to stdout
+    python tools/runlog_report.py a.jsonl --compare b.jsonl  # two-run diff
+    python tools/runlog_report.py run_1234.jsonl --json      # summary JSON
+
+The summary reports, per scalar series: first/last/min/max/mean and a
+non-finite count (a NaN'd loss is loud even without the executor's
+numerics sentinel armed).  ``--compare`` lines up two runs by step
+index and reports final-value deltas per shared scalar plus step-time
+and throughput ratios — the "did my change speed it up or break
+convergence" question in one command.
+
+Stdlib only — runs anywhere the log files are readable, no paddle_tpu
+import needed.  Exit code: 0 on success, 2 when a log cannot be read
+or holds no records.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import math
+import sys
+from typing import Dict, List, Optional
+
+# the frozen surface (tools/api_spec.txt): like cache_admin, the spec
+# generator only sees functions listed here for non-package modules
+__all__ = ["load", "summarize", "render_text", "write_csv", "compare",
+           "render_compare", "main"]
+
+
+def load(path: str) -> List[dict]:
+    """Parse one JSONL run log; torn/blank lines are skipped (a live
+    writer may be racing us at a rotation boundary)."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _series_stats(vals: List[float]) -> dict:
+    finite = [v for v in vals if isinstance(v, (int, float))
+              and math.isfinite(v)]
+    out = {
+        "n": len(vals),
+        "nonfinite": len(vals) - len(finite),
+        "first": vals[0] if vals else None,
+        "last": vals[-1] if vals else None,
+    }
+    if finite:
+        out["min"] = min(finite)
+        out["max"] = max(finite)
+        out["mean"] = sum(finite) / len(finite)
+    return out
+
+
+def summarize(records: List[dict]) -> dict:
+    """Aggregate one run: step span, wall span, step-time / throughput
+    means, per-scalar series stats, grad-norm series stats."""
+    steps = [r.get("step") for r in records if r.get("step") is not None]
+    tss = [r.get("ts") for r in records if isinstance(r.get("ts"),
+                                                     (int, float))]
+    scalars: Dict[str, List[float]] = {}
+    for r in records:
+        for name, v in (r.get("scalars") or {}).items():
+            scalars.setdefault(name, []).append(v)
+    out = {
+        "records": len(records),
+        "step_first": min(steps) if steps else None,
+        "step_last": max(steps) if steps else None,
+        "wall_span_s": round(max(tss) - min(tss), 3) if len(tss) > 1 else 0.0,
+        "step_ms": _series_stats(
+            [r["step_ms"] for r in records if "step_ms" in r]),
+        "samples_per_sec": _series_stats(
+            [r["samples_per_sec"] for r in records
+             if "samples_per_sec" in r]),
+        "grad_global_norm": _series_stats(
+            [r["grad_global_norm"] for r in records
+             if "grad_global_norm" in r]),
+        "scalars": {name: _series_stats(vals)
+                    for name, vals in sorted(scalars.items())},
+    }
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render_text(summary: dict, label: str = "") -> str:
+    lines = [f"run log{' ' + label if label else ''}: "
+             f"{summary['records']} records, steps "
+             f"{_fmt(summary['step_first'])}..{_fmt(summary['step_last'])}, "
+             f"{_fmt(summary['wall_span_s'])} s wall"]
+    for key in ("step_ms", "samples_per_sec", "grad_global_norm"):
+        st = summary[key]
+        if st["n"]:
+            lines.append(
+                f"  {key}: mean={_fmt(st.get('mean'))} "
+                f"min={_fmt(st.get('min'))} max={_fmt(st.get('max'))} "
+                f"last={_fmt(st['last'])}")
+    for name, st in summary["scalars"].items():
+        nf = f"  NONFINITE={st['nonfinite']}" if st["nonfinite"] else ""
+        lines.append(
+            f"  scalar {name}: first={_fmt(st['first'])} "
+            f"last={_fmt(st['last'])} min={_fmt(st.get('min'))} "
+            f"max={_fmt(st.get('max'))}{nf}")
+    return "\n".join(lines) + "\n"
+
+
+def write_csv(records: List[dict], fh) -> None:
+    """Flat CSV: fixed columns + one column per scalar name seen."""
+    names = sorted({n for r in records
+                    for n in (r.get("scalars") or {})})
+    w = csv.writer(fh)
+    w.writerow(["step", "ts", "step_ms", "samples_per_sec",
+                "grad_global_norm"] + names)
+    for r in records:
+        sc = r.get("scalars") or {}
+        w.writerow([r.get("step"), r.get("ts"), r.get("step_ms"),
+                    r.get("samples_per_sec"), r.get("grad_global_norm")]
+                   + [sc.get(n) for n in names])
+
+
+def compare(a: List[dict], b: List[dict]) -> dict:
+    """Two-run diff: final-value delta per shared scalar + step-time /
+    throughput ratios (b relative to a)."""
+    sa, sb = summarize(a), summarize(b)
+    out = {"a": {"records": sa["records"]}, "b": {"records": sb["records"]},
+           "scalars": {}}
+    for name in sorted(set(sa["scalars"]) & set(sb["scalars"])):
+        fa = sa["scalars"][name]["last"]
+        fb = sb["scalars"][name]["last"]
+        ent = {"a_last": fa, "b_last": fb}
+        if isinstance(fa, (int, float)) and isinstance(fb, (int, float)) \
+                and math.isfinite(fa) and math.isfinite(fb):
+            ent["delta"] = fb - fa
+        out["scalars"][name] = ent
+    for key in ("step_ms", "samples_per_sec"):
+        ma = sa[key].get("mean")
+        mb = sb[key].get("mean")
+        if ma and mb:
+            out[key + "_ratio"] = round(mb / ma, 4)
+    return out
+
+
+def render_compare(cmp: dict) -> str:
+    lines = [f"compare: a={cmp['a']['records']} records, "
+             f"b={cmp['b']['records']} records"]
+    for key in ("step_ms_ratio", "samples_per_sec_ratio"):
+        if key in cmp:
+            lines.append(f"  {key.replace('_ratio', '')} b/a: {cmp[key]}")
+    for name, ent in cmp["scalars"].items():
+        delta = f" delta={_fmt(ent['delta'])}" if "delta" in ent else ""
+        lines.append(f"  scalar {name}: a_last={_fmt(ent['a_last'])} "
+                     f"b_last={_fmt(ent['b_last'])}{delta}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render / compare run-scalar JSONL logs "
+                    "(FLAGS_run_log_dir)")
+    ap.add_argument("log", help="run log JSONL path")
+    ap.add_argument("--compare", metavar="OTHER",
+                    help="second log: report final-value deltas and "
+                         "step-time/throughput ratios (OTHER vs LOG)")
+    ap.add_argument("--csv", action="store_true",
+                    help="emit the records as CSV instead of a summary")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary (or comparison) as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        records = load(args.log)
+    except OSError as e:
+        print(f"cannot read {args.log}: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"no records in {args.log}", file=sys.stderr)
+        return 2
+
+    if args.csv:
+        write_csv(records, sys.stdout)
+        return 0
+    if args.compare:
+        try:
+            other = load(args.compare)
+        except OSError as e:
+            print(f"cannot read {args.compare}: {e}", file=sys.stderr)
+            return 2
+        if not other:
+            print(f"no records in {args.compare}", file=sys.stderr)
+            return 2
+        cmp = compare(records, other)
+        sys.stdout.write(json.dumps(cmp, indent=2) + "\n" if args.json
+                         else render_compare(cmp))
+        return 0
+    summary = summarize(records)
+    sys.stdout.write(json.dumps(summary, indent=2) + "\n" if args.json
+                     else render_text(summary, label=args.log))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1062,7 +1062,10 @@ class Executor:
         ``feed_specs`` maps feed names to shape tuples, ``(shape,
         dtype)`` pairs (shape itself a tuple/list), numpy/jax arrays,
         or ``jax.ShapeDtypeStruct``s — only shape/dtype are read, no
-        feed data is needed.  Shapes must be concrete.  Names are the
+        feed data is needed.  A LIST of such dicts warms one executable
+        per entry (the serving plane precompiles a whole batch-size
+        bucket ladder this way); the returned counts aggregate over
+        all of them.  Shapes must be concrete.  Names are the
         post-expansion feed names (a LoD feed contributes its padded
         array plus the ``<name>@LEN`` length vector).  The scope must
         already hold the program's persistable state (run the startup
@@ -1085,6 +1088,20 @@ class Executor:
         """
         program = program if program is not None else default_main_program()
         scope = scope or global_scope()
+        if isinstance(feed_specs, (list, tuple)):
+            # one warm per spec-set (a serving bucket ladder): aggregate
+            # the counts, keep every skip reason
+            agg = {"segments": 0, "warmed": 0, "persistent_hits": 0,
+                   "compiled": 0, "skipped": [], "ms": 0.0}
+            for fs in feed_specs:
+                one = self.warm_start(program, fs, fetch_list, scope,
+                                      hydrate_only=hydrate_only)
+                for k in ("segments", "warmed", "persistent_hits",
+                          "compiled"):
+                    agg[k] += one[k]
+                agg["skipped"].extend(one["skipped"])
+                agg["ms"] = round(agg["ms"] + one["ms"], 3)
+            return agg
         feed_specs = dict(feed_specs or {})
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in (fetch_list or [])]

@@ -247,6 +247,29 @@ define_flag("numerics_check", "",
             "(sync=False) the sentinel serializes each step — the "
             "cost of a verdict before the next apply.  Empty "
             "(default) disables the pass")
+define_flag("serving_buckets", "1,2,4,8,16,32",
+            "batch-size bucket ladder for the model-serving plane "
+            "(paddle_tpu/serving): concurrent requests coalesce into "
+            "padded batches snapped to the smallest bucket that fits, "
+            "so a handful of warmed executables cover all traffic and "
+            "no dispatch ever recompiles.  Per-model override via "
+            "DynamicBatcher(buckets=...) / ModelManager.load(buckets=...)")
+define_flag("serving_max_queue_delay_ms", 5.0,
+            "continuous-batching dispatch SLO: a queued request waits at "
+            "most this long for more requests to coalesce before its "
+            "(possibly partial, padded) batch dispatches.  Lower = "
+            "latency-biased, higher = occupancy-biased")
+define_flag("serving_max_queue_rows", 1024,
+            "admission-control bound on a model's request queue in ROWS "
+            "(sum of queued request batch sizes): past it, new requests "
+            "are shed immediately with a typed Overloaded reply instead "
+            "of queueing into timeout (counted in serving.<model>.shed)")
+define_flag("serving_queue_delay_slo_ms", 0.0,
+            "optional queue-delay SLO for admission control: when "
+            "backlog x observed per-batch service time says a new "
+            "request cannot be answered within this many ms, it is shed "
+            "with a typed Overloaded reply.  0 (default) disables the "
+            "estimate — only the serving_max_queue_rows bound sheds")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

@@ -24,9 +24,32 @@ class AnalysisConfig:
         self.ir_optim = True
         self._passes = ["fuse_fc_lstm", "fuse_fc_gru",
                         "fuse_conv_bn", "fuse_fc_act"]
+        # warm-start wiring (serving plane): batch sizes to AOT-warm at
+        # create_predictor time when FLAGS_compile_cache_dir is set, so
+        # a served model's first request never pays an XLA compile
+        # (Executor.warm_start; counted via executor.persistent_hits).
+        # None (default) keeps create_predictor byte-identical.
+        self.warm_start_batch_sizes: Optional[List[int]] = None
+        self._warm_sample_shapes: Optional[Dict[str, tuple]] = None
 
     def set_model(self, model_dir: str) -> None:
         self.model_dir = model_dir
+
+    def set_warm_start(self, batch_sizes,
+                       sample_shapes: Optional[Dict[str, tuple]] = None
+                       ) -> None:
+        """Ask ``create_predictor`` to precompile one executable per
+        batch size (specs derived from the program's static feed
+        declarations; ``sample_shapes`` overrides feeds whose non-batch
+        dims are symbolic, e.g. padded sequence models).  Effective
+        only with the persistent compile cache enabled
+        (``FLAGS_compile_cache_dir``) — without it the first request
+        would pay the same compile either way and cold create stays
+        cheap."""
+        self.warm_start_batch_sizes = [int(b) for b in batch_sizes]
+        self._warm_sample_shapes = (
+            {k: tuple(v) for k, v in sample_shapes.items()}
+            if sample_shapes else None)
 
     def switch_ir_optim(self, flag: bool = True) -> None:
         self.ir_optim = flag
@@ -72,6 +95,47 @@ class Predictor:
                                  fetch_list=self._fetch_names,
                                  scope=self._scope)
 
+    # -- warm start (serving plane / persistent compile cache) ------------
+    def warm_start(self, feed_specs, hydrate_only: bool = False) -> dict:
+        """AOT-precompile this predictor's executables before the first
+        request (``Executor.warm_start``): ``feed_specs`` is one
+        name→spec dict, or a LIST of them (a serving bucket ladder —
+        one executable per batch size).  With
+        ``FLAGS_compile_cache_dir`` set, warm entries hydrate from /
+        store to the persistent cache, so a redeployed server compiles
+        nothing (executor.persistent_hits counts the wins)."""
+        with self._lock:
+            return self._exe.warm_start(self._program, feed_specs,
+                                        self._fetch_names,
+                                        scope=self._scope,
+                                        hydrate_only=hydrate_only)
+
+    def feed_specs_for_batch(self, batch_size: int,
+                             sample_shapes: Optional[Dict] = None) -> Dict:
+        """One warm_start spec dict at ``batch_size``, shapes from the
+        program's static feed declarations (``(-1, *sample)``);
+        ``sample_shapes`` fills feeds with symbolic non-batch dims."""
+        block = self._program.global_block
+        specs = {}
+        for n in self._feed_names:
+            var = block.var_or_none(n)
+            dtype = (var.dtype if var is not None and var.dtype is not None
+                     else "float32")
+            if sample_shapes and n in sample_shapes:
+                sample = tuple(int(s) for s in sample_shapes[n])
+            else:
+                if var is None or var.shape is None:
+                    raise ValueError(
+                        f"feed {n!r} has no static declaration; pass "
+                        "sample_shapes")
+                sample = tuple(var.shape[1:])
+                if any(s < 0 for s in sample):
+                    raise ValueError(
+                        f"feed {n!r} declares symbolic dims {var.shape}; "
+                        "pass sample_shapes with the served padded shape")
+            specs[n] = ((int(batch_size),) + sample, dtype)
+        return specs
+
     # -- PaddlePredictor::Clone -------------------------------------------
     def clone(self) -> "Predictor":
         """Same program + shared weights, own executable cache — safe to
@@ -110,8 +174,20 @@ def create_predictor(config: AnalysisConfig) -> Predictor:
     for name in config.pass_names():
         # fetch targets count as external uses: never fused away/rewritten
         getattr(P, name)(program, scope, keep_vars=fetch_names)
-    return Predictor(program, feed_names, [v.name for v in fetch_vars],
+    pred = Predictor(program, feed_names, [v.name for v in fetch_vars],
                      scope)
+    if config.warm_start_batch_sizes:
+        from ..core import compile_cache as _compile_cache
+        if _compile_cache.enabled():
+            # persistent-cache warm start: a redeployed/served model's
+            # first request hydrates AOT executables from disk instead
+            # of paying the XLA compile (executor.persistent_hits);
+            # with the cache cold this stores them for the next process.
+            # Flag unset: skipped — create_predictor stays byte-identical
+            pred.warm_start([
+                pred.feed_specs_for_batch(b, config._warm_sample_shapes)
+                for b in config.warm_start_batch_sizes])
+    return pred
 
 
 create_paddle_predictor = create_predictor

@@ -25,6 +25,9 @@ Endpoints (all GET):
   per device + host RSS); ``/profilez`` the per-executable XLA
   cost/memory attribution records with roofline positions
   (:mod:`perf`).  Both JSON by default, ``?text=1`` human text.
+- ``/servingz`` the model-serving plane (``paddle_tpu/serving``): per
+  in-process ModelServer, the version router plus per-model QPS,
+  queue-depth, batch-occupancy, shed and latency-percentile gauges.
 
 Built on stdlib ``http.server`` (ThreadingHTTPServer, daemon threads):
 no new dependencies, safe to leave running in tests and serving
@@ -52,6 +55,9 @@ _server: Optional["DebugServer"] = None
 _providers: Dict[str, Callable[[], object]] = {}
 _role: Optional[str] = None
 _aggregator = None  # duck-typed: anything with .to_prometheus_text()
+# /servingz sources: one per in-process ModelServer (keyed by its
+# endpoint), each fn() returning that server's router + model gauges
+_servingz: Dict[str, Callable[[], object]] = {}
 
 
 def register_provider(name: str, fn: Callable[[], object]) -> None:
@@ -64,6 +70,32 @@ def register_provider(name: str, fn: Callable[[], object]) -> None:
 def unregister_provider(name: str) -> None:
     with _lock:
         _providers.pop(name, None)
+
+
+def register_servingz(name: str, fn: Callable[[], object]) -> None:
+    """Add a /servingz source (a ModelServer's ``manager.servingz``).
+    Re-registering a name replaces it (latest owner wins)."""
+    with _lock:
+        _servingz[name] = fn
+
+
+def unregister_servingz(name: str) -> None:
+    with _lock:
+        _servingz.pop(name, None)
+
+
+def _servingz_payload() -> dict:
+    with _lock:
+        sources = dict(_servingz)
+    if not sources:
+        return {"serving": "no model server registered in this process"}
+    out = {}
+    for name, fn in sorted(sources.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # one broken server must not 500 the page
+            out[name] = {"error": repr(e)[:200]}
+    return out
 
 
 def set_role(role: Optional[str]) -> None:
@@ -201,6 +233,13 @@ class _Handler(BaseHTTPRequestHandler):
                             else json.dumps(_perf.profilez(), indent=2))
                 self._reply(200, body,
                             "text/plain" if text else "application/json")
+            elif path == "/servingz":
+                # the serving-plane debug page: router state + per-model
+                # QPS / queue-depth / batch-occupancy / latency gauges
+                # for every ModelServer in this process
+                self._reply(200, json.dumps(_servingz_payload(), indent=2,
+                                            default=repr),
+                            "application/json")
             elif path == "/chaosz":
                 # fault-injection control plane (distributed/faults.py):
                 # ?inject=<spec> arms rules, ?clear=1 removes runtime
@@ -232,6 +271,7 @@ class _Handler(BaseHTTPRequestHandler):
                      "/tracez  (?raw=1 span snapshot, ?recent=1 flight "
                      "recorder)",
                      "/memz  /profilez  (?text=1 human rendering)",
+                     "/servingz  (model-server router + batching gauges)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),
                     "text/plain")
             else:

@@ -1,0 +1,46 @@
+"""Multi-tenant model serving plane (continuous batching + hot-swap).
+
+The path from a :class:`~paddle_tpu.inference.Predictor` to the
+north-star "heavy traffic from millions of users": a model server built
+entirely on this repo's own primitives — the framed-TCP transport
+(:mod:`paddle_tpu.distributed.transport`), the TTL-lease registry
+(:mod:`paddle_tpu.distributed.registry`), the persistent compile cache
+(:meth:`Executor.warm_start`), and the observability plane.
+
+Reference precedent: the standalone inference layer of the survey
+(``paddle/fluid/inference/``, SURVEY.md § inference) ships a
+predictor-per-thread API and stops there; serving it at scale was left
+to external servers.  Here the serving loop is TPU-native by design —
+on TPU, throughput is won by *never recompiling and never dispatching a
+half-empty batch*:
+
+- **Continuous dynamic batching** (:mod:`batcher`): concurrent requests
+  coalesce into padded batches snapped to a bucket ladder
+  (``FLAGS_serving_buckets``); a batch dispatches the moment the top
+  bucket fills *or* the per-model max-queue-delay expires.  Pad rows are
+  sliced off before the reply; every dispatch shape is on the warmed
+  ladder, so the executor's shape-bucket cache never recompiles.
+- **Versioned hot-swap** (:mod:`model_registry`): load version B next
+  to A, warm B's whole bucket ladder (from the persistent compile cache
+  when enabled), atomically flip the router, drain A — zero dropped and
+  zero recompile-stalled requests during the flip.
+- **Admission control**: bounded per-model queues and a queue-delay SLO;
+  past either, requests are shed with a typed :class:`Overloaded` reply
+  instead of silently queueing into timeout.
+- **Replica groups** (:mod:`server` / :mod:`client`): servers announce
+  ``(model, version, health)`` via registry leases; the thin client
+  routes across replicas with health-gated failover.
+
+Nothing here is imported by the core framework: a process that never
+instantiates a server/batcher gets no new sockets, threads, or behavior.
+"""
+from __future__ import annotations
+
+from .batcher import BucketLadder, DynamicBatcher, Overloaded  # noqa: F401
+from .model_registry import ModelManager, ServedModel  # noqa: F401
+from .server import ModelServer, ServingService  # noqa: F401
+from .client import ServingClient  # noqa: F401
+
+__all__ = ["BucketLadder", "DynamicBatcher", "Overloaded",
+           "ModelManager", "ServedModel",
+           "ModelServer", "ServingService", "ServingClient"]

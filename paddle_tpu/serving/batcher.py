@@ -1,0 +1,520 @@
+"""Continuous dynamic batching: coalesce requests onto a bucket ladder.
+
+The serving-plane hot loop.  Concurrent :meth:`DynamicBatcher.submit`
+calls enqueue requests; one scheduler thread coalesces them into a
+single padded batch snapped to the smallest bucket that fits
+(:class:`BucketLadder`), dispatches through the model's
+:class:`~paddle_tpu.inference.Predictor`, and a completion thread
+slices the per-request rows back out (pad rows never leave the server).
+
+Why buckets: the executor compiles one XLA executable per feed-shape
+signature (``core/executor.py`` shape-bucket cache).  Free-form batch
+sizes would recompile constantly; snapping every dispatch to a small
+ladder (default 1/2/4/8/16/32) means a handful of executables cover all
+traffic — warm them once (``ModelManager.load(warm=True)``) and the
+server never compiles again.
+
+Dispatch policy (the "continuous" part): a batch goes out as soon as
+the TOP bucket fills *or* the oldest queued request has waited
+``max_delay_ms`` — whichever comes first.  Low traffic pays at most the
+delay SLO riding a small bucket; saturation runs back-to-back top
+buckets with zero idle.
+
+Pipelining: ``Predictor.run`` dispatches asynchronously (the executor
+returns :class:`LazyFetch` handles), so while batch N executes on
+device the scheduler thread is already assembling and feeding batch
+N+1, and the completion thread materializes batch N's results — one
+batched readback per dispatch — and completes the reply futures.
+
+Admission control: a bounded queue (``max_queue_rows``) plus an
+optional queue-delay SLO (``queue_delay_slo_ms``): when the backlog
+times the observed per-batch service time says the SLO is unmeetable,
+new requests are shed immediately with a typed :class:`Overloaded` —
+a fast, honest overload reply beats a slow timeout.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import flags as _flags
+from ..core.types import np_dtype
+from ..observability import stats as _obs_stats
+from ..observability import trace as _obs_trace
+
+
+class Overloaded(RuntimeError):
+    """Typed load-shed reply: the request was NOT queued.
+
+    Carried over the wire by :mod:`server`/:mod:`client` so a remote
+    caller sees the same type with the same fields — clients should
+    back off or fail over to another replica."""
+
+    def __init__(self, model: str, queue_rows: int, limit_rows: int,
+                 est_delay_ms: Optional[float] = None,
+                 slo_ms: Optional[float] = None):
+        self.model = model
+        self.queue_rows = queue_rows
+        self.limit_rows = limit_rows
+        self.est_delay_ms = est_delay_ms
+        self.slo_ms = slo_ms
+        if est_delay_ms is not None:
+            why = (f"estimated queue delay {est_delay_ms:.1f} ms exceeds "
+                   f"SLO {slo_ms:.1f} ms")
+        else:
+            why = f"queue full ({queue_rows}/{limit_rows} rows)"
+        super().__init__(f"model {model!r} overloaded: {why}")
+
+    def to_dict(self) -> dict:
+        return {"model": self.model, "queue_rows": self.queue_rows,
+                "limit_rows": self.limit_rows,
+                "est_delay_ms": self.est_delay_ms, "slo_ms": self.slo_ms}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Overloaded":
+        return cls(d.get("model", "?"), int(d.get("queue_rows", 0)),
+                   int(d.get("limit_rows", 0)), d.get("est_delay_ms"),
+                   d.get("slo_ms"))
+
+
+class BucketLadder:
+    """Sorted batch-size ladder; ``snap(n)`` is the smallest bucket
+    ≥ n.  Requests larger than the top bucket are rejected at submit
+    (dispatching off-ladder would recompile — the one thing the
+    serving plane exists to never do)."""
+
+    def __init__(self, buckets: Optional[Sequence[int]] = None):
+        if buckets is None:
+            buckets = self.flag_buckets()
+        sizes = sorted({int(b) for b in buckets})
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"invalid bucket ladder: {buckets!r}")
+        self.sizes = tuple(sizes)
+
+    @staticmethod
+    def parse(spec) -> List[int]:
+        """The ladder-spec grammar ("1,2,4" / "1;2;4"), shared by the
+        flag default and tools/serve.py's --buckets."""
+        return [int(p) for p in str(spec).replace(";", ",").split(",")
+                if p.strip()]
+
+    @classmethod
+    def flag_buckets(cls) -> List[int]:
+        return cls.parse(_flags.get_flags("serving_buckets"))
+
+    @property
+    def max(self) -> int:
+        return self.sizes[-1]
+
+    def snap(self, n: int) -> int:
+        for b in self.sizes:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} rows exceeds the top bucket {self.max}")
+
+    def __repr__(self) -> str:
+        return f"BucketLadder{self.sizes}"
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "future", "t_enq")
+
+    def __init__(self, feed: Dict[str, np.ndarray], rows: int):
+        self.feed = feed
+        self.rows = rows
+        self.future: "Future" = Future()
+        self.t_enq = time.monotonic()
+
+
+class BatcherStats:
+    """Per-model serving gauges for /servingz: QPS and latency
+    percentiles over a bounded recent window, plus lifetime counters
+    (which also land in the process stats registry as
+    ``serving.<model>.*`` Prometheus series)."""
+
+    _WINDOW = 512
+
+    def __init__(self, model: str):
+        self._lock = threading.Lock()
+        # (t_done_monotonic, latency_ms) of recent completed requests
+        self._recent: deque = deque(maxlen=self._WINDOW)
+        self.requests = 0
+        self.rows = 0
+        self.shed = 0
+        self.batches = 0
+        self.padded_rows = 0
+        self.dispatched_rows = 0
+        self.errors = 0
+        sc = _obs_stats.scope(f"serving.{model}")
+        self._c_requests = sc.counter("requests")
+        self._c_rows = sc.counter("rows")
+        self._c_shed = sc.counter(
+            "shed", "requests refused by admission control (typed "
+            "Overloaded reply; queue bound or queue-delay SLO)")
+        self._c_batches = sc.counter("batches")
+        self._c_padded = sc.counter(
+            "padded_rows", "pad rows added to snap batches onto the "
+            "bucket ladder (sliced off before the reply)")
+        self._c_errors = sc.counter("errors")
+        self._g_depth = sc.gauge("queue_rows")
+        self._h_latency = sc.histogram("latency_ms")
+        self._h_occupancy = sc.histogram(
+            "batch_occupancy_pct",
+            buckets=(10, 25, 50, 75, 90, 100))
+
+    def note_submit(self, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+        self._c_requests.inc()
+        self._c_rows.inc(rows)
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+        self._c_shed.inc()
+
+    def note_batch(self, rows: int, bucket: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.padded_rows += bucket - rows
+            self.dispatched_rows += rows
+        self._c_batches.inc()
+        self._c_padded.inc(bucket - rows)
+        self._h_occupancy.observe(100.0 * rows / bucket)
+
+    def note_done(self, n_requests: int, latencies_ms: List[float],
+                  error: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if error:
+                self.errors += n_requests
+            for lat in latencies_ms:
+                self._recent.append((now, lat))
+        if error:
+            self._c_errors.inc(n_requests)
+        for lat in latencies_ms:
+            self._h_latency.observe(lat)
+
+    def set_depth(self, rows: int) -> None:
+        self._g_depth.set(rows)
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            recent = list(self._recent)
+            out = {
+                "requests": self.requests, "rows": self.rows,
+                "shed": self.shed, "batches": self.batches,
+                "padded_rows": self.padded_rows, "errors": self.errors,
+                "avg_batch_occupancy": (
+                    round(self.dispatched_rows
+                          / max(self.dispatched_rows + self.padded_rows, 1),
+                          3)),
+            }
+        if recent:
+            span = max(now - recent[0][0], 1e-3)
+            lats = sorted(lat for _, lat in recent)
+
+            def pct(p):
+                return round(lats[min(int(p * len(lats)), len(lats) - 1)], 3)
+            out.update({
+                "qps": round(len(recent) / span, 1),
+                "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            })
+        return out
+
+
+def _pad_rows(arr: np.ndarray, pad: int) -> np.ndarray:
+    """Pad ``arr`` to ``len(arr)+pad`` rows by repeating the last row:
+    real in-range values keep every lowering numerically tame (an
+    all-zero pad can divide-by-zero a normalization), and the pad rows
+    are sliced off before any reply."""
+    if pad == 0:
+        return arr
+    return np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
+
+
+class DynamicBatcher:
+    """One model version's continuous-batching scheduler (module doc).
+
+    ``predictor`` needs the Predictor surface: ``run(feed_dict)``,
+    ``feed_names``, ``fetch_names``.  All feeds must share the same
+    leading (batch) dimension; coalescing concatenates along it.
+    """
+
+    def __init__(self, predictor, name: str = "model",
+                 buckets: Optional[Sequence[int]] = None,
+                 max_delay_ms: Optional[float] = None,
+                 max_queue_rows: Optional[int] = None,
+                 queue_delay_slo_ms: Optional[float] = None):
+        self.predictor = predictor
+        self.name = name
+        self.ladder = (buckets if isinstance(buckets, BucketLadder)
+                       else BucketLadder(buckets))
+        self.max_delay_ms = (
+            float(_flags.get_flags("serving_max_queue_delay_ms"))
+            if max_delay_ms is None else float(max_delay_ms))
+        self.max_queue_rows = (
+            int(_flags.get_flags("serving_max_queue_rows"))
+            if max_queue_rows is None else int(max_queue_rows))
+        slo = (_flags.get_flags("serving_queue_delay_slo_ms")
+               if queue_delay_slo_ms is None else queue_delay_slo_ms)
+        self.queue_delay_slo_ms = float(slo) or None  # 0 ⇒ disabled
+        self.stats = BatcherStats(name)
+        # per-feed (sample_shape, dtype) contract each request must
+        # match — a request with a wrong trailing shape must be
+        # rejected ALONE at submit, not poison every innocent request
+        # coalesced into its batch when np.concatenate throws.  Seeded
+        # from the program's static feed declarations when the
+        # predictor carries a program; feeds with symbolic dims (or
+        # stub predictors) latch from the first accepted request.
+        self._feed_contract: Dict[str, list] = {}
+        prog = getattr(predictor, "program", None)
+        block = prog().global_block if callable(prog) else None
+        for n in predictor.feed_names:
+            var = block.var_or_none(n) if block is not None else None
+            if var is not None and var.shape is not None and \
+                    not any(s < 0 for s in var.shape[1:]):
+                self._feed_contract[n] = [tuple(var.shape[1:]),
+                                          np.dtype(np_dtype(var.dtype))
+                                          if var.dtype is not None else None]
+            else:
+                self._feed_contract[n] = [None, None]
+
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._rows_queued = 0
+        self._inflight_batches = 0
+        self._closed = False
+        self._ewma_batch_ms: Optional[float] = None
+        # one completion thread: materializes each batch's LazyFetch
+        # results (one batched readback) and completes futures IN
+        # DISPATCH ORDER while the scheduler assembles the next batch
+        self._done_q: deque = deque()
+        self._done_cv = threading.Condition()
+        self._sched = threading.Thread(
+            target=self._sched_loop, daemon=True,
+            name=f"serving-sched-{name}")
+        self._completer = threading.Thread(
+            target=self._complete_loop, daemon=True,
+            name=f"serving-complete-{name}")
+        self._sched.start()
+        self._completer.start()
+
+    # -- request side ------------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray]) -> "Future":
+        """Enqueue one request; the Future resolves to the list of fetch
+        arrays (leading dim = this request's rows).  Raises
+        :class:`Overloaded` (shed, never queued) or ``ValueError``
+        (malformed feed / batch beyond the top bucket)."""
+        arrs = {}
+        rows = None
+        for n in self.predictor.feed_names:
+            if n not in feed:
+                raise ValueError(f"request missing feed {n!r}")
+            a = np.asarray(feed[n])
+            if a.ndim == 0:
+                raise ValueError(f"feed {n!r} must be batch-major")
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise ValueError(
+                    f"feeds disagree on the batch dim: {n!r} has "
+                    f"{a.shape[0]} rows, expected {rows}")
+            contract = self._feed_contract[n]
+            if contract[0] is not None and a.shape[1:] != contract[0]:
+                raise ValueError(
+                    f"feed {n!r} sample shape {a.shape[1:]} does not "
+                    f"match this model's {contract[0]}")
+            if contract[1] is not None and a.dtype != contract[1]:
+                # cast HERE (the executor would cast anyway): a stray
+                # float64 request must not promote the whole coalesced
+                # batch through np.concatenate
+                a = a.astype(contract[1])
+            arrs[n] = a
+        if not rows:
+            raise ValueError("empty request (0 rows)")
+        if rows > self.ladder.max:
+            raise ValueError(
+                f"request of {rows} rows exceeds the top bucket "
+                f"{self.ladder.max}; split it client-side")
+        req = _Request(arrs, rows)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError(f"batcher {self.name!r} is closed")
+            for n, a in arrs.items():
+                c = self._feed_contract[n]
+                if c[0] is None:
+                    # no static declaration: the first accepted request
+                    # fixes the sample shape (coalescing concatenates
+                    # along the batch dim, so mixed trailing shapes
+                    # could never share a batch anyway)
+                    c[0] = a.shape[1:]
+                    if c[1] is None:
+                        c[1] = a.dtype
+                elif a.shape[1:] != c[0]:
+                    raise ValueError(
+                        f"feed {n!r} sample shape {a.shape[1:]} does "
+                        f"not match this model's {c[0]}")
+            depth = self._rows_queued
+            if depth + rows > self.max_queue_rows:
+                self.stats.note_shed()
+                raise Overloaded(self.name, depth, self.max_queue_rows)
+            if self.queue_delay_slo_ms is not None and \
+                    self._ewma_batch_ms is not None:
+                # delay the request would WAIT behind work already
+                # accepted (not its own service time — an idle server
+                # must admit): queued + in-flight batches, each costing
+                # the observed per-batch service time
+                backlog = (depth + self.ladder.max - 1) \
+                    // self.ladder.max + self._inflight_batches
+                est = backlog * self._ewma_batch_ms
+                if est > self.queue_delay_slo_ms:
+                    self.stats.note_shed()
+                    raise Overloaded(self.name, depth, self.max_queue_rows,
+                                     est, self.queue_delay_slo_ms)
+            self._q.append(req)
+            self._rows_queued += rows
+            self.stats.set_depth(self._rows_queued)
+            self._cv.notify_all()
+        self.stats.note_submit(rows)
+        return req.future
+
+    def infer(self, feed: Dict[str, np.ndarray],
+              timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Blocking convenience over :meth:`submit`."""
+        return self.submit(feed).result(timeout=timeout)
+
+    # -- scheduler ---------------------------------------------------------
+    def _sched_loop(self) -> None:
+        while True:
+            take, total = self._gather()
+            if take is None:
+                return
+            self._dispatch(take, total)
+
+    def _gather(self):
+        """Block until a batch is due: top bucket full, the oldest
+        request aged past max_delay_ms, or close."""
+        max_rows = self.ladder.max
+        delay_s = self.max_delay_ms / 1e3
+        with self._cv:
+            while True:
+                if self._q:
+                    if self._rows_queued >= max_rows or self._closed:
+                        break
+                    remaining = self._q[0].t_enq + delay_s - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                elif self._closed:
+                    return None, 0
+                else:
+                    self._cv.wait()
+            take, total = [], 0
+            while self._q and total + self._q[0].rows <= max_rows:
+                r = self._q.popleft()
+                take.append(r)
+                total += r.rows
+            self._rows_queued -= total
+            self._inflight_batches += 1
+            self.stats.set_depth(self._rows_queued)
+        return take, total
+
+    def _dispatch(self, take: List[_Request], total: int) -> None:
+        bucket = self.ladder.snap(total)
+        t0 = time.monotonic()
+        try:
+            feed = {}
+            for n in self.predictor.feed_names:
+                a = (take[0].feed[n] if len(take) == 1
+                     else np.concatenate([r.feed[n] for r in take], axis=0))
+                feed[n] = _pad_rows(a, bucket - total)
+            with _obs_trace.start_span("serving::dispatch", cat="serving",
+                                       root=False,
+                                       tags={"model": self.name,
+                                             "bucket": bucket,
+                                             "rows": total}):
+                outs = self.predictor.run(feed)
+            err = None
+        except Exception as e:
+            outs, err = None, e
+        self.stats.note_batch(total, bucket)
+        with self._done_cv:
+            self._done_q.append((take, outs, err, t0))
+            self._done_cv.notify()
+
+    # -- completion --------------------------------------------------------
+    def _complete_loop(self) -> None:
+        while True:
+            with self._done_cv:
+                while not self._done_q:
+                    # exit only once the scheduler is done for good: a
+                    # momentarily idle in-flight count mid-close must
+                    # not strand batches the scheduler is still packing
+                    if self._closed and not self._sched.is_alive():
+                        return
+                    self._done_cv.wait(timeout=0.2)
+                take, outs, err, t0 = self._done_q.popleft()
+            now = time.monotonic()
+            if err is not None:
+                for r in take:
+                    r.future.set_exception(err)
+                self.stats.note_done(
+                    len(take), [(now - r.t_enq) * 1e3 for r in take],
+                    error=True)
+            else:
+                # materializing the first array flushes the whole
+                # batch's pending LazyFetch set in ONE device readback
+                outs = [np.asarray(o) for o in outs]
+                off = 0
+                for r in take:
+                    r.future.set_result(
+                        [o[off:off + r.rows] for o in outs])
+                    off += r.rows
+                self.stats.note_done(
+                    len(take), [(now - r.t_enq) * 1e3 for r in take])
+            batch_ms = (now - t0) * 1e3
+            with self._cv:
+                self._inflight_batches -= 1
+                e = self._ewma_batch_ms
+                self._ewma_batch_ms = (batch_ms if e is None
+                                       else 0.8 * e + 0.2 * batch_ms)
+                self._cv.notify_all()
+
+    # -- lifecycle ---------------------------------------------------------
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait until every accepted request has been answered (the
+        hot-swap retire gate).  Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._q or self._rows_queued or self._inflight_batches \
+                    or self._done_q:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=min(left, 0.2))
+        return True
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop accepting, drain what was accepted, join the threads."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        with self._done_cv:
+            self._done_cv.notify_all()
+        self._sched.join(timeout=timeout)
+        self._completer.join(timeout=timeout)
+
+    def queue_rows(self) -> int:
+        with self._cv:
+            return self._rows_queued

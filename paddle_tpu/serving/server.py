@@ -1,0 +1,245 @@
+"""Model server: the serving plane's RPC front door + replica announce.
+
+Rides the repo's own framed-TCP transport (one more
+:class:`~paddle_tpu.distributed.transport.RPCServer` service, like the
+pserver/master/registry): an ``INFER`` frame carries a request's feed
+tensors in the zero-copy batched serde, the reply streams the fetch
+tensors back scatter-gather, and the existing per-request distributed
+tracing (PR 4) stitches client → server → ``serving::dispatch`` →
+``executor::step`` spans end to end with no new wire format.
+
+Wire protocol (both payloads ride the PR-3 batched serde):
+
+- ``INFER`` (msg 21): ``name`` = model name, payload =
+  ``serde.dumps_batch`` of the feed ``(name, array)`` pairs.  Reply
+  payload is 1 tag byte + body: ``R`` + serde batch of fetch pairs on
+  success, ``O`` + JSON :class:`Overloaded` detail on load-shed (typed,
+  never a generic error).  Anything else (unknown model, bad feed)
+  surfaces as the transport's ERR frame.
+- ``SERVING_ADMIN`` (msg 22): JSON command — ``{"cmd": "status"}``,
+  ``{"cmd": "swap"|"load", "model":, "version":, "model_dir":, ...}``,
+  ``{"cmd": "activate"|"retire", ...}`` — JSON reply.  This is what
+  ``tools/serve.py --swap`` drives.
+
+Replica groups: ``registry_ep`` set ⇒ the server announces one TTL
+lease per served model under the logical key
+``serving/<model>/<replica_id>`` (value: this server's endpoint), with
+the active version + live QPS riding the lease's data payload and the
+fleet health plane seeing a ``SERVING``-role heartbeat.  The thin
+:class:`~paddle_tpu.serving.client.ServingClient` discovers replicas
+from the same registry and fails over health-gated.  No registry ⇒ no
+lease traffic, a plain static-endpoint server.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from .batcher import Overloaded
+from .model_registry import ModelManager
+from ..distributed import registry as _registry
+from ..distributed import serde, transport
+from ..observability import debug_server as _debug_server
+
+# message types: 21/22 keep the one-namespace msg-type space clear of
+# transport 1-14, master 16-20, and the observability pulls 24/25
+INFER = 21
+SERVING_ADMIN = 22
+
+transport.MSG_NAMES.update({INFER: "infer",
+                            SERVING_ADMIN: "serving_admin"})
+
+# INFER reply tag bytes (first payload byte)
+_TAG_RESULT = b"R"
+_TAG_OVERLOAD = b"O"
+
+
+def replica_key(model: str, replica_id: str) -> str:
+    """The registry lease key a serving replica announces under."""
+    return f"serving/{model}/{replica_id}"
+
+
+def parse_replica_key(logical: str):
+    """``(model, replica_id)`` from a serving lease key, else None."""
+    parts = logical.split("/", 2)
+    if len(parts) == 3 and parts[0] == "serving":
+        return parts[1], parts[2]
+    return None
+
+
+class ServingService:
+    """``handle()`` contract of transport.RPCServer services."""
+
+    def __init__(self, manager: ModelManager, on_change=None):
+        self.manager = manager
+        # server hook: re-announce registry leases after admin changes
+        self._on_change = on_change
+
+    def handle(self, msg_type, trainer_id, name, payload):
+        if msg_type == INFER:
+            feed = dict(serde.loads_batch(payload, copy=False))
+            try:
+                fut, sm = self.manager.serve_request(name, feed)
+            except Overloaded as e:
+                return transport.OK, [
+                    _TAG_OVERLOAD + json.dumps(e.to_dict()).encode("utf-8")]
+            # bounded wait: a wedged batcher must surface as an ERR frame
+            # to this client, not a connection thread parked forever
+            from ..core import flags as _flags
+            outs = fut.result(timeout=float(_flags.get_flags("rpc_deadline")))
+            # reply names come from the model that ANSWERED — a re-route
+            # for names could race a hot-swap onto a different version
+            pairs = list(zip(sm.predictor.fetch_names, outs))
+            return transport.OK, [_TAG_RESULT] + serde.dumps_batch_vec(pairs)
+        if msg_type == SERVING_ADMIN:
+            body = json.loads(bytes(payload).decode("utf-8"))
+            out = self._admin(body)
+            return transport.OK, json.dumps(out, default=repr).encode("utf-8")
+        return transport.ERR, f"serving: unknown msg {msg_type}".encode()
+
+    def _admin(self, body: dict) -> dict:
+        cmd = body.get("cmd")
+        m = self.manager
+        if cmd == "status":
+            return m.servingz()
+        if cmd in ("load", "swap"):
+            kw = {k: body[k] for k in
+                  ("model_dir", "buckets", "sample_shapes", "max_delay_ms",
+                   "max_queue_rows", "queue_delay_slo_ms") if k in body}
+            if cmd == "load":
+                sm = m.load(body["model"], body["version"],
+                            activate=bool(body.get("activate", True)), **kw)
+                out = {"loaded": f"{sm.name}@{sm.version}",
+                       "warm": sm.warm_info}
+            else:
+                out = m.swap(body["model"], body["version"], **kw)
+            if self._on_change is not None:
+                self._on_change()
+            return out
+        if cmd == "activate":
+            m.activate(body["model"], body["version"])
+            return {"active": m.active_version(body["model"])}
+        if cmd == "retire":
+            m.retire(body["model"], body["version"])
+            return {"retired": f"{body['model']}@{body['version']}"}
+        raise ValueError(f"serving_admin: unknown cmd {cmd!r}")
+
+
+class ModelServer:
+    """One serving process: RPC endpoint + model manager + announces.
+
+    ``registry_ep`` (optional) turns on replica-group membership; with
+    it unset the server opens exactly one listening socket and nothing
+    else.  ``manager`` may be shared/prebuilt (in-process tests);
+    otherwise the server owns one and closes it on :meth:`stop`.
+    """
+
+    def __init__(self, endpoint: str = "127.0.0.1:0",
+                 manager: Optional[ModelManager] = None,
+                 registry_ep: Optional[str] = None,
+                 replica_id: Optional[str] = None,
+                 lease_ttl: float = _registry.DEFAULT_TTL):
+        self._own_manager = manager is None
+        self.manager = manager if manager is not None else ModelManager()
+        self.service = ServingService(self.manager,
+                                      on_change=self._sync_announcements)
+        self._server = transport.RPCServer(endpoint, self.service)
+        self.registry_ep = registry_ep
+        self.lease_ttl = lease_ttl
+        self.replica_id = replica_id or f"{self.endpoint}"
+        self._hb_lock = threading.Lock()
+        self._heartbeats: Dict[str, _registry.Heartbeat] = {}
+        self._started = False
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def endpoint(self) -> str:
+        host = self._server.endpoint.rsplit(":", 1)[0]
+        return f"{host}:{self.port}"
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._server.start()
+        self._started = True
+        _debug_server.register_servingz(self.endpoint,
+                                        self.manager.servingz)
+        self._sync_announcements()
+
+    def stop(self) -> None:
+        # before draining the heartbeats: an admin-swap handler thread
+        # finishing after stop() calls _sync_announcements, which must
+        # not re-announce leases for a dead server
+        self._started = False
+        with self._hb_lock:
+            hbs, self._heartbeats = dict(self._heartbeats), {}
+        for hb in hbs.values():
+            hb.stop(bye=True)
+        _debug_server.unregister_servingz(self.endpoint)
+        self._server.stop()
+        if self._own_manager:
+            self.manager.close()
+
+    # -- convenience passthroughs (announce-aware) -------------------------
+    def load(self, *args, **kw):
+        sm = self.manager.load(*args, **kw)
+        self._sync_announcements()
+        return sm
+
+    def swap(self, *args, **kw):
+        out = self.manager.swap(*args, **kw)
+        self._sync_announcements()
+        return out
+
+    # -- registry announce -------------------------------------------------
+    def _model_health(self, model: str):
+        def probe() -> dict:
+            sm = None
+            try:
+                sm = self.manager._route(model)  # active version
+            except KeyError:
+                pass
+            if sm is None:
+                return {"step": 0}
+            snap = sm.batcher.stats.snapshot()
+            return {"step": snap.get("requests", 0)}
+        return probe
+
+    def _model_data(self, model: str):
+        def data() -> dict:
+            version = self.manager.active_version(model)
+            out = {"model": model, "version": version,
+                   "endpoint": self.endpoint}
+            try:
+                sm = self.manager._route(model)
+                snap = sm.batcher.stats.snapshot()
+                out["qps"] = snap.get("qps", 0.0)
+                out["queue_rows"] = sm.batcher.queue_rows()
+            except KeyError:
+                pass
+            return out
+        return data
+
+    def _sync_announcements(self) -> None:
+        """One registry heartbeat per served MODEL NAME: the lease
+        (``serving/<model>/<replica>`` → this endpoint) is the replica
+        group membership; its data payload carries the live version so
+        a hot-swap is visible fleet-wide within one lease refresh."""
+        if not self.registry_ep or not self._started:
+            return
+        names = {sm.name for sm in self.manager.models()
+                 if sm.state not in ("RETIRED",)}
+        with self._hb_lock:
+            for model in sorted(names - set(self._heartbeats)):
+                hb = _registry.Heartbeat(
+                    self.registry_ep, replica_key(model, self.replica_id),
+                    self.endpoint, ttl=self.lease_ttl, role="SERVING",
+                    health_fn=self._model_health(model),
+                    data_fn=self._model_data(model))
+                hb.start()
+                self._heartbeats[model] = hb
+            for model in sorted(set(self._heartbeats) - names):
+                self._heartbeats.pop(model).stop(bye=True)

@@ -47,6 +47,7 @@ METRIC_KEYS = (
     "implied_sp4_tokens_per_sec_per_device",
     "batched_storm_vars_per_sec",
     "batched_dense_mb_per_sec",
+    "batched_qps",
     "cold_vs_warm_speedup",
     "eff_flops",
     "pipeline_vs_link",

@@ -74,6 +74,15 @@ MODULES = [
     "chaos",        # tools/chaos.py (tools/ is on sys.path here)
     "paddle_tpu.parallel",
     "paddle_tpu.inference",
+    # the model-serving plane (bucket-ladder batching, hot-swap model
+    # registry, INFER wire, replica client) + its operator CLI: frozen
+    # so the serving wire/API surface drifts loudly
+    "paddle_tpu.serving",
+    "paddle_tpu.serving.batcher",
+    "paddle_tpu.serving.model_registry",
+    "paddle_tpu.serving.server",
+    "paddle_tpu.serving.client",
+    "serve",        # tools/serve.py (tools/ is on sys.path here)
     "paddle_tpu.contrib.trainer",
     "paddle_tpu.contrib.inferencer",
     "paddle_tpu.contrib.decoder",

@@ -1202,6 +1202,117 @@ def bench_compile_cache():
     }
 
 
+def _pipeline_child_main():
+    """Child for bench_pipeline: K-stage mnist pipeline on a K-device
+    virtual CPU mesh (one stage per device, worker threads overlap).
+    GPipe vs 1F1B at M in {4, 8, 16} microbatches vs the naive
+    sequential stage-by-stage baseline; reports samples/s, measured +
+    slot-grid bubble fraction, and per-stage utilization."""
+    import os
+    import sys
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.pipeline as pipe
+    from paddle_tpu.models import mnist
+
+    K = int(os.environ.get("PADDLE_TPU_BENCH_PIPE_STAGES", "4"))
+    mb = int(os.environ.get("PADDLE_TPU_BENCH_PIPE_MICROBATCH", "32"))
+    reps = int(os.environ.get("PADDLE_TPU_BENCH_PIPE_REPS", "3"))
+    devices = jax.devices()[:K]
+    rng = np.random.RandomState(0)
+    # host_cpus bounds the thread-overlap win on the CPU mesh: the
+    # sequential baseline already uses every core via XLA intra-op
+    # threading, so speedup > 1 here measures pure schedule overlap;
+    # on a >=K-core (or multi-chip) host the full GPipe ratio applies
+    out = {"stages": K, "microbatch_rows": mb, "host_cpus": os.cpu_count(),
+           "device": devices[0].platform, "configs": {}}
+
+    def timed(tr, feed, mode, n):
+        t0 = time.perf_counter()
+        res = None
+        for _ in range(n):
+            res = tr.run(feed, mode=mode)
+        return time.perf_counter() - t0, res
+
+    for M in (4, 8, 16):
+        B = mb * M
+        feed = {"pixel": rng.randn(B, 1, 28, 28).astype("float32"),
+                "label": rng.randint(0, 10, (B, 1)).astype("int64")}
+        cfg = {"batch": B, "microbatches": M,
+               "bubble_bound": round(pipe.gpipe_bubble_bound(K, M), 4)}
+
+        prog, startup, (feeds, loss, acc) = _fresh(lambda: mnist.build())
+        pp = pipe.PipelineTranspiler().transpile(
+            prog, startup, num_stages=K, num_microbatches=M,
+            loss_name=loss.name)
+        tr = pipe.PipelineTrainer(pp, schedule="gpipe",
+                                  devices=devices).init()
+        tr.run(feed, mode="sequential")  # warmup: compiles every stage
+        dt, _ = timed(tr, feed, "sequential", reps)
+        cfg["sequential_samples_per_sec"] = round(B * reps / dt, 1)
+
+        for sched in ("gpipe", "1f1b"):
+            trs = pipe.PipelineTrainer(pp, schedule=sched,
+                                       devices=devices).init()
+            trs.run(feed)  # warmup (slots mode)
+            dt, res = timed(trs, feed, None, reps)
+            cfg[sched] = {
+                "samples_per_sec": round(B * reps / dt, 1),
+                "speedup_vs_sequential": round(
+                    (B * reps / dt) / cfg["sequential_samples_per_sec"],
+                    3),
+                "bubble_fraction": round(res.bubble_fraction, 4),
+                "bubble_fraction_slots": round(
+                    res.bubble_fraction_slots, 4),
+                "stage_utilization": [round(u, 3)
+                                      for u in res.stage_utilization],
+                "stage_activation_bytes": res.stage_activation_bytes,
+            }
+        out["configs"][f"m{M}"] = cfg
+
+    m8 = out["configs"]["m8"]
+    best = max(("gpipe", "1f1b"), key=lambda s: m8[s]["samples_per_sec"])
+    out["pipeline_samples_per_sec"] = m8[best]["samples_per_sec"]
+    out["best_schedule_m8"] = best
+    out["pipeline_vs_sequential_speedup"] = \
+        m8[best]["speedup_vs_sequential"]
+    out["bubble_fraction_m8"] = m8[best]["bubble_fraction"]
+    out["bubble_bound_m8"] = m8["bubble_bound"]
+    print("PIPELINE=" + json.dumps(out), flush=True)
+    sys.stdout.flush()
+
+
+def bench_pipeline():
+    """Pipeline parallelism machinery: K-stage mnist training, GPipe vs
+    1F1B vs naive sequential stage execution at M in {4, 8, 16}
+    microbatches.  Subprocess on a virtual K-device CPU mesh (the axon
+    plugin pins this process to 1 device; stage overlap needs one
+    device per stage) — on a real multi-chip host the same harness
+    measures hardware overlap, here it measures the scheduling plane.
+    Headline: best-schedule samples/s at M=8, with the measured bubble
+    fraction vs the (K-1)/(M+K-1) GPipe model."""
+    import os
+    import subprocess
+    import sys
+
+    K = int(os.environ.get("PADDLE_TPU_BENCH_PIPE_STAGES", "4"))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={K}").strip()
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--pipeline-child"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=900)
+    for line in out.stdout.splitlines():
+        if line.startswith("PIPELINE="):
+            return json.loads(line[len("PIPELINE="):])
+    raise RuntimeError(
+        f"pipeline child failed rc={out.returncode}: {out.stderr[-500:]}")
+
+
 def bench_scaling():
     """Weak-scaling efficiency on the virtual 8-device CPU mesh (see
     paddle_tpu/parallel/scaling.py — per-device compiled cost, the only
@@ -1244,6 +1355,7 @@ CONFIG_TABLE = [
     ("resnet50_datapath", bench_resnet50_datapath, 420, True),
     ("rpc_transport", bench_rpc_transport, 300, False),
     ("serving", bench_serving, 420, False),
+    ("pipeline", bench_pipeline, 900, False),
     ("compile_cache", bench_compile_cache, 600, False),
     ("scaling_dp8", bench_scaling, 900, False),
 ]
@@ -1714,5 +1826,7 @@ if __name__ == "__main__":
         _worker_main(sys.argv[2].split(","))
     elif len(sys.argv) > 1 and sys.argv[1] == "--compile-cache-child":
         _compile_cache_child_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--pipeline-child":
+        _pipeline_child_main()
     else:
         main()

@@ -62,12 +62,14 @@ from .core.program import (  # noqa: F401
     default_main_program,
     default_startup_program,
     name_scope,
+    pipeline_stage_guard,
     program_guard,
 )
 from .core import unique_name  # noqa: F401
 from . import executor, framework  # noqa: F401  (fluid.framework idioms)
 from .data_feeder import DataFeeder  # noqa: F401
 from .distributed import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from . import pipeline  # noqa: F401  (pipeline parallelism plane)
 from .contrib import (  # noqa: F401
     BeginEpochEvent,
     BeginStepEvent,

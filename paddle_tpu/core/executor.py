@@ -686,7 +686,7 @@ class Executor:
         if tel:
             self._record_step(entry, key, cache_hit, lowering_ms,
                               compile_ms, feed_vals, fetches, t_run0, plan,
-                              donated_state)
+                              donated_state, program=program)
         if pf and entry.perf is not None and t_run0 is not None:
             # feed the measured wall back into the cost/memory record
             # (roofline position) and sample the live device-memory
@@ -862,7 +862,7 @@ class Executor:
         if tel:
             self._record_step(entry, key, cache_hit, lowering_ms,
                               compile_ms, stacked, fetches, t_run0, plan,
-                              donated_state)
+                              donated_state, program=program)
         if pf and entry.perf is not None and t_run0 is not None:
             # dispatch wall covers K steps, and so does the record's
             # flops/bytes — the roofline rates normalize consistently
@@ -1391,7 +1391,8 @@ class Executor:
 
     def _record_step(self, entry, key, cache_hit: bool, lowering_ms: float,
                      compile_ms: float, feed_vals, fetches,
-                     t_run0_ns: int, plan, donated_state) -> None:
+                     t_run0_ns: int, plan, donated_state,
+                     program: Optional[Program] = None) -> None:
         t_now = time.perf_counter_ns()
         wall_ms = (t_now - t_run0_ns) / 1e6
         meta = entry.meta
@@ -1414,7 +1415,8 @@ class Executor:
             compile_ms=round(compile_ms, 3),
             feed_bytes=feed_bytes,
             fetch_bytes=fetch_bytes,
-            wall_ms=round(wall_ms, 3))
+            wall_ms=round(wall_ms, 3),
+            extras=self._step_stat_extras(program, plan, fetches))
         _obs_step.record(ss)
         m = _em()
         m.steps.inc()
@@ -1427,6 +1429,35 @@ class Executor:
 
     def _post_step_telemetry(self, ss, plan, donated_state) -> None:
         """Hook for subclasses (ParallelExecutor adds mesh-level stats)."""
+
+    @staticmethod
+    def _step_stat_extras(program, plan, fetches):
+        """Model-health scalars for the StepStats record: any fetch
+        registered in ``Program.step_stat_vars`` (switch_moe wires its
+        aux loss / dropped-token fraction there) lands in the record's
+        ``extras`` and a same-named gauge — so EP health shows per step
+        on ``/stepz`` and ``/metrics``.  Scalar-only, and only when the
+        var is actually fetched; the float() forces a (tiny) device
+        readback, paid solely under FLAGS_runtime_stats.  For
+        ``run_steps`` the stacked [K] fetch reports the LAST step."""
+        reg = getattr(program, "step_stat_vars", None)
+        if not reg:
+            return None
+        out = {}
+        for name, val in zip(plan.fetch_names, fetches):
+            key = reg.get(name)
+            if key is None:
+                continue
+            try:
+                arr = np.asarray(val)
+                if arr.size < 1:
+                    continue
+                v = float(arr.reshape(-1)[-1])
+            except Exception:
+                continue
+            out[key] = v
+            _obs_stats.gauge(key).set(v)
+        return out or None
 
     @staticmethod
     def _perf_wall_ms(t_run0, cache_hit, lowering_ms, compile_ms,

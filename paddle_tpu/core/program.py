@@ -158,6 +158,29 @@ class _NameScope:
 
 _name_scope = _NameScope()
 
+# current pipeline stage for ops created under ``pipeline_stage_guard``
+# (None = unmarked).  The pipeline transpiler reads the stamped
+# ``pipeline_stage`` attr as a user-chosen cut assignment; unmarked ops
+# inherit the previous op's stage (see paddle_tpu/pipeline/transpiler.py).
+_pipeline_stage: Optional[int] = None
+
+
+@contextlib.contextmanager
+def pipeline_stage_guard(stage: int):
+    """Stamp ops created in this block with ``pipeline_stage=stage``
+    (the user-marked cut-point API of the pipeline transpiler; the
+    reference's layer-placement precedent is ParallelNeuralNetwork's
+    per-layer device assignment, legacy/gserver §2.7).  Stages must be
+    used in non-decreasing program order — the transpiler validates
+    that dataflow never crosses a stage boundary backwards."""
+    global _pipeline_stage
+    saved = _pipeline_stage
+    _pipeline_stage = int(stage)
+    try:
+        yield
+    finally:
+        _pipeline_stage = saved
+
 
 @contextlib.contextmanager
 def name_scope(prefix: str):
@@ -334,6 +357,8 @@ class Block:
         ns = _full_name_scope()
         if ns:
             op.attrs.setdefault("op_namescope", f"/{ns}/")
+        if _pipeline_stage is not None:
+            op.attrs.setdefault("pipeline_stage", _pipeline_stage)
         self.ops.append(op)
         self.program._version += 1
         return op
@@ -396,6 +421,11 @@ class Program:
         self.random_seed = 0
         self._op_role = OpRole.Forward
         self._op_role_vars: List[str] = []
+        # model-health scalars the executor should stamp into StepStats
+        # when fetched: var name -> short stat key (e.g. switch_moe's
+        # aux-loss / dropped-token fraction under "moe.<prefix>.*");
+        # serialized with the program so transpiled clones keep it
+        self.step_stat_vars: Dict[str, str] = {}
 
     # -- block management --------------------------------------------------
     @property
@@ -479,7 +509,10 @@ class Program:
 
     # -- serialization -----------------------------------------------------
     def to_dict(self) -> dict:
-        return {"version": 1, "blocks": [b.to_dict() for b in self.blocks]}
+        d = {"version": 1, "blocks": [b.to_dict() for b in self.blocks]}
+        if self.step_stat_vars:
+            d["step_stat_vars"] = dict(self.step_stat_vars)
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Program":
@@ -496,6 +529,7 @@ class Program:
                 b.ops.append(Operator.from_dict(b, od))
             p.blocks.append(b)
         p._current_block_idx = 0
+        p.step_stat_vars = dict(d.get("step_stat_vars", {}))
         return p
 
     def to_string(self) -> str:

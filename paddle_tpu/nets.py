@@ -227,6 +227,15 @@ def switch_moe(input, num_experts, d_ffn, capacity_factor=1.25,
     kept = layers.reduce_sum(mask)                          # scalar
     dropped_frac = layers.scale(
         layers.elementwise_div(kept, total_tokens), scale=-1.0, bias=1.0)
+    # EP health observability: register both scalars as step-stat vars —
+    # whenever a run FETCHES them (convergence loops, the ep dryrun
+    # phase) and FLAGS_runtime_stats is on, the executor stamps them
+    # into the StepStats record (/stepz) and same-named gauges
+    # (/metrics); runlog picks scalar fetches up by name already
+    prog = input.block.program
+    prog.step_stat_vars[aux_loss.name] = f"moe.{name_prefix}.aux_loss"
+    prog.step_stat_vars[dropped_frac.name] = \
+        f"moe.{name_prefix}.dropped_frac"
     return out, aux_loss, dropped_frac
 
 

@@ -63,6 +63,10 @@ class StepStats:
     sync_ms: float = 0.0       # explicit device sync inside run (if any)
     wall_ms: float = 0.0       # whole run() wall time
     ts: float = field(default_factory=time.time)
+    # model-health scalars registered via Program.step_stat_vars and
+    # fetched this step (e.g. switch_moe's aux loss / dropped-token
+    # fraction) — EP/MoE health lands in /stepz next to the step timing
+    extras: Optional[Dict[str, float]] = None
 
     def to_dict(self) -> dict:
         return asdict(self)

@@ -1,0 +1,55 @@
+"""Pipeline parallelism: program-level stage transpiler + microbatch
+schedules + drivers (ROADMAP item 2; survey §2.7 — the reference's
+layer-placement precedent is ``legacy/gserver/.../ParallelNeuralNetwork.h``).
+
+Typical use::
+
+    import paddle_tpu.pipeline as pipe
+
+    t = pipe.PipelineTranspiler()
+    pp = t.transpile(prog, startup, num_stages=4, num_microbatches=8,
+                     loss_name=loss.name)
+    trainer = pipe.PipelineTrainer(pp, schedule="1f1b",
+                                   devices=jax.devices()[:4]).init()
+    res = trainer.run(feed)        # one minibatch: M microbatches + opt
+    res.loss, res.bubble_fraction, res.stage_utilization
+
+Stage cuts: mark layers with ``fluid.pipeline_stage_guard(k)`` while
+building the program, pass explicit ``cut_points``, or let the
+transpiler cost-balance (``balance="xla"`` refines the split with real
+XLA flops from the PR-7 cost attribution).  Multi-host stages run one
+:class:`PipelineStageWorker` per process over the striped RPC
+transport.
+"""
+from __future__ import annotations
+
+from .schedule import (SCHEDULES, gpipe_bubble_bound, gpipe_order,
+                       one_f_one_b_order, simulate_slots,
+                       slot_bubble_fraction, stage_orders,
+                       validate_orders)
+from .transpiler import (PipelineProgram, PipelineTranspiler,
+                         StagePrograms, balanced_cut_points,
+                         op_flops_estimate, xla_stage_flops)
+from .runner import PipelineTrainer, StepResult
+from .rpc import PipelineStageWorker, StageMailbox
+
+__all__ = [
+    "PipelineTranspiler",
+    "PipelineProgram",
+    "StagePrograms",
+    "PipelineTrainer",
+    "StepResult",
+    "PipelineStageWorker",
+    "StageMailbox",
+    "SCHEDULES",
+    "stage_orders",
+    "gpipe_order",
+    "one_f_one_b_order",
+    "simulate_slots",
+    "slot_bubble_fraction",
+    "validate_orders",
+    "gpipe_bubble_bound",
+    "balanced_cut_points",
+    "op_flops_estimate",
+    "xla_stage_flops",
+]

@@ -1,0 +1,185 @@
+"""Multi-host pipeline stages over the striped zero-copy RPC transport.
+
+Each stage runs in its own process, binds an :class:`RPCServer` with a
+:class:`StageMailbox` service, and pushes boundary tensors to its peers
+with batched ``SEND_VARS`` frames (one RPC per peer per action, riding
+the PR-3 connection striping / scatter-gather serde).  Names carry the
+microbatch tag (``<var>@mb<m>``), so a consumer blocks on exactly the
+tensors its schedule action needs.  Trace contexts propagate on the
+wire (PR-4), so ``tools/stitch_trace.py`` over the stage endpoints
+renders the pipeline ladder as one Perfetto timeline.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.executor import Executor, Scope
+from ..distributed import transport
+from ..distributed.transport import (COMPLETE, OK, RPCServer, SEND_VARS,
+                                     serde)
+from . import schedule as _sched
+from .transpiler import PipelineProgram
+
+__all__ = ["StageMailbox", "PipelineStageWorker", "mb_tag"]
+
+_TAKE_TIMEOUT_S = 180.0
+
+
+def mb_tag(name: str, m: int) -> str:
+    return f"{name}@mb{m}"
+
+
+class StageMailbox:
+    """RPC service: peers push (name@mbM, tensor) pairs; the local stage
+    blocks on :meth:`take` until its action's inputs arrived."""
+
+    def __init__(self):
+        self._store: Dict[str, object] = {}
+        self._cond = threading.Condition()
+        self.peers_done = 0
+
+    # -- service entry (transport._serve_io) -------------------------------
+    def handle(self, msg_type, trainer_id, name, payload):
+        if msg_type == SEND_VARS:
+            pairs = serde.loads_batch(payload, copy=True)
+            with self._cond:
+                self._store.update(pairs)
+                self._cond.notify_all()
+            return OK, b""
+        if msg_type == COMPLETE:
+            with self._cond:
+                self.peers_done += 1
+                self._cond.notify_all()
+            return OK, b""
+        raise ValueError(f"stage mailbox: unexpected message {msg_type}")
+
+    def take(self, names: List[str],
+             timeout: float = _TAKE_TIMEOUT_S) -> List[object]:
+        """Block until every name arrived; pop and return in order."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: all(n in self._store for n in names),
+                timeout=timeout)
+            if not ok:
+                missing = [n for n in names if n not in self._store]
+                raise TimeoutError(
+                    f"pipeline stage mailbox timed out waiting for "
+                    f"{missing[:4]} (of {len(names)})")
+            return [self._store.pop(n) for n in names]
+
+
+class PipelineStageWorker:
+    """One process = one pipeline stage, exchanging boundaries over RPC.
+
+    ``endpoints`` lists every stage's endpoint in stage order; this
+    worker binds ``endpoints[stage]``.  Feeds: pass the FULL minibatch
+    feed dict to :meth:`run_minibatch` on every stage — each stage
+    slices out only the feeds its programs consume (so data readers can
+    be replicated, the usual multi-host pattern).
+    """
+
+    def __init__(self, pipeline_program: PipelineProgram, stage: int,
+                 endpoints: List[str], schedule: str = "gpipe",
+                 bind_endpoint: Optional[str] = None):
+        self.pp = pipeline_program
+        self.K = pipeline_program.num_stages
+        self.M = pipeline_program.num_microbatches
+        self.stage = stage
+        self.st = pipeline_program.stages[stage]
+        self.endpoints = list(endpoints)
+        self.schedule = schedule
+        self.order = _sched.stage_orders(schedule, self.K, self.M)[stage]
+        self.mailbox = StageMailbox()
+        self.server = RPCServer(bind_endpoint or endpoints[stage],
+                                self.mailbox)
+        self.server.start()
+        self.client = transport.get_client(trainer_id=stage)
+        self.exe = Executor()
+        self.scope = Scope()
+        self._initialized = False
+
+    def init(self, wait_peers: bool = True,
+             timeout: float = 90.0) -> "PipelineStageWorker":
+        self.exe.run(self.st.startup_program, scope=self.scope)
+        if wait_peers:
+            others = [ep for i, ep in enumerate(self.endpoints)
+                      if i != self.stage]
+            if others:
+                transport.wait_server_ready(others, timeout=timeout)
+        self._initialized = True
+        return self
+
+    def _send(self, kind: str, names_to_dsts: Dict[str, List[int]],
+              vals: Dict[str, object], m: int) -> None:
+        by_dst: Dict[int, list] = {}
+        for n, dsts in names_to_dsts.items():
+            for d in dsts:
+                by_dst.setdefault(d, []).append(
+                    (mb_tag(n, m), np.asarray(vals[n])))
+        calls = [(self.client.send_vars, self.endpoints[d], pairs)
+                 for d, pairs in sorted(by_dst.items())]
+        if calls:
+            self.client.parallel(calls)
+
+    def run_minibatch(self, feed: Dict[str, object]) -> Optional[float]:
+        """One full minibatch (M microbatches + one optimizer step) in
+        this stage's schedule order.  Returns the mean microbatch loss
+        on the last stage, None elsewhere."""
+        if not self._initialized:
+            raise RuntimeError("call init() first")
+        st, M = self.st, self.M
+        from .transpiler import split_microbatches
+        _, per_mb = split_microbatches(feed, M)
+        retained: Dict[tuple, object] = {}
+        losses = np.zeros(M, dtype=np.float64)
+        for kind, m in self.order:
+            if kind == "F":
+                sfeed = {n: per_mb[m][n] for n in st.fwd_feeds}
+                if st.recv_acts:
+                    names = sorted(st.recv_acts)
+                    vals = self.mailbox.take([mb_tag(n, m) for n in names])
+                    for n, v in zip(names, vals):
+                        if n in st.recv_acts_fwd:
+                            sfeed[n] = v
+                        if n in st.recv_acts_bwd:
+                            retained[(n, m)] = v
+                outs = self.exe.run(st.fwd_program, feed=sfeed,
+                                    fetch_list=st.fwd_fetches,
+                                    scope=self.scope, sync=True)
+                vals = dict(zip(st.fwd_fetches, outs))
+                for n in st.stash:
+                    retained[(n, m)] = vals[n]
+                self._send("act", st.send_acts, vals, m)
+                if self.stage == self.K - 1 and self.pp.loss_name:
+                    losses[m] = float(np.asarray(vals[self.pp.loss_name]))
+            else:
+                bfeed = {n: per_mb[m][n] for n in st.bwd_feeds}
+                for n in st.stash + st.recv_acts_bwd:
+                    bfeed[n] = retained.pop((n, m))
+                if st.recv_grads:
+                    names = sorted(st.recv_grads)
+                    vals = self.mailbox.take([mb_tag(n, m) for n in names])
+                    bfeed.update(zip(names, vals))
+                outs = self.exe.run(st.bwd_program, feed=bfeed,
+                                    fetch_list=st.bwd_fetches,
+                                    scope=self.scope, sync=True)
+                vals = dict(zip(st.bwd_fetches, outs))
+                self._send("grad", st.send_grads, vals, m)
+        if st.opt_program is not None:
+            self.exe.run(st.opt_program, scope=self.scope, sync=True)
+        if self.stage == self.K - 1 and self.pp.loss_name:
+            return float(losses.mean())
+        return None
+
+    def shutdown(self, notify_peers: bool = False) -> None:
+        if notify_peers:
+            for i, ep in enumerate(self.endpoints):
+                if i != self.stage:
+                    try:
+                        self.client.complete(ep)
+                    except Exception:
+                        pass
+        self.server.stop()

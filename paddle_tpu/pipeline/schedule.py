@@ -1,0 +1,145 @@
+"""Microbatch schedules for pipeline parallelism: GPipe and 1F1B.
+
+A schedule is, per stage, an ordered list of actions ``("F", m)`` /
+``("B", m)`` over ``M`` microbatches.  ``simulate_slots`` lays those
+orders onto a slot-clocked grid (one action per stage per slot, earliest
+slot that satisfies the data dependencies) — the grid both drives the
+slot-stepped concurrent runner (pipeline/runner.py) and yields the exact
+schedule-level bubble fraction, which for GPipe equals the classical
+``(K-1)/(M+K-1)`` bound when forward and backward each occupy one slot.
+
+GPipe (Huang et al.): all M forwards, then all M backwards — maximal
+activation stash (M microbatches live at the fwd/bwd turn), simplest
+order.  1F1B (PipeDream-flush / Narayanan et al.): stage ``s`` warms up
+with ``K-1-s`` forwards then alternates one-forward-one-backward and
+drains — same bubble in slot terms, but at most ``K-s`` stashed
+microbatches per stage, so the activation footprint stops growing
+with M.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["SCHEDULES", "gpipe_order", "one_f_one_b_order",
+           "stage_orders", "validate_orders", "simulate_slots",
+           "slot_bubble_fraction", "gpipe_bubble_bound"]
+
+Action = Tuple[str, int]   # ("F"|"B", microbatch)
+
+SCHEDULES = ("gpipe", "1f1b")
+
+
+def gpipe_order(num_stages: int, num_microbatches: int,
+                stage: int) -> List[Action]:
+    """All forwards then all backwards for one stage."""
+    del num_stages, stage
+    M = num_microbatches
+    return [("F", m) for m in range(M)] + [("B", m) for m in range(M)]
+
+
+def one_f_one_b_order(num_stages: int, num_microbatches: int,
+                      stage: int) -> List[Action]:
+    """Non-interleaved 1F1B for one stage: ``K-1-s`` warmup forwards,
+    steady one-forward-one-backward, backward drain."""
+    K, M, s = num_stages, num_microbatches, stage
+    warm = min(M, K - 1 - s)
+    order: List[Action] = [("F", m) for m in range(warm)]
+    for m in range(M - warm):
+        order.append(("F", warm + m))
+        order.append(("B", m))
+    for m in range(M - warm, M):
+        order.append(("B", m))
+    return order
+
+
+def stage_orders(schedule: str, num_stages: int,
+                 num_microbatches: int) -> List[List[Action]]:
+    """Per-stage action orders for a named schedule."""
+    if schedule == "gpipe":
+        fn = gpipe_order
+    elif schedule in ("1f1b", "one_f_one_b"):
+        fn = one_f_one_b_order
+    else:
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}; pick one of "
+            f"{SCHEDULES}")
+    return [fn(num_stages, num_microbatches, s) for s in range(num_stages)]
+
+
+def validate_orders(orders: List[List[Action]],
+                    num_microbatches: int) -> None:
+    """Every stage must run F and B of every microbatch exactly once,
+    each B after its own F."""
+    M = num_microbatches
+    for s, order in enumerate(orders):
+        want = {("F", m) for m in range(M)} | {("B", m) for m in range(M)}
+        got = list(order)
+        if set(got) != want or len(got) != len(want):
+            raise ValueError(f"stage {s} order is not a permutation of "
+                             f"F/B over {M} microbatches: {got}")
+        seen_f = set()
+        for kind, m in got:
+            if kind == "F":
+                seen_f.add(m)
+            elif m not in seen_f:
+                raise ValueError(
+                    f"stage {s} schedules B({m}) before F({m})")
+
+
+def simulate_slots(orders: List[List[Action]]
+                   ) -> List[List[Optional[Action]]]:
+    """Greedy slot assignment honoring pipeline dependencies.
+
+    Dependencies: ``F(s, m)`` needs ``F(s-1, m)`` completed in an
+    earlier slot; ``B(s, m)`` needs ``B(s+1, m)`` (or, on the last
+    stage, its own ``F(s, m)``) completed earlier, plus its own
+    ``F(s, m)``.  Each stage executes at most one action per slot, in
+    its order.  Returns ``grid[slot][stage]`` of actions (None = idle).
+    """
+    K = len(orders)
+    done: Dict[Tuple[int, str, int], int] = {}  # (stage, kind, m) -> slot
+    next_i = [0] * K
+    grid: List[List[Optional[Action]]] = []
+    total = sum(len(o) for o in orders)
+    placed = 0
+    while placed < total:
+        slot = len(grid)
+        row: List[Optional[Action]] = [None] * K
+        progressed = False
+        for s in range(K):
+            if next_i[s] >= len(orders[s]):
+                continue
+            kind, m = orders[s][next_i[s]]
+            if kind == "F":
+                ready = s == 0 or done.get((s - 1, "F", m), slot) < slot
+            else:
+                ready = done.get((s, "F", m), slot) < slot and (
+                    s == K - 1 or done.get((s + 1, "B", m), slot) < slot)
+            if ready:
+                row[s] = (kind, m)
+                done[(s, kind, m)] = slot
+                next_i[s] += 1
+                placed += 1
+                progressed = True
+        grid.append(row)
+        if not progressed:
+            raise RuntimeError(
+                "pipeline schedule deadlocked: no stage can progress "
+                f"at slot {slot} (orders violate dependencies)")
+    return grid
+
+
+def slot_bubble_fraction(grid: List[List[Optional[Action]]]) -> float:
+    """Idle fraction of the slot grid: 1 - busy_slots / (K * slots)."""
+    if not grid:
+        return 0.0
+    K = len(grid[0])
+    busy = sum(1 for row in grid for a in row if a is not None)
+    return 1.0 - busy / float(K * len(grid))
+
+
+def gpipe_bubble_bound(num_stages: int, num_microbatches: int) -> float:
+    """The classical GPipe bubble model ``(K-1)/(M+K-1)`` (equal-cost
+    forward/backward slots)."""
+    K, M = num_stages, num_microbatches
+    return (K - 1) / float(M + K - 1)

@@ -1,0 +1,215 @@
+"""MoE/EP hardening: switch_moe convergence + StepStats health wiring.
+
+ROADMAP item 2 satellites: the 2-expert convergence bar (the model
+actually trains), and the aux-loss / dropped-token fraction riding
+StepStats (-> /stepz) and gauges (-> /metrics) whenever they are
+fetched under FLAGS_runtime_stats.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import flags as core_flags
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.observability import stats as obs_stats
+from paddle_tpu.observability import step_stats as obs_step
+
+
+def _build_moe(E=2, D=8, d_ffn=16, N=64, lr=1e-2, prefix="moe_t"):
+    prog, startup = Program(), Program()
+    prog.random_seed = 5
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [D])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        out, aux, dropped = fluid.nets.switch_moe(
+            x, num_experts=E, d_ffn=d_ffn, capacity_per_expert=N,
+            name_prefix=prefix, return_aux=True)
+        logits = fluid.layers.fc(out, 2, act="softmax")
+        ce = fluid.layers.mean(fluid.layers.cross_entropy(logits, y))
+        loss = fluid.layers.elementwise_add(
+            ce, fluid.layers.scale(aux, scale=0.01))
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return prog, startup, loss, aux, dropped
+
+
+def _moe_batch(N=64, D=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(N, D).astype("float32")
+    # two separated clusters with opposite labels: learnable fast, and
+    # cluster-specialized experts help the router find structure
+    x[: N // 2] += 2.0
+    x[N // 2:] -= 2.0
+    y = np.zeros((N, 1), "int64")
+    y[N // 2:] = 1
+    return x, y
+
+
+def test_switch_moe_2expert_convergence():
+    """A 2-expert switch_moe model trains to the loss bar (ROADMAP item
+    2 / VERDICT missing #3): cross-entropy drops below 0.1 and well
+    below its starting point."""
+    N = 64
+    prog, startup, loss, aux, dropped = _build_moe(E=2, N=N)
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    x, y = _moe_batch(N)
+    losses = []
+    for step in range(80):
+        (l, a, d) = exe.run(prog, feed={"x": x, "y": y},
+                            fetch_list=[loss.name, aux.name, dropped.name],
+                            scope=scope)
+        losses.append(float(l))
+        assert np.isfinite(losses[-1]), f"step {step}: {losses[-1]}"
+        # capacity_per_expert=N: nothing can drop
+        assert float(np.asarray(d)) == pytest.approx(0.0, abs=1e-6)
+        assert float(np.asarray(a)) > 0.0
+    assert losses[-1] < 0.1, f"did not converge: {losses[::16]}"
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_moe_aux_stats_ride_step_stats():
+    """Fetching the registered aux vars under FLAGS_runtime_stats lands
+    them in the StepStats record (extras -> /stepz) and same-named
+    gauges (-> /metrics)."""
+    N = 16
+    prog, startup, loss, aux, dropped = _build_moe(E=2, N=N,
+                                                   prefix="moe_ss")
+    assert prog.step_stat_vars[aux.name] == "moe.moe_ss.aux_loss"
+    assert prog.step_stat_vars[dropped.name] == "moe.moe_ss.dropped_frac"
+    # survives clone/serialize (transpilers clone programs)
+    assert prog.clone().step_stat_vars == prog.step_stat_vars
+
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    x, y = _moe_batch(N)
+    saved = core_flags.get_flags("runtime_stats")
+    core_flags.set_flags({"runtime_stats": True})
+    try:
+        obs_step.clear()
+        exe.run(prog, feed={"x": x, "y": y},
+                fetch_list=[loss.name, aux.name, dropped.name],
+                scope=scope)
+        (rec,) = obs_step.last_n(1)
+        assert rec.extras is not None
+        assert rec.extras["moe.moe_ss.aux_loss"] > 0.0
+        assert rec.extras["moe.moe_ss.dropped_frac"] == pytest.approx(
+            0.0, abs=1e-6)
+        # /stepz JSON export carries the extras
+        export = obs_step.recorder().export(tail=1)
+        assert export["last"][-1]["extras"][
+            "moe.moe_ss.aux_loss"] == rec.extras["moe.moe_ss.aux_loss"]
+        # gauges on the metric surface
+        snap = obs_stats.snapshot()
+        assert any(k.startswith("moe.moe_ss.aux_loss") for k in snap), \
+            sorted(k for k in snap if k.startswith("moe"))[:4]
+    finally:
+        core_flags.set_flags({"runtime_stats": saved})
+
+
+def test_moe_stats_absent_when_not_fetched():
+    """Not fetching the aux vars (or keeping stats off) adds nothing."""
+    N = 16
+    prog, startup, loss, aux, dropped = _build_moe(E=2, N=N,
+                                                   prefix="moe_off")
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    x, y = _moe_batch(N)
+    saved = core_flags.get_flags("runtime_stats")
+    core_flags.set_flags({"runtime_stats": True})
+    try:
+        obs_step.clear()
+        exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss.name],
+                scope=scope)
+        (rec,) = obs_step.last_n(1)
+        assert rec.extras is None
+    finally:
+        core_flags.set_flags({"runtime_stats": saved})
+
+
+def test_step_stat_vars_follow_pipeline_stage_programs():
+    """Transpile-only (tier-1): the switch_moe step-stat registration
+    follows the aux vars onto the emitted stage programs — fresh-
+    Program emission must not drop what clone() keeps."""
+    import paddle_tpu.pipeline as pipe
+    prog, startup, loss, aux, dropped = _build_moe(E=2, N=8,
+                                                   prefix="moe_reg")
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_stages=2, num_microbatches=2,
+        loss_name=loss.name)
+    regs = {}
+    for st in pp.stages:
+        for p in (st.fwd_program, st.bwd_program, st.opt_program):
+            if p is not None:
+                regs.update(p.step_stat_vars)
+    assert set(regs.values()) == {"moe.moe_reg.aux_loss",
+                                  "moe.moe_reg.dropped_frac"}
+
+
+@pytest.mark.slow
+def test_moe_trains_inside_pipeline_last_stage():
+    """Pipeline + MoE compose: a 2-stage pipeline whose last stage holds
+    the 2-expert MoE matches the single-process loss curve (the EP and
+    PP axes do not fight over the program rewrite)."""
+    import paddle_tpu.pipeline as pipe
+    N, M, D = 32, 4, 8
+    mb = N // M
+
+    def build():
+        # capacity sized to the MICROBATCH token count (the pipeline
+        # runs the block per microbatch of mb rows); explicit stage
+        # markers pin the MoE whole onto stage 1
+        prog, startup = Program(), Program()
+        prog.random_seed = 5
+        with program_guard(prog, startup), unique_name.guard():
+            x = fluid.layers.data("x", [D])
+            y = fluid.layers.data("y", [1], dtype="int64")
+            with fluid.pipeline_stage_guard(0):
+                h = fluid.layers.fc(x, D, act="relu")
+            with fluid.pipeline_stage_guard(1):
+                out, aux, dropped = fluid.nets.switch_moe(
+                    h, num_experts=2, d_ffn=16, capacity_per_expert=mb,
+                    name_prefix="moe_pp", return_aux=True)
+                logits = fluid.layers.fc(out, 2, act="softmax")
+                ce = fluid.layers.mean(fluid.layers.cross_entropy(logits,
+                                                                  y))
+                loss = fluid.layers.elementwise_add(
+                    ce, fluid.layers.scale(aux, scale=0.01))
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        return prog, startup, loss, aux, dropped
+
+    x, y = _moe_batch(N)
+    feed = {"x": x, "y": y}
+
+    # reference for the FIRST microbatch's pre-update loss: a fresh
+    # single-process run on the same mb rows from the same named init
+    prog, startup, loss, aux, dropped = build()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    (l0,) = exe.run(prog, feed={"x": x[:mb], "y": y[:mb]},
+                    fetch_list=[loss.name], scope=scope)
+
+    prog2, startup2, loss2, _, _ = build()
+    pp = pipe.PipelineTranspiler().transpile(
+        prog2, startup2, num_stages=2, num_microbatches=M,
+        loss_name=loss2.name)
+    # the MoE (and its optimizer + aux graph) must land whole on the
+    # last stage — its params are consumed there
+    moe_stage = {pp.op_stage_assignment[i]
+                 for i, op in enumerate(prog2.global_block.ops)
+                 if "moe_pp" in " ".join(op.input_arg_names())
+                 and pp.op_stage_assignment[i] is not None}
+    assert moe_stage == {1}, moe_stage
+    # the step-stat registration follows the aux vars onto the emitted
+    # stage program (fresh-Program emission must not drop it)
+    assert set(pp.stages[1].fwd_program.step_stat_vars.values()) == {
+        "moe.moe_pp.aux_loss", "moe.moe_pp.dropped_frac"}
+    assert not pp.stages[0].fwd_program.step_stat_vars
+    tr = pipe.PipelineTrainer(pp).init()
+    first = tr.run(feed)
+    assert first.microbatch_losses[0] == pytest.approx(float(l0),
+                                                       rel=1e-5)
+    got = [first.loss] + [tr.run(feed).loss for _ in range(5)]
+    assert all(np.isfinite(v) for v in got)
+    assert got[-1] < got[0], got

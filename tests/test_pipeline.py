@@ -1,0 +1,491 @@
+"""Pipeline parallelism: stage transpiler + GPipe/1F1B schedules.
+
+Tier-1 coverage for ISSUE 9's tentpole:
+- stage-split correctness: every op assigned exactly once, boundary
+  send/recv matched, LR chain replicated;
+- microbatch gradient accumulation reproduces the full-batch step;
+- the 4-stage acceptance runs: GPipe AND 1F1B match the single-process
+  loss curve at rtol <= 1e-4 on mnist and the tiny transformer;
+- slot schedules: validity, deadlock-freedom, and the exact
+  (K-1)/(M+K-1) GPipe bubble on the slot grid;
+- collective-permute boundary transport parity (pp mesh axis);
+- the 2-process RPC pipeline smoke (subprocess stages over the striped
+  transport, tests/pipeline_runner.py).
+
+Tiering: the structural/numerics pins and the RPC smoke are tier-1;
+the compile-heavy 4-stage mnist/transformer/permute acceptance runs
+are ``slow`` (the tier-1 wall budget is shared with 530+ tests — the
+mnist-convergence acceptance test set this precedent).  Run them with
+``-m slow -k parity``.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.pipeline as pipe
+from paddle_tpu.core import unique_name
+from paddle_tpu.core.executor import Executor, Scope
+from paddle_tpu.core.program import (OP_ROLE_ATTR, OpRole, Program,
+                                     program_guard)
+from paddle_tpu.models import mnist as mnist_model
+from paddle_tpu.models import transformer as transformer_model
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_mnist(lr=1e-3, seed=3):
+    prog, startup = Program(), Program()
+    prog.random_seed = seed
+    with program_guard(prog, startup), unique_name.guard():
+        feeds, loss, acc = mnist_model.build(lr=lr)
+    return prog, startup, loss
+
+
+def mnist_feed(batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"pixel": rng.randn(batch, 1, 28, 28).astype("float32"),
+            "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
+
+
+def build_tiny_transformer(T=8, V=32, seed=7):
+    prog, startup = Program(), Program()
+    prog.random_seed = seed
+    with program_guard(prog, startup), unique_name.guard():
+        feeds, loss, _ = transformer_model.build(
+            src_vocab=V, tgt_vocab=V, max_len=T, d_model=16, n_head=2,
+            d_ffn=32, n_layer=1, dropout=0.0, with_optimizer=True)
+    return prog, startup, loss
+
+
+def transformer_feed(batch=8, T=8, V=32, seed=0):
+    # all-ones masks: equal token weight per microbatch, the GPipe
+    # equal-weight contract for exact microbatch-mean equivalence
+    rng = np.random.RandomState(seed)
+    mask = np.ones((batch, T), "float32")
+    return {"src_ids": rng.randint(0, V, (batch, T)).astype("int64"),
+            "tgt_ids": rng.randint(0, V, (batch, T)).astype("int64"),
+            "lbl_ids": rng.randint(0, V, (batch, T)).astype("int64"),
+            "src_mask": mask, "tgt_mask": mask}
+
+
+def reference_losses(build, feed, steps):
+    prog, startup, loss = build()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    out = []
+    for _ in range(steps):
+        (l,) = exe.run(prog, feed=feed, fetch_list=[loss.name],
+                       scope=scope)
+        out.append(float(l))
+    return out
+
+
+@pytest.fixture(scope="module")
+def mnist_ref():
+    """Shared single-process mnist reference curve (one compile serves
+    the parity, accumulation and permute tests)."""
+    feed = mnist_feed(batch=16)
+    return feed, reference_losses(build_mnist, feed, steps=4)
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+def test_schedule_orders_valid_and_slot_bubble_matches_bound():
+    for K, M in ((2, 4), (4, 4), (4, 8), (3, 16)):
+        for sched in ("gpipe", "1f1b"):
+            orders = pipe.stage_orders(sched, K, M)
+            pipe.validate_orders(orders, M)
+            grid = pipe.simulate_slots(orders)
+            bubble = pipe.slot_bubble_fraction(grid)
+            bound = pipe.gpipe_bubble_bound(K, M)
+            # one F + one B slot per microbatch per stage: the grid
+            # realizes the classical bubble exactly for both schedules
+            assert abs(bubble - bound) < 1e-9, (sched, K, M, bubble)
+            assert len(grid) == 2 * (M + K - 1), (sched, K, M, len(grid))
+
+
+def test_one_f_one_b_order_shape():
+    order = pipe.one_f_one_b_order(4, 8, 0)
+    # stage 0 warms up with K-1 forwards before its first backward
+    kinds = [k for k, _ in order]
+    assert kinds[:3] == ["F", "F", "F"]
+    assert order[3] == ("F", 3) and order[4] == ("B", 0)
+
+
+def test_bad_schedule_rejected():
+    with pytest.raises(ValueError):
+        pipe.stage_orders("zigzag", 2, 4)
+    with pytest.raises(ValueError):
+        pipe.validate_orders([[("B", 0), ("F", 0)]], 1)
+
+
+# ---------------------------------------------------------------------------
+# stage splitting
+# ---------------------------------------------------------------------------
+
+def test_stage_split_every_op_assigned_exactly_once():
+    prog, startup, loss = build_mnist()
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_stages=4, num_microbatches=4,
+        loss_name=loss.name)
+    pp.validate()
+    n_ops = len(prog.global_block.ops)
+    assigned = pp.op_stage_assignment
+    assert len(assigned) == n_ops
+    lr_chain = set(pp.lr_chain_ops)
+    # every original op: exactly one stage, or an LR-chain op
+    seen = {}
+    for st in pp.stages:
+        for phase in ("F", "B", "O"):
+            for i in st.op_indices[phase]:
+                assert i not in seen, f"op {i} assigned twice"
+                seen[i] = st.idx
+    for i in range(n_ops):
+        if i in lr_chain:
+            assert i not in seen
+            assert assigned[i] is None
+        else:
+            assert seen[i] == assigned[i]
+    assert set(seen) | lr_chain == set(range(n_ops))
+    # boundary vars matched + static activation-bytes accounting
+    for st in pp.stages:
+        assert st.fwd_program.global_block.ops, "empty stage"
+        assert st.activation_bytes(4) >= 0
+    assert sum(st.activation_bytes(4) for st in pp.stages[:-1]) > 0
+
+
+def test_stage_split_respects_explicit_markers():
+    prog, startup = Program(), Program()
+    prog.random_seed = 1
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        with fluid.pipeline_stage_guard(0):
+            h = fluid.layers.fc(x, 16, act="relu")
+        with fluid.pipeline_stage_guard(1):
+            logits = fluid.layers.fc(h, 4, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_microbatches=2, loss_name=loss.name)
+    assert pp.num_stages == 2
+    # the fc at stage 0 keeps its params/optimizer there
+    blk = prog.global_block
+    for i, op in enumerate(blk.ops):
+        if op.type == "sgd":
+            p = op.input("Param")[0]
+            want = 0 if p.startswith("fc_0") else 1
+            assert pp.op_stage_assignment[i] == want, (p, i)
+
+
+def test_cut_points_and_balanced_costs():
+    assert pipe.balanced_cut_points([1, 1, 1, 1], 2) == [2]
+    assert pipe.balanced_cut_points([10, 1, 1, 1], 2) == [1]
+    # forced tail cuts always leave one op per stage
+    assert pipe.balanced_cut_points([10, 10, 1, 1], 4) == [1, 2, 3]
+    with pytest.raises(ValueError):
+        pipe.balanced_cut_points([1], 2)
+    prog, startup, loss = build_mnist()
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_stages=2, num_microbatches=2,
+        loss_name=loss.name, cut_points=[6])
+    assert pp.num_stages == 2
+    pp.validate()
+
+
+def test_xla_stage_flops_attribution():
+    prog, startup, loss = build_mlp()
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_stages=2, num_microbatches=2,
+        loss_name=loss.name)
+    flops = pipe.xla_stage_flops(pp, batch_hint=4)
+    assert len(flops) == 2 and all(f > 0 for f in flops), flops
+    # balance="xla" must yield a valid split
+    prog2, startup2, loss2 = build_mlp()
+    pp2 = pipe.PipelineTranspiler().transpile(
+        prog2, startup2, num_stages=2, num_microbatches=2,
+        loss_name=loss2.name, balance="xla")
+    pp2.validate()
+
+
+# ---------------------------------------------------------------------------
+# numerics
+# ---------------------------------------------------------------------------
+
+def build_mlp(seed=9):
+    prog, startup = Program(), Program()
+    prog.random_seed = seed
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        h = fluid.layers.fc(h, 32, act="relu")
+        logits = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(logits, y))
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    return prog, startup, loss
+
+
+def mlp_feed(batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.randn(batch, 16).astype("float32"),
+            "y": rng.randint(0, 4, (batch, 1)).astype("int64")}
+
+
+def test_microbatch_accumulation_matches_full_batch():
+    """Tier-1 numerics pin: M microbatches of mean-accumulated grads +
+    ONE optimizer application per minibatch (via the run_steps scan)
+    reproduce the single-process full-batch step at tight rtol."""
+    feed = mlp_feed()
+    ref = reference_losses(build_mlp, feed, steps=3)
+    prog, startup, loss = build_mlp()
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_stages=2, num_microbatches=4,
+        loss_name=loss.name)
+    tr = pipe.PipelineTrainer(pp).init()
+    for i in range(3):
+        res = tr.run(feed)
+        assert res.loss == pytest.approx(ref[i], rel=1e-5), (i, res.loss)
+        assert res.microbatch_losses.shape == (4,)
+    # post-step accumulators are zeroed (reset op ran)
+    state = tr.state_dict()
+    accs = [v for n, v in state.items() if n.endswith("@ACC")]
+    assert accs and all(np.allclose(a, 0.0) for a in accs)
+
+
+@pytest.mark.slow
+def test_mnist_4stage_parity(mnist_ref):
+    """Acceptance: 4-stage pipelined mnist matches the single-process
+    loss curve at rtol <= 1e-4 — concurrent slot mode under BOTH
+    schedules (steps 1-2 GPipe, steps 3-4 1F1B against the same
+    reference curve: the two schedules must agree with the reference
+    AND each other across evolving optimizer state; scan-mode parity
+    is pinned by test_microbatch_accumulation_matches_full_batch)."""
+    import jax
+    feed, ref = mnist_ref
+
+    prog2, startup2, loss2 = build_mnist()
+    pp2 = pipe.PipelineTranspiler().transpile(
+        prog2, startup2, num_stages=4, num_microbatches=4,
+        loss_name=loss2.name)
+    tr2 = pipe.PipelineTrainer(pp2, schedule="gpipe",
+                               devices=jax.devices()[:4]).init()
+    results = [tr2.run(feed) for _ in range(2)]
+    gpipe_last = results[-1]
+    tr2.schedule = "1f1b"
+    results += [tr2.run(feed) for _ in range(2)]
+    np.testing.assert_allclose([r.loss for r in results], ref, rtol=1e-4)
+    last = results[-1]
+    assert last.mode == "slots" and last.schedule == "1f1b"
+    # the slot grid realizes the GPipe bubble bound exactly, for both
+    # schedule families
+    for r in (gpipe_last, last):
+        assert r.bubble_fraction_slots == pytest.approx(
+            pipe.gpipe_bubble_bound(4, 4))
+        assert r.bubble_fraction is not None
+        assert len(r.stage_utilization) == 4
+        assert all(u > 0 for u in r.stage_utilization)
+
+
+@pytest.mark.slow
+def test_transformer_4stage_parity():
+    """Acceptance: 4-stage pipelined tiny transformer (noam LR schedule
+    replicated per stage, skip boundaries) matches single-process at
+    rtol <= 1e-4 under both schedules (concurrent slot mode; step 1
+    GPipe, steps 2-3 1F1B against the same reference curve)."""
+    import jax
+    feed = transformer_feed()
+    ref = reference_losses(build_tiny_transformer, feed, steps=3)
+    prog, startup, loss = build_tiny_transformer()
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_stages=4, num_microbatches=4,
+        loss_name=loss.name)
+    # mask-derived biases are computed at stage 0 and consumed by
+    # every later layer: skip boundaries exist, local transport only
+    assert not pp.adjacent_only()
+    tr = pipe.PipelineTrainer(pp, schedule="gpipe",
+                              devices=jax.devices()[:4]).init()
+    got = [tr.run(feed).loss]
+    tr.schedule = "1f1b"
+    got += [tr.run(feed).loss for _ in range(2)]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_permute_transport_parity(mnist_ref):
+    """Boundary tensors moved by collective permute over the pp mesh
+    axis reproduce the single-process losses exactly."""
+    import jax
+    feed, ref = mnist_ref
+    prog, startup, loss = build_mnist()
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_stages=4, num_microbatches=4,
+        loss_name=loss.name)
+    assert pp.adjacent_only()
+    tr = pipe.PipelineTrainer(pp, schedule="gpipe",
+                              devices=jax.devices()[:4],
+                              transport="permute").init()
+    got = [tr.run(feed).loss for _ in range(2)]
+    np.testing.assert_allclose(got, ref[:2], rtol=1e-4)
+
+
+def test_ring_shifter_and_envelopes():
+    import jax
+    from paddle_tpu.pipeline.permute import (RingShifter, pack_envelope,
+                                             unpack_envelope)
+    named = {("a", 0): np.arange(6, dtype="float32").reshape(2, 3),
+             ("b", 1): np.array([[7]], dtype="int32")}
+    rt = unpack_envelope(pack_envelope(named))
+    assert set(rt) == set(named)
+    for k in named:
+        np.testing.assert_array_equal(rt[k], named[k])
+    sh = RingShifter(jax.devices()[:4])
+    payloads = [b"", pack_envelope(named), b"", b""]
+    fwd = sh.shift(payloads, direction=1)
+    assert unpack_envelope(fwd[2]).keys() == named.keys()
+    assert unpack_envelope(fwd[0]) == {} and unpack_envelope(fwd[1]) == {}
+    bwd = sh.shift(payloads, direction=-1)
+    assert unpack_envelope(bwd[0]).keys() == named.keys()
+
+
+def test_pipeline_metrics_exported():
+    """Self-contained (no dependence on test order): one tiny pipeline
+    step populates the pipeline.* gauges and the statusz provider."""
+    prog, startup = Program(), Program()
+    prog.random_seed = 1
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        with fluid.pipeline_stage_guard(0):
+            h = fluid.layers.fc(x, 8, act="relu")
+        with fluid.pipeline_stage_guard(1):
+            logits = fluid.layers.fc(h, 4, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_microbatches=2, loss_name=loss.name)
+    tr = pipe.PipelineTrainer(pp).init()
+    rng = np.random.RandomState(0)
+    tr.run({"x": rng.randn(4, 8).astype("float32"),
+            "y": rng.randint(0, 4, (4, 1)).astype("int64")})
+    from paddle_tpu.observability import stats as obs_stats
+    snap = obs_stats.snapshot()
+    keys = " ".join(snap)
+    assert "pipeline.steps" in keys
+    assert "pipeline.bubble_fraction" in keys
+    assert "pipeline.stage_activation_bytes.s0" in keys
+    from paddle_tpu.pipeline import runner as _runner
+    summary = _runner._pipeline_statusz()
+    assert summary.get("num_stages") == 2
+    assert "gpipe_bubble_bound" in summary
+
+
+def test_trainer_input_validation():
+    prog, startup, loss = build_mnist()
+    pp = pipe.PipelineTranspiler().transpile(
+        prog, startup, num_stages=2, num_microbatches=4,
+        loss_name=loss.name)
+    tr = pipe.PipelineTrainer(pp)
+    with pytest.raises(RuntimeError):
+        tr.run(mnist_feed())          # init() not called
+    tr.init()
+    with pytest.raises(ValueError):
+        tr.run(mnist_feed(batch=6))   # 6 % 4 != 0
+    with pytest.raises(ValueError):
+        pipe.PipelineTrainer(pp, schedule="nope")
+    with pytest.raises(ValueError):
+        pipe.PipelineTrainer(pp, transport="permute")  # needs devices
+
+
+def test_cross_stage_weight_sharing_rejected():
+    prog, startup = Program(), Program()
+    prog.random_seed = 1
+    with program_guard(prog, startup), unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        w = fluid.ParamAttr(name="shared.w")
+        with fluid.pipeline_stage_guard(0):
+            h = fluid.layers.fc(x, 8, act="relu", param_attr=w)
+        with fluid.pipeline_stage_guard(1):
+            h2 = fluid.layers.fc(h, 8, act="relu",
+                                 param_attr=fluid.ParamAttr(name="shared.w"))
+            logits = fluid.layers.fc(h2, 4, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    with pytest.raises(NotImplementedError):
+        pipe.PipelineTranspiler().transpile(
+            prog, startup, num_microbatches=2, loss_name=loss.name)
+
+
+# ---------------------------------------------------------------------------
+# 2-process RPC pipeline smoke
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_rpc_pipeline_two_process_smoke(tmp_path):
+    """Two subprocess stages exchange activations/grads over the striped
+    RPC transport; the distributed loss curve matches the in-process
+    pipeline at rtol <= 1e-4."""
+    sys.path.insert(0, HERE)
+    try:
+        import pipeline_runner as plr
+    finally:
+        sys.path.pop(0)
+    steps = 3
+    # in-process reference over the SAME model/data/transpile
+    prog, startup, loss = plr.build_model()
+    pp = plr.transpile(prog, startup, loss)
+    tr = pipe.PipelineTrainer(pp, schedule="1f1b").init()
+    ref = [tr.run(feed).loss for feed in plr.batches(steps)]
+
+    endpoints = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    out_path = str(tmp_path / "losses.jsonl")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PIPE_ENDPOINTS": ",".join(endpoints),
+        "PIPE_STEPS": str(steps),
+        "PIPE_SCHEDULE": "1f1b",
+        "PIPE_OUT": out_path,
+        "PADDLE_READY_DIR": str(tmp_path / "ready"),
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE), env.get("PYTHONPATH", "")]),
+    })
+    runner = os.path.join(HERE, "pipeline_runner.py")
+    procs = []
+    for s in range(2):
+        e = dict(env)
+        e["PIPE_STAGE"] = str(s)
+        procs.append(subprocess.Popen(
+            [sys.executable, runner], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    deadline = time.time() + 240
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(5.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, out.decode("utf-8", "replace")[-4000:]
+    with open(out_path) as f:
+        rows = [json.loads(line) for line in f]
+    assert len(rows) == steps
+    got = [r["loss"] for r in rows]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)

@@ -48,6 +48,7 @@ METRIC_KEYS = (
     "batched_storm_vars_per_sec",
     "batched_dense_mb_per_sec",
     "batched_qps",
+    "pipeline_samples_per_sec",
     "cold_vs_warm_speedup",
     "eff_flops",
     "pipeline_vs_link",

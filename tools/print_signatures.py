@@ -48,6 +48,15 @@ MODULES = [
     "paddle_tpu.observability.runlog",
     "bench_compare",   # tools/bench_compare.py (tools/ on sys.path here)
     "runlog_report",   # tools/runlog_report.py
+    # pipeline parallelism plane (stage transpiler, schedules, drivers,
+    # permute transport, RPC stage workers): frozen so the stage-program
+    # contract and schedule API drift loudly
+    "paddle_tpu.pipeline",
+    "paddle_tpu.pipeline.transpiler",
+    "paddle_tpu.pipeline.schedule",
+    "paddle_tpu.pipeline.runner",
+    "paddle_tpu.pipeline.permute",
+    "paddle_tpu.pipeline.rpc",
     "paddle_tpu.lod_tensor",
     "paddle_tpu.transpiler",
     "paddle_tpu.data_feeder",

@@ -143,7 +143,7 @@ class _CacheEntry:
     compile cost (0.0 for disk hits — no compile was paid)."""
 
     __slots__ = ("plan", "jitted", "meta", "from_disk", "fingerprint",
-                 "aot_ms", "perf")
+                 "aot_ms", "perf", "fused_disabled", "fused_used")
 
     def __init__(self, plan, jitted):
         self.plan = plan
@@ -152,6 +152,16 @@ class _CacheEntry:
         self.from_disk = False
         self.fingerprint = None
         self.aot_ms = None
+        # set by _recover_fused_fault: this entry was re-lowered without
+        # the fused sparse kernels after a dispatch-level compile fault
+        # (recovery is once-per-entry — a second fault re-raises)
+        self.fused_disabled = False
+        # the lowering's trace-time latch dict ({"sparse_fused": bool},
+        # build_block_fn._sparse_fused_used): did THIS entry's lowering
+        # actually emit fused sparse kernels?  None for executables with
+        # no reachable trace (disk hydrates).  Recovery gates on it —
+        # the live flag value can lie in both directions
+        self.fused_used = None
         # cost/memory attribution record (observability/perf.py) when
         # FLAGS_perf_attribution harvested this executable; else None
         self.perf = None
@@ -615,8 +625,17 @@ class Executor:
             except Exception as e:
                 jitted = self._recover_disk_entry(entry, program, e,
                                                   donated_state)
-                fetches, new_state, rng_out = jitted(feed_vals, donated_state,
-                                                     const_state, rng)
+                try:
+                    fetches, new_state, rng_out = jitted(
+                        feed_vals, donated_state, const_state, rng)
+                except Exception as e2:
+                    # an AOT/disk entry recovered to a lazy re-lower that
+                    # STILL faults: last chance is a fused-kernel compile
+                    # fault — drop the kernels once, counted
+                    jitted = self._recover_fused_fault(entry, program, e2,
+                                                       donated_state)
+                    fetches, new_state, rng_out = jitted(
+                        feed_vals, donated_state, const_state, rng)
         if tel:
             t_disp1 = time.perf_counter_ns()
             if not cache_hit:
@@ -839,8 +858,18 @@ class Executor:
                 jitted = self._recover_disk_entry(
                     entry, program, e, donated_state,
                     build_fn=self._make_scan_builder(program, entry.plan))
-                fetches, new_state, rng_out = jitted(stacked, donated_state,
-                                                     const_state, rng)
+                try:
+                    fetches, new_state, rng_out = jitted(
+                        stacked, donated_state, const_state, rng)
+                except Exception as e2:
+                    # see run(): AOT/disk recovery faulting again can
+                    # only be saved by dropping the fused kernels once
+                    jitted = self._recover_fused_fault(
+                        entry, program, e2, donated_state,
+                        build_fn=self._make_scan_builder(program,
+                                                         entry.plan))
+                    fetches, new_state, rng_out = jitted(
+                        stacked, donated_state, const_state, rng)
         if tel:
             t_disp1 = time.perf_counter_ns()
             if not cache_hit:
@@ -885,9 +914,10 @@ class Executor:
     def _make_scan_builder(self, program: Program, plan):
         """Builder for run_steps' K-step ``lax.scan`` wrapper (the
         executable the cache stores for mode="run_steps")."""
-        def build():
+        def build(disable_sparse_fused=False):
             fn = build_block_fn(program, plan, training=self._training,
-                                mesh=self._mesh())
+                                mesh=self._mesh(),
+                                disable_sparse_fused=disable_sparse_fused)
             refeed = plan.donated_write_indices
             n_writes = len(plan.persist_writes)
             extra_idx = [i for i in range(n_writes)
@@ -923,6 +953,7 @@ class Executor:
                     final_state[i] = extra[slot]
                 return fetches, final_state, rng
 
+            multi._sparse_fused_used = fn._sparse_fused_used
             return multi
         return build
 
@@ -963,8 +994,17 @@ class Executor:
         ShapeDtypeStructs — the AOT lowering's avals; any aval guessed
         wrong is recovered at dispatch (``_recover_disk_entry``).
         """
-        make = build_fn or (lambda: build_block_fn(
+        raw_make = build_fn or (lambda: build_block_fn(
             program, plan, training=self._training, mesh=self._mesh()))
+        used_cell = []  # the raw fn's _sparse_fused_used dict, once built
+
+        def make(**kw):
+            fn = raw_make(**kw)
+            cell = getattr(fn, "_sparse_fused_used", None)
+            if cell is not None:
+                used_cell[:] = [cell]
+            return fn
+
         if _compile_cache.enabled():
             fp = _compile_cache.fingerprint(program, sig, fetch_names,
                                             self._training, mode,
@@ -988,6 +1028,7 @@ class Executor:
                                  meta={"mode": mode,
                                        "fetches": list(fetch_names)})
             entry = _CacheEntry(plan, compiled)
+            entry.fused_used = used_cell[0] if used_cell else None
             entry.fingerprint = fp
             entry.aot_ms = aot_ms
             entry.perf = _obs_perf.harvest(compiled, "compile", mode,
@@ -1005,11 +1046,14 @@ class Executor:
             t0 = time.perf_counter_ns()
             jitted = jitted.lower(*args).compile()
             entry = _CacheEntry(plan, jitted)
+            entry.fused_used = used_cell[0] if used_cell else None
             entry.aot_ms = (time.perf_counter_ns() - t0) / 1e6
             entry.perf = _obs_perf.harvest(jitted, "compile", mode,
                                            compile_ms=entry.aot_ms)
             return entry
-        return _CacheEntry(plan, jitted)
+        entry = _CacheEntry(plan, jitted)
+        entry.fused_used = used_cell[0] if used_cell else None
+        return entry
 
     def _recover_disk_entry(self, entry: _CacheEntry, program: Program,
                             exc, donated_state, build_fn=None):
@@ -1024,27 +1068,82 @@ class Executor:
         way), and the run proceeds as a plain compile.
 
         Failures of lazy-jit entries — which already retrace per call —
-        re-raise untouched, as does a fault AFTER execution started
-        (donated buffers already consumed: a retry would read deleted
-        arrays; aval/sharding mismatches raise before any donation)."""
-        if entry.aot_ms is None and not entry.from_disk:
-            raise exc
+        re-raise untouched UNLESS their lowering emitted fused sparse
+        kernels (entry.fused_used latch): a
+        fused-kernel Mosaic/XLA compile fault only surfaces at this
+        layer (the per-op try/except in kernels/sparse.py covers trace
+        time only), so the counted-fallback contract is completed here
+        by ONE re-lower with the fused kernels disabled.  A fault AFTER
+        execution started (donated buffers already consumed: a retry
+        would read deleted arrays) always re-raises; aval/sharding and
+        compile faults raise before any donation."""
         if any(isinstance(v, jax.Array) and v.is_deleted()
                for v in donated_state):
             raise exc
+        if entry.aot_ms is None and not entry.from_disk:
+            return self._recover_fused_fault(entry, program, exc,
+                                             donated_state, build_fn)
         if entry.fingerprint is not None:
             # a cache-keyed executable (disk-hydrated or stored): count
             # the fault against the cache and evict the stale entry.
             # warm_start force-AOT entries with the cache OFF recompile
             # silently — there is no cache to blame
             _compile_cache.dispatch_fault(entry.fingerprint, exc)
-        make = build_fn or (lambda: build_block_fn(
-            program, entry.plan, training=self._training,
-            mesh=self._mesh()))
-        jitted = jax.jit(make(), donate_argnums=(1,))
+        jitted = jax.jit(self._entry_builder(entry, program, build_fn)(),
+                         donate_argnums=(1,))
         entry.jitted = jitted
         entry.from_disk = False
         entry.aot_ms = None
+        return jitted
+
+    def _entry_builder(self, entry, program, build_fn=None):
+        """Block-fn builder for fault-recovery re-lowers; accepts
+        ``disable_sparse_fused`` (both producers — the default
+        build_block_fn closure and _make_scan_builder's build — do).
+        The rebuilt fn's trace-time used-latch replaces the entry's (a
+        disk-hydrated entry has none until its lazy rebuild traces)."""
+        def mk(disable_sparse_fused=False):
+            if build_fn is not None:
+                fn = build_fn(disable_sparse_fused=disable_sparse_fused)
+            else:
+                fn = build_block_fn(
+                    program, entry.plan, training=self._training,
+                    mesh=self._mesh(),
+                    disable_sparse_fused=disable_sparse_fused)
+            cell = getattr(fn, "_sparse_fused_used", None)
+            if cell is not None:
+                entry.fused_used = cell
+            return fn
+        return mk
+
+    def _recover_fused_fault(self, entry, program, exc, donated_state,
+                             build_fn=None):
+        """Last line of the FLAGS_sparse_fused_kernel counted-fallback
+        contract: a compile fault that only surfaces at dispatch (Mosaic
+        on a real TPU — invisible to the trace-time try/except in
+        kernels/sparse.py) re-lowers the step ONCE with the fused
+        kernels disabled, counted in sparse_fused.runtime_disables.
+        Reached for lazy-jit entries directly from _recover_disk_entry,
+        and from the run()/run_steps() second-level retry when an
+        AOT/disk entry's fused re-lower faults again.  Gated on the
+        ENTRY's trace-time latch (entry.fused_used — the flag's live
+        value can lie in both directions: flipped since the trace, or
+        on for a program with no sparse lookups); anything whose
+        lowering emitted no fused kernels re-raises untouched."""
+        from ..kernels import sparse as _sparse_kernels
+        cell = entry.fused_used
+        if entry.fused_disabled or not (cell and cell.get("sparse_fused")):
+            raise exc
+        if any(isinstance(v, jax.Array) and v.is_deleted()
+               for v in donated_state):
+            raise exc
+        _sparse_kernels.count_runtime_disable()
+        mk = self._entry_builder(entry, program, build_fn)
+        jitted = jax.jit(mk(disable_sparse_fused=True), donate_argnums=(1,))
+        entry.jitted = jitted
+        entry.from_disk = False
+        entry.aot_ms = None
+        entry.fused_disabled = True
         return jitted
 
     def warm_start(self, program: Optional[Program] = None,

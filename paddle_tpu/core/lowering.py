@@ -119,8 +119,20 @@ def lower_ops(ctx: LowerContext, program: Program, block: Block, env: Dict) -> D
     """Trace every op in ``block`` through its lowering rule, mutating env."""
     from ..ops.control_flow_ops import CONTROL_FLOW_OPS
 
-    for op in block.ops:
+    # FLAGS_sparse_fused_kernel peephole: lookup_table ops sharing one Ids
+    # input lower through a single fused Pallas gather launch
+    # (kernels/sparse.py).  Mesh-lowered blocks keep the plain XLA gathers
+    # — GSPMD shards those natively but cannot partition a custom call —
+    # and fault-recovery re-lowers (ctx.disable_sparse_fused) skip it.
+    from ..kernels import sparse as _sparse_kernels
+    fusion = (_sparse_kernels.plan_lookup_fusion(block)
+              if _sparse_kernels.enabled_for(ctx) else None)
+
+    for pos, op in enumerate(block.ops):
         if op.type in SKIP_OPS:
+            continue
+        if fusion is not None and fusion.covers(pos) and fusion.lower(pos, env):
+            ctx.sparse_fused_used = True
             continue
         if op.type in CONTROL_FLOW_OPS:
             try:
@@ -163,11 +175,21 @@ def lower_ops(ctx: LowerContext, program: Program, block: Block, env: Dict) -> D
 
 
 def build_block_fn(program: Program, plan: BlockPlan, training: bool = True,
-                   mesh=None):
+                   mesh=None, disable_sparse_fused: bool = False):
     """Return fn(feed_vals, donated_state, const_state, rng) ->
-    (fetch_vals, new_persist_vals, rng_out)."""
+    (fetch_vals, new_persist_vals, rng_out).
+
+    ``disable_sparse_fused``: lower WITHOUT the FLAGS_sparse_fused_kernel
+    Pallas paths even when the flag is on — the executor's dispatch-fault
+    recovery re-lowers a step this way when its compile died with the
+    fused kernels in it (kernels/sparse.py counted-fallback contract)."""
     block = program.blocks[plan.block_idx]
     donated, const = plan.donated_reads, plan.const_reads
+    # trace-time latch: did THIS lowering actually emit fused sparse
+    # kernels?  The executor's dispatch-fault recovery gates on it (the
+    # flag alone lies in both directions: it may have changed since the
+    # entry traced, and a flag-on program may contain no sparse lookups)
+    used = {"sparse_fused": False}
 
     def fn(feed_vals, donated_state, const_state, rng):
         # host-side timing of the op-by-op jax trace: runs once per XLA
@@ -180,12 +202,15 @@ def build_block_fn(program: Program, plan: BlockPlan, training: bool = True,
 
         ctx = LowerContext(block=block, mesh=mesh, lower_block_fn=lower_sub,
                            training=training)
+        ctx.disable_sparse_fused = disable_sparse_fused
         ctx.set_rng(rng)
         env: Dict = {}
         env.update(zip(plan.feed_names, feed_vals))
         env.update(zip(donated, donated_state))
         env.update(zip(const, const_state))
         lower_ops(ctx, program, block, env)
+        if getattr(ctx, "sparse_fused_used", False):
+            used["sparse_fused"] = True
         fetches = [env[n] for n in plan.fetch_names]
         new_state = [env[n] for n in plan.persist_writes]
         if t0 is not None:
@@ -196,4 +221,5 @@ def build_block_fn(program: Program, plan: BlockPlan, training: bool = True,
                 _obs_trace.emit("lowering::trace", t0, t1)
         return fetches, new_state, ctx.rng_key
 
+    fn._sparse_fused_used = used
     return fn

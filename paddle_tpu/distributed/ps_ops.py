@@ -296,8 +296,20 @@ class PServerLoop:
         self.exit = False
         self.error: Exception = None
         self.block_locks: Dict[int, threading.Lock] = defaultdict(threading.Lock)
-        self.lr_lock = threading.Lock()
+        # RLock: the hogwild checkpoint runs under lr_lock and its
+        # _read_var snapshots re-enter it for LR-program vars
+        self.lr_lock = threading.RLock()
         self._async_sends = 0
+        # which optimize block WRITES each persistable var: wire/checkpoint
+        # readers must snapshot under that block's lock (see _read_var)
+        self.var_to_block: Dict[str, int] = {}
+        for bidx, bprog in self.block_progs.items():
+            blk = bprog.global_block
+            for bop in blk.ops:
+                for n in bop.output_arg_names():
+                    v = blk.var_or_none(n)
+                    if v is not None and v.persistable:
+                        self.var_to_block.setdefault(n, bidx)
 
         # HA replication state (module docstring "HA replication")
         self.backup_endpoint = op.attr("backup_endpoint", None) or None
@@ -409,9 +421,13 @@ class PServerLoop:
         os.makedirs(dirname, exist_ok=True)
         path = os.path.join(dirname,
                             f"pserver_{self.op.attr('ps_index', 0)}.npz")
-        arrs = {n: np.asarray(self.scope.find_var(n))
-                for n in self.persist_names
-                if self.scope.find_var(n) is not None}
+        # _read_var: block-lock-coherent snapshots — an async checkpoint
+        # racing a hogwild apply must not read a donated (deleted) buffer
+        arrs = {}
+        for n in self.persist_names:
+            v = self._read_var(n)
+            if v is not None:
+                arrs[n] = np.asarray(v)
         tmp = path + ".tmp.npz"
         np.savez(tmp, **arrs)
         os.replace(tmp, path)  # atomic like the Go rename
@@ -647,9 +663,17 @@ class PServerLoop:
                                    root=False,
                                    tags={"round": self.applied_rounds + 1}):
                 touched = self._merge_grads(per_trainer)
-                self._run_lr()
+                with self.lr_lock:
+                    self._run_lr()
                 for bidx in sorted(touched):
-                    self._run_block(bidx)
+                    # block lock even in sync mode: the protocol barriers
+                    # make reader overlap impossible in the NORMAL flow,
+                    # but HA promotion/fault edges can let a GET arrive
+                    # mid-apply, and _read_var's snapshot coherence
+                    # invariant ("readers snapshot under the writer
+                    # block's lock") must hold for every _run_block site
+                    with self.block_locks[bidx]:
+                        self._run_block(bidx)
         except Exception as e:
             # record + still advance the round so waiting GETs wake up and
             # surface the error instead of deadlocking (exception_holder.h
@@ -707,6 +731,29 @@ class PServerLoop:
             # only, like the Go async pserver (service.go:346)
             with self.lr_lock:
                 self._checkpoint()
+
+    def _read_var(self, name):
+        """Snapshot one scope var to host for the wire/checkpoint, coherent
+        with concurrent applies.  The optimize-block executor dispatch
+        DONATES the param's device buffer, so an unlocked reader that
+        grabbed the Array just before an async (hogwild) apply can hold a
+        deleted buffer by the time it serializes — the intermittent
+        async-mode 'Array has been deleted' crash (test_dist_train
+        deflake, PR 10).  Reading under the var's writer-block lock (the
+        same lock _apply_async runs the block under) pins apply/read
+        interleaving to whole blocks; LR-program vars snapshot under
+        lr_lock for the same reason.  Returns a host value or None."""
+        bidx = self.var_to_block.get(name)
+        if bidx is not None:
+            with self.block_locks[bidx]:
+                val = self.scope.find_var(name)
+                return None if val is None else _to_host(val)
+        if name in self.lr_fetch:
+            with self.lr_lock:
+                val = self.scope.find_var(name)
+                return None if val is None else _to_host(val)
+        val = self.scope.find_var(name)
+        return None if val is None else _to_host(val)
 
     def _wait_round(self, trainer_id) -> None:
         """Sync-mode read barrier: block until every round this trainer
@@ -777,10 +824,10 @@ class PServerLoop:
 
         if msg_type == GET_VAR:
             self._wait_round(trainer_id)
-            val = self.scope.find_var(name)
+            val = self._read_var(name)
             if val is None:
                 raise KeyError(f"pserver has no variable {name!r}")
-            return OK, serde.dumps_value(_to_host(val))
+            return OK, serde.dumps_value(val)
 
         if msg_type == GET_VARS:
             # one round-barrier wait covers the whole batch, then the
@@ -789,10 +836,10 @@ class PServerLoop:
             self._wait_round(trainer_id)
             pairs = []
             for n in names:
-                val = self.scope.find_var(n)
+                val = self._read_var(n)
                 if val is None:
                     raise KeyError(f"pserver has no variable {n!r}")
-                pairs.append((n, _to_host(val)))
+                pairs.append((n, val))
             return OK, serde.dumps_batch_vec(pairs)
 
         if msg_type == PREFETCH:
@@ -801,7 +848,7 @@ class PServerLoop:
             self._wait_round(trainer_id)
             info = self.dist_tables[name]
             ids = np.asarray(serde.loads_value(payload)).reshape(-1)
-            table = np.asarray(self.scope.find_var(info["var"]))
+            table = np.asarray(self._read_var(info["var"]))
             return OK, serde.dumps_value(table[ids])
 
         if msg_type == FETCH_BARRIER:

@@ -910,6 +910,13 @@ def _ring_vjp_bwd(axis_name, causal, sm_scale, dropout_rate, res, g):
 
         if causal:
             dq_p, dk_p, dv_p = lax.cond(kv_i < idx, compute, skip, None)
+        elif jax.default_backend() != "tpu":
+            # same routing as the forward scan (PR 6): a BARE pallas call
+            # inside this scan makes XLA's SPMD partitioner reject the
+            # off-TPU module with "PartitionId instruction is not
+            # supported"; a traced always-true cond lowers to the shape
+            # XLA accepts.  TPU keeps the straight-line call.
+            dq_p, dk_p, dv_p = lax.cond(kv_i >= 0, compute, skip, None)
         else:
             dq_p, dk_p, dv_p = compute(None)
         return (k_c, v_c, m_c, dk_a + dk_p, dv_a + dv_p, dq_a + dq_p), None

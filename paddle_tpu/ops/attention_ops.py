@@ -45,9 +45,17 @@ def _fused_attention(ctx, ins, attrs):
         # through seq 1024 in-model (105k vs 76k tok/s at 256; 49k vs 37k
         # at 1024, Transformer-base); the flash kernel's win is O(block)
         # memory, so auto switches only where the O(T^2) scores would
-        # dominate HBM (long-context training)
+        # dominate HBM (long-context training).  The crossover is
+        # head_dim-aware (PERF.md §1 round 4): at D >= 128 the kernel
+        # runs 44-64 TFLOPs and wins from 2048; at D < 128 every MXU dot
+        # is half-filled by construction (~23-25 TFLOPs ceiling, packing
+        # remedies measured equal) while the XLA ratio narrows only
+        # slowly (1.8x at 256 -> 1.3x at 1024), so D=64 geometries stay
+        # on XLA until 4096, where the score materialization cost
+        # dominates either way.
+        threshold = 2048 if q.shape[-1] >= 128 else 4096
         impl = "pallas" if (jax.default_backend() == "tpu"
-                            and k.shape[2] >= 2048) else "xla"
+                            and k.shape[2] >= threshold) else "xla"
 
     if impl == "xla":
         out = A.mha_xla(q, k, v, kv_mask, causal, scale,

@@ -341,3 +341,19 @@ def test_scan_driver_loss_trajectory_matches():
 
     a, b = final_loss(False), final_loss(True)
     np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_deepfm_fused_headline_wired_into_compare_gate():
+    """ISSUE 10 satellite: the deepfm_fused config's headline metric must
+    be a bench_compare METRIC_KEY (so the regression gate and the
+    measured-configs accounting see the fused capture), and the config
+    must be registered with the orchestrator."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import bench_compare
+
+    import bench
+
+    assert "fused_samples_per_sec" in bench_compare.METRIC_KEYS
+    names = [n for n, _, _, _ in bench.CONFIG_TABLE]
+    assert "deepfm_fused" in names

@@ -43,6 +43,7 @@ METRIC_KEYS = (
     "images_per_sec",
     "tokens_per_sec",
     "samples_per_sec",
+    "fused_samples_per_sec",
     "tflops",
     "implied_sp4_tokens_per_sec_per_device",
     "batched_storm_vars_per_sec",

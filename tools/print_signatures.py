@@ -100,6 +100,10 @@ MODULES = [
     # admin-tooling drift is loud
     "paddle_tpu.core.compile_cache",
     "cache_admin",  # tools/cache_admin.py (tools/ is on sys.path here)
+    # the fused sparse-embedding kernel surface (FLAGS_sparse_fused_kernel
+    # gather/update entry points + the lowering peephole planner): frozen
+    # so the optimizer-wiring contract drifts loudly
+    "paddle_tpu.kernels.sparse",
 ]
 
 

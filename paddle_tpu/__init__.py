@@ -70,6 +70,7 @@ from . import executor, framework  # noqa: F401  (fluid.framework idioms)
 from .data_feeder import DataFeeder  # noqa: F401
 from .distributed import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from . import pipeline  # noqa: F401  (pipeline parallelism plane)
+from . import checkpoint  # noqa: F401  (sharded checkpoints + elastic resize)
 from .contrib import (  # noqa: F401
     BeginEpochEvent,
     BeginStepEvent,

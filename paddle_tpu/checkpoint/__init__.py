@@ -1,0 +1,42 @@
+"""Topology-independent sharded checkpoints + elastic resize.
+
+Every host writes only the variable shards it owns plus a JSON manifest
+(var → global shape/dtype, shard → dim-0 slice extents, writer
+topology, content digests, monotonic step id); restore reads manifests,
+plans per-host reads, and re-shards to ANY target layout — N→M
+pservers, a different pipeline stage count, ZeRO on or off — with a
+two-phase commit (everything lands under ``_tmp``, then one atomic
+rename) so a crash mid-save can never yield a loadable half-checkpoint
+and restore always picks the newest COMPLETE step.  The survey's §5
+checkpoint/resume discipline generalized the way DeepSpeed universal
+checkpoints and Orbax do, for exactly the elastic failure mode the §2.8
+runtime (PRs 2/6) keeps jobs alive through.
+
+Modules: :mod:`manifest` (the shard catalog), :mod:`store` (two-phase
+commit step directories), :mod:`reshard` (the restore planner),
+:mod:`snapshot` (async no-pause snapshotter), :mod:`elastic` (scope
+save/restore, fleet-cut helpers, registry-gauge resize controller).
+Integration points: ``DistributeTranspilerConfig.checkpoint_sharded``
+(pserver shards + restart/resize hydration),
+``ParallelExecutor.save_sharded_state`` (ZeRO layouts),
+``pipeline.PipelineTrainer.save_checkpoint`` (stage layouts),
+``distributed.notify_checkpoint`` (the fleet cut), and
+``TaskMaster.stamp_checkpoint`` (cut-step publication).
+"""
+from . import elastic, manifest, reshard, snapshot, store  # noqa: F401
+from .elastic import (ElasticController, restore_scope, save_scope,
+                      scope_snapshotter, wait_step_complete)
+from .manifest import Manifest
+from .reshard import load_locals, load_vars
+from .snapshot import AsyncSnapshotter
+from .store import (CheckpointError, commit_single, complete_steps,
+                    inflight_steps, latest_complete_step, load_manifest,
+                    prune, try_commit, verify_step, write_piece)
+
+__all__ = [
+    "AsyncSnapshotter", "CheckpointError", "ElasticController", "Manifest",
+    "commit_single", "complete_steps", "inflight_steps",
+    "latest_complete_step", "load_locals", "load_manifest", "load_vars",
+    "prune", "restore_scope", "save_scope", "scope_snapshotter",
+    "try_commit", "verify_step", "wait_step_complete", "write_piece",
+]

@@ -1,0 +1,193 @@
+"""Elastic resize: fleets whose state survives a mesh change.
+
+The mechanics of growing/shrinking a fleet on this checkpoint plane:
+
+1. **Cut**: the trainer (or an operator) picks a step id and asks every
+   state owner to snapshot it — pservers via ``checkpoint_notify`` with
+   an explicit step (``distributed.notify_checkpoint``), executors /
+   pipeline trainers via their save helpers.  In sync mode the round
+   barrier IS the consistent cut; the master additionally stamps the
+   cut step through its snapshot/publish path
+   (``TaskMaster.stamp_checkpoint``) so every standby mirror and every
+   late joiner agrees on which step the fleet cut at.
+2. **Commit**: each writer's piece lands under ``_tmp``;
+   :func:`wait_step_complete` polls the shared root until the atomic
+   commit rename happens.  A writer killed mid-snapshot simply means
+   the step never commits — restore picks the previous COMPLETE step.
+3. **Reshard + rejoin**: the NEW fleet (any size) transpiles its own
+   layout and hydrates from the manifest — each joining host reads
+   exactly the rows it now owns (``reshard.load_locals``); a departing
+   host's rows are simply read by whoever owns them now.  Reader/task
+   leases follow via the TaskMaster's existing health-driven requeue.
+
+:class:`ElasticController` turns the registry's live lease/health
+gauges into resize decisions (how many workers of a role are ALIVE vs
+a target) — the policy half; the state mechanics above are the half
+that makes acting on the decision safe.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import reshard as _reshard
+from . import store as _store
+from .snapshot import AsyncSnapshotter
+from .store import CheckpointError
+
+__all__ = ["save_scope", "restore_scope", "scope_snapshotter",
+           "wait_step_complete", "ElasticController"]
+
+RNG_STATE_VAR = "@RNG_STATE@"
+
+
+def _persistable_names(program, scope) -> List[str]:
+    names = [v.name for v in program.global_block.vars.values()
+             if v.persistable and v.name != RNG_STATE_VAR]
+    return [n for n in names if scope.find_var(n) is not None]
+
+
+def _collect_scope(scope, names) -> Dict[str, np.ndarray]:
+    """Host snapshot of scope vars with overlapped device→host readback:
+    kick every ``copy_to_host_async`` first, then materialize — the
+    waits overlap instead of serializing (the send host op's pattern)."""
+    vals = {n: scope.find_var(n) for n in names}
+    for v in vals.values():
+        start = getattr(v, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # pragma: no cover - committed buffers etc.
+                pass
+    return {n: np.asarray(v) for n, v in vals.items() if v is not None}
+
+
+def save_scope(root: str, step: int, program, scope,
+               writer: str = "host0",
+               topology: Optional[dict] = None) -> str:
+    """Synchronous single-writer checkpoint of a program's persistable
+    state (the plain one-host cell of the reshard matrix).  Every var is
+    written as a whole shard under its own name, so any other layout can
+    re-shard from it and it can absorb any other layout's manifest."""
+    arrays = _collect_scope(scope, _persistable_names(program, scope))
+    topo = {"kind": "local", **(topology or {})}
+    return _store.commit_single(root, step, writer, arrays, topology=topo)
+
+
+def restore_scope(root: str, program, scope, step: Optional[int] = None,
+                  verify: bool = True, strict: bool = True) -> int:
+    """Restore a program's persistable state from the newest (or given)
+    COMPLETE step, re-sharding from WHATEVER topology wrote it.  With
+    ``strict`` a persistable var missing from the manifest is an error
+    (a silently-uninitialized param is a wrong-answer factory); relaxed
+    mode skips it.  Returns the restored step id."""
+    if step is None:
+        step = _store.latest_complete_step(root)
+        if step is None:
+            raise CheckpointError(
+                f"no COMPLETE checkpoint step under {root!r}")
+    man = _store.load_manifest(root, step)
+    have = man.vars()
+    names = [v.name for v in program.global_block.vars.values()
+             if v.persistable and v.name != RNG_STATE_VAR]
+    missing = [n for n in names if n not in have]
+    if missing and strict:
+        raise CheckpointError(
+            f"checkpoint step {step} under {root!r} is missing "
+            f"persistable vars {missing[:8]} (of {len(names)}); pass "
+            "strict=False to restore the intersection")
+    wants = {n: (None, None) for n in names if n in have}
+    vals = _reshard.load_vars(root, step, wants, verify=verify)
+    for n, v in vals.items():
+        scope.set_var(n, v)
+    return step
+
+
+def scope_snapshotter(root: str, program, scope, writer: str = "host0",
+                      topology: Optional[dict] = None,
+                      keep: Optional[int] = None) -> AsyncSnapshotter:
+    """Async no-pause snapshotter over an executor scope: call
+    ``snapshot(step)`` from the training loop between steps; collect is
+    one overlapped host readback, serialization/fsync/commit run on the
+    background thread.  The persistable set is re-probed per snapshot —
+    state that enters the scope later (lazily-created optimizer
+    accumulators, a snapshotter built before startup ran) is picked up
+    instead of silently missing from every committed step."""
+
+    def collect(step):
+        return _collect_scope(scope, _persistable_names(program, scope))
+
+    return AsyncSnapshotter(root, writer, collect,
+                            topology={"kind": "local", **(topology or {})},
+                            expected_writers=[writer], keep=keep)
+
+
+def wait_step_complete(root: str, step: int, timeout: float = 60.0,
+                       poll: float = 0.05,
+                       expected_writers=None) -> bool:
+    """Poll (and opportunistically commit) until ``step`` is COMPLETE.
+    The caller that triggered a fleet cut uses this to learn the commit
+    landed before acting on it (e.g. before tearing the old fleet
+    down).  Returns False on timeout — only-COMPLETE-steps semantics
+    mean a False here leaves the previous checkpoint authoritative."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            if _store.try_commit(root, step, expected_writers):
+                return True
+        except CheckpointError:
+            # a torn piece set can never commit; report timeout-style
+            pass
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(poll)
+
+
+class ElasticController:
+    """Resize decisions from the registry's live lease/health gauges.
+
+    Polls the discovery registry's health table (the same one the
+    fleet-health plane and the master's dead-requeue consume) and
+    reports, per role, who is ALIVE — the input to a grow/shrink
+    decision against a target size.  Deciding is cheap and read-only;
+    *acting* is the caller's move (start workers pointed at the
+    checkpoint root / retire leases), with the checkpoint plane making
+    the action safe."""
+
+    def __init__(self, registry_ep: str, poll_ttl: float = 2.0):
+        from ..distributed import transport as _transport
+        self.registry_ep = registry_ep
+        self.poll_ttl = poll_ttl
+        self._client = _transport.RPCClient(0)
+        self._cache = {"t": float("-inf"), "table": {}}
+
+    def fleet_view(self, refresh: bool = False) -> Dict[str, dict]:
+        """{worker: {state, role, ...}} from the registry health table,
+        cached for ``poll_ttl``."""
+        from ..distributed import registry as _registry_mod
+        now = time.monotonic()
+        if refresh or now - self._cache["t"] >= self.poll_ttl:
+            self._cache["t"] = now
+            self._cache["table"] = _registry_mod.fetch_health(
+                self._client, self.registry_ep,
+                connect_timeout=min(2.0, max(0.5, self.poll_ttl)))
+        return self._cache["table"]
+
+    def alive(self, role: str) -> List[str]:
+        from ..observability import health as _health
+        return sorted(w for w, info in self.fleet_view().items()
+                      if info.get("role") == role
+                      and info.get("state") != _health.DEAD)
+
+    def decide(self, role: str, target: int) -> dict:
+        """Grow/shrink recommendation for ``role`` against ``target``
+        live workers: {"action": "grow"|"shrink"|"hold", "delta": n,
+        "alive": [...]}."""
+        alive = self.alive(role)
+        n = len(alive)
+        action = "hold" if n == target else ("grow" if n < target
+                                             else "shrink")
+        return {"action": action, "delta": abs(target - n),
+                "alive": alive, "target": target}

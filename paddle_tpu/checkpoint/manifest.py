@@ -1,0 +1,178 @@
+"""Checkpoint manifests: the topology-independent shard catalog.
+
+A checkpoint step is a set of *shard files* (one ``.npz`` per writer)
+plus a ``MANIFEST.json`` describing, for every variable,
+
+- its GLOBAL identity: name, global shape, dtype — independent of how
+  any particular fleet sliced it;
+- the shards covering it: which file, which npz key, which contiguous
+  dim-0 row range ``[offset, offset + rows)`` of the global array, and a
+  content digest;
+- the topology that WROTE it (#pservers, dp/pp/ZeRO layout, sync mode) —
+  recorded for operators and debuggers, never *required* by restore:
+  the whole point is that restore plans reads from extents alone, so a
+  checkpoint written under any layout re-shards onto any other
+  (the DeepSpeed universal-checkpoint / Orbax discipline).
+
+Replicated variables (LR schedule state, per-section scalar optimizer
+accumulators like ``beta1_pow`` — values identical on every writer by
+construction) carry ``offset = None``; every writer may record its
+copy and restore reads any one of them.
+
+Writers each produce a *manifest piece* (``manifest-<writer>.json``,
+same schema, only their own shards); the committer merges pieces into
+the final ``MANIFEST.json`` (store.py owns the two-phase commit).
+"""
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["FORMAT_VERSION", "Manifest", "array_digest", "merge_pieces",
+           "shard_entry"]
+
+FORMAT_VERSION = 1
+
+
+def array_digest(arr: np.ndarray) -> str:
+    """Content digest of one shard array (crc32 over the raw C-order
+    bytes, prefixed so the algorithm can evolve)."""
+    arr = np.ascontiguousarray(arr)
+    return "crc32:%08x" % (zlib.crc32(arr.tobytes()) & 0xFFFFFFFF)
+
+
+def file_digest(data: bytes) -> str:
+    """Digest of a whole shard FILE — verifiable with stdlib alone
+    (tools/ckpt_admin.py runs on hosts without numpy)."""
+    return "crc32:%08x" % (zlib.crc32(data) & 0xFFFFFFFF)
+
+
+def shard_entry(var: str, key: str, file: str, writer: str,
+                shape: Sequence[int], dtype: str, digest: str,
+                offset: Optional[int] = None,
+                global_shape: Optional[Sequence[int]] = None) -> dict:
+    """One shard record.  ``offset=None`` marks a replicated copy (any
+    writer's copy restores the var); otherwise the shard covers global
+    rows ``[offset, offset + shape[0])``."""
+    return {
+        "var": var, "key": key, "file": file, "writer": writer,
+        "shape": [int(s) for s in shape], "dtype": str(dtype),
+        "digest": digest,
+        "offset": None if offset is None else int(offset),
+        "global_shape": [int(s) for s in (global_shape
+                                          if global_shape is not None
+                                          else shape)],
+    }
+
+
+class Manifest:
+    """In-memory view of a (piece or merged) manifest."""
+
+    def __init__(self, step: int, topology: Optional[dict] = None,
+                 writers: Optional[List[str]] = None,
+                 shards: Optional[List[dict]] = None,
+                 files: Optional[Dict[str, dict]] = None,
+                 expected_writers: Optional[List[str]] = None):
+        self.step = int(step)
+        self.topology = dict(topology or {})
+        self.writers = list(writers or [])
+        self.shards = list(shards or [])
+        self.files = dict(files or {})
+        # recorded by each piece so a committer (or an admin tool) can
+        # tell a complete piece set from a partial one without any
+        # out-of-band coordination
+        self.expected_writers = (list(expected_writers)
+                                 if expected_writers is not None else None)
+
+    # -- var catalog -------------------------------------------------------
+    def vars(self) -> Dict[str, dict]:
+        """{var: {"global_shape", "dtype", "replicated"}} derived from
+        the shard list (the shards are the source of truth; a derived
+        catalog cannot drift from them)."""
+        out: Dict[str, dict] = {}
+        for s in self.shards:
+            ent = out.setdefault(s["var"], {
+                "global_shape": list(s["global_shape"]),
+                "dtype": s["dtype"],
+                "replicated": s["offset"] is None,
+            })
+            if list(s["global_shape"]) != ent["global_shape"] \
+                    or s["dtype"] != ent["dtype"]:
+                raise ValueError(
+                    f"manifest inconsistency for var {s['var']!r}: shard "
+                    f"{s['key']!r} declares global shape "
+                    f"{s['global_shape']}/{s['dtype']} but another shard "
+                    f"declared {ent['global_shape']}/{ent['dtype']}")
+        return out
+
+    def shards_of(self, var: str) -> List[dict]:
+        return [s for s in self.shards if s["var"] == var]
+
+    def nbytes(self) -> int:
+        return sum(int(f.get("nbytes", 0)) for f in self.files.values())
+
+    # -- (de)serialization -------------------------------------------------
+    def to_dict(self) -> dict:
+        d = {
+            "format_version": FORMAT_VERSION,
+            "step": self.step,
+            "topology": self.topology,
+            "writers": self.writers,
+            "shards": self.shards,
+            "files": self.files,
+        }
+        if self.expected_writers is not None:
+            d["expected_writers"] = self.expected_writers
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Manifest":
+        ver = int(d.get("format_version", 0))
+        if ver > FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint manifest format {ver} is newer than this "
+                f"build understands ({FORMAT_VERSION}); upgrade before "
+                "restoring")
+        return cls(step=d["step"], topology=d.get("topology"),
+                   writers=d.get("writers"), shards=d.get("shards"),
+                   files=d.get("files"),
+                   expected_writers=d.get("expected_writers"))
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def loads(cls, text: str) -> "Manifest":
+        return cls.from_dict(json.loads(text))
+
+
+def merge_pieces(pieces: List[Manifest]) -> Manifest:
+    """Merge per-writer manifest pieces into the final step manifest.
+    Validates step agreement and cross-writer var consistency (a var
+    two writers disagree on — shape or dtype — is a torn checkpoint
+    and must fail the COMMIT, not a later restore)."""
+    if not pieces:
+        raise ValueError("no manifest pieces to merge")
+    step = pieces[0].step
+    merged = Manifest(step, topology=pieces[0].topology)
+    seen_writers = set()
+    for p in pieces:
+        if p.step != step:
+            raise ValueError(
+                f"manifest pieces disagree on step: {p.step} vs {step}")
+        for w in p.writers:
+            if w in seen_writers:
+                raise ValueError(f"duplicate manifest piece for writer "
+                                 f"{w!r} at step {step}")
+            seen_writers.add(w)
+        merged.writers.extend(p.writers)
+        merged.shards.extend(p.shards)
+        merged.files.update(p.files)
+        if p.expected_writers:
+            merged.expected_writers = list(p.expected_writers)
+    merged.writers.sort()
+    merged.vars()    # consistency check across writers
+    return merged

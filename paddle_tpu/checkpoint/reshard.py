@@ -1,0 +1,216 @@
+"""Restore planner: re-shard a committed checkpoint onto ANY layout.
+
+Restore never cares what fleet wrote a checkpoint.  A reader declares
+*wants* — for each variable, either the whole global array or a
+contiguous dim-0 row range — and the planner maps each want onto the
+manifest's shard extents, reads only the shard files it needs (each
+file opened once per restore), slices, and reassembles.  That is the
+whole topology-independence contract: N pservers → M pservers (both
+directions), ZeRO on ↔ off, pipeline stages → one host, all reduce to
+the same row-range arithmetic.
+
+Every failure names its variable and rows: a coverage gap (the written
+shards do not cover a wanted range) or an overlap disagreement is a
+torn/foreign checkpoint and must be loud, never a silent zero-fill.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import store as _store
+from .manifest import Manifest, array_digest
+from .store import CheckpointError
+
+__all__ = ["plan_reads", "load_vars", "load_locals"]
+
+
+def plan_reads(man: Manifest, var: str,
+               offset: Optional[int], rows: Optional[int]) -> List[dict]:
+    """Shard reads covering ``var`` rows ``[offset, offset+rows)`` —
+    or any one replicated copy when the manifest's shards for it are
+    replicated.  Returns ``[{"shard", "lo", "hi"}]`` with lo/hi local
+    to the shard array.  Raises CheckpointError on unknown vars and
+    coverage gaps."""
+    shards = man.shards_of(var)
+    if not shards:
+        raise CheckpointError(
+            f"checkpoint step {man.step} has no variable {var!r} "
+            f"(has: {sorted(man.vars())[:20]}...)")
+    replicated = [s for s in shards if s["offset"] is None]
+    if replicated:
+        return [{"shard": replicated[0], "lo": 0,
+                 "hi": replicated[0]["shape"][0]
+                 if replicated[0]["shape"] else 0}]
+    gshape = shards[0]["global_shape"]
+    if not gshape:
+        # 0-d var: only whole-array shards exist; any copy restores it
+        return [{"shard": shards[0], "lo": 0, "hi": 0}]
+    total = int(gshape[0])
+    if offset is None:
+        offset, rows = 0, total
+    if rows is None:
+        rows = total - offset
+    if offset < 0 or rows < 0 or offset + rows > total:
+        raise CheckpointError(
+            f"restore of {var!r} wants rows [{offset}, {offset + rows}) "
+            f"outside the global shape {gshape}")
+    ordered = sorted(shards, key=lambda s: s["offset"])
+    # overlap disagreements are LOUD: two dense shards claiming the
+    # same rows means two writers disagreed about ownership (a torn or
+    # misconfigured save) — restore must refuse, never silently pick
+    # whichever sorts first.  (Replicated copies are the sanctioned
+    # duplication mechanism and were handled above.)
+    prev = None
+    for s in ordered:
+        if prev is not None and s["offset"] < prev["offset"] + \
+                prev["shape"][0]:
+            raise CheckpointError(
+                f"restore of {var!r}: shards {prev['key']!r} (writer "
+                f"{prev['writer']}) and {s['key']!r} (writer "
+                f"{s['writer']}) overlap on rows — ambiguous "
+                "checkpoint, refusing to restore")
+        prev = s
+    want_lo, want_hi = offset, offset + rows
+    plan, cover = [], want_lo
+    for s in ordered:
+        s_lo, s_hi = s["offset"], s["offset"] + s["shape"][0]
+        if s_hi <= cover or s_lo >= want_hi:
+            continue
+        if s_lo > cover:
+            raise CheckpointError(
+                f"restore of {var!r}: rows [{cover}, {s_lo}) are covered "
+                f"by no shard (writers {man.writers}) — torn or "
+                "incompatible checkpoint")
+        lo = max(cover, s_lo)
+        plan.append({"shard": s, "lo": lo - s_lo,
+                     "hi": min(want_hi, s_hi) - s_lo})
+        cover = min(want_hi, s_hi)
+        if cover >= want_hi:
+            break
+    if cover < want_hi:
+        raise CheckpointError(
+            f"restore of {var!r}: rows [{cover}, {want_hi}) are covered "
+            f"by no shard (writers {man.writers})")
+    return plan
+
+
+def _gather(man: Manifest, sdir: str, wants: List[Tuple[str, dict]],
+            verify: bool) -> Dict[str, np.ndarray]:
+    """Execute planned reads for ``wants = [(out_name, want), ...]``
+    where want = {"var", "offset", "rows"}.  Opens each shard file once
+    and digest-verifies each USED shard array once."""
+    catalog = man.vars()
+    plans: Dict[str, List[dict]] = {}
+    need_files: Dict[str, List[str]] = {}
+    for out_name, w in wants:
+        plan = plan_reads(man, w["var"], w.get("offset"), w.get("rows"))
+        plans[out_name] = plan
+        for p in plan:
+            need_files.setdefault(p["shard"]["file"], []).append(out_name)
+
+    loaded: Dict[Tuple[str, str], np.ndarray] = {}
+    verified = set()
+    for fn in sorted(need_files):
+        path = os.path.join(sdir, fn)
+        try:
+            data = np.load(path)
+        except FileNotFoundError:
+            raise CheckpointError(
+                f"checkpoint shard file {path!r} named by the manifest "
+                "is missing")
+        except Exception as e:
+            raise CheckpointError(
+                f"checkpoint shard file {path!r} is unreadable/corrupt: "
+                f"{e!r}")
+        with data:
+            keys_needed = {p["shard"]["key"]
+                           for out_name in set(need_files[fn])
+                           for p in plans[out_name]
+                           if p["shard"]["file"] == fn}
+            for key in sorted(keys_needed):
+                if key not in data.files:
+                    raise CheckpointError(
+                        f"shard key {key!r} missing from {path!r}")
+                arr = data[key]
+                shard = next(p["shard"] for ps in plans.values()
+                             for p in ps if p["shard"]["key"] == key
+                             and p["shard"]["file"] == fn)
+                if verify and (fn, key) not in verified:
+                    if array_digest(arr) != shard["digest"]:
+                        raise CheckpointError(
+                            f"var {shard['var']!r} shard {key!r} in "
+                            f"{path!r} fails its content digest — "
+                            "refusing to restore corrupt state")
+                    verified.add((fn, key))
+                loaded[(fn, key)] = arr
+
+    out: Dict[str, np.ndarray] = {}
+    for out_name, w in wants:
+        plan = plans[out_name]
+        info = catalog[w["var"]]
+        first = loaded[(plan[0]["shard"]["file"], plan[0]["shard"]["key"])]
+        if plan[0]["shard"]["offset"] is None or first.ndim == 0:
+            # replicated (any copy) or 0-d (whole-array shards only).
+            # A DENSE want against a replicated shard still gets only
+            # its rows — a reader's extent table must not care whether
+            # the writer stored the var sharded or replicated
+            arr = np.array(first)
+            off, rows = w.get("offset"), w.get("rows")
+            if off is not None and arr.ndim >= 1:
+                hi = arr.shape[0] if rows is None else off + rows
+                if off < 0 or hi > arr.shape[0]:
+                    raise CheckpointError(
+                        f"restore of {w['var']!r}: rows [{off}, {hi}) "
+                        f"outside the replicated copy's shape "
+                        f"{arr.shape}")
+                arr = arr[off:hi]
+            out[out_name] = arr
+            continue
+        parts = [loaded[(p["shard"]["file"], p["shard"]["key"])]
+                 [p["lo"]:p["hi"]] for p in plan]
+        arr = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+        out[out_name] = np.array(arr, dtype=info["dtype"], copy=True)
+    return out
+
+
+def load_vars(root: str, step: Optional[int] = None,
+              wants: Optional[Dict[str, Tuple[Optional[int],
+                                              Optional[int]]]] = None,
+              verify: bool = True) -> Dict[str, np.ndarray]:
+    """Load global variables from the newest (or given) COMPLETE step.
+
+    ``wants`` maps var → ``(offset, rows)`` (``(None, None)`` or absent
+    map = full arrays for every var in the manifest).  Returns
+    {var: np.ndarray} keyed by GLOBAL names."""
+    if step is None:
+        step = _store.latest_complete_step(root)
+        if step is None:
+            raise CheckpointError(
+                f"no COMPLETE checkpoint step under {root!r}")
+    man = _store.load_manifest(root, step)
+    if wants is None:
+        wants = {v: (None, None) for v in man.vars()}
+    pairs = [(name, {"var": name, "offset": off, "rows": rows})
+             for name, (off, rows) in sorted(wants.items())]
+    return _gather(man, _store.step_dir(root, step), pairs, verify)
+
+
+def load_locals(root: str, step: Optional[int],
+                wants: Dict[str, dict],
+                verify: bool = True) -> Dict[str, np.ndarray]:
+    """Load LOCAL-named slices: ``wants`` maps each local (layout-
+    specific) name to ``{"var": global, "offset": int|None, "rows":
+    int|None}`` — the restore side of a shard-extent table (e.g. a
+    pserver hydrating its sections from any writer topology).  Returns
+    {local_name: np.ndarray}."""
+    if step is None:
+        step = _store.latest_complete_step(root)
+        if step is None:
+            raise CheckpointError(
+                f"no COMPLETE checkpoint step under {root!r}")
+    man = _store.load_manifest(root, step)
+    return _gather(man, _store.step_dir(root, step),
+                   sorted(wants.items()), verify)

@@ -1,0 +1,239 @@
+"""Async snapshotter: checkpoints that never pause the step loop.
+
+Phase split:
+
+- **collect** (caller thread): ``collect_fn(step)`` returns the host
+  arrays to persist.  Callers keep this cheap-and-coherent — the
+  pserver's collector snapshots under the writer-block locks and kicks
+  ``copy_to_host_async`` on every device value before materializing
+  (PR 10 ``_read_var`` coherence + overlapped readback), an executor
+  collector just reads the scope between steps.  This is the ONLY part
+  the training thread pays for.
+- **serialize + fsync + commit** (background thread): the npz
+  serialization, digesting, fsync and two-phase commit all run off the
+  step loop.  While a snapshot is in flight a new request is *skipped*
+  (counted), never queued — checkpointing degrades to a lower cadence
+  under pressure instead of stalling training.
+
+Observability: ``checkpoint.{snapshots,skipped_inflight,bytes,commits,
+faults}`` counters, ``checkpoint.inflight`` / ``checkpoint.save_ms`` /
+``checkpoint.collect_ms`` / ``checkpoint.last_step`` gauges, a
+``checkpoint`` /statusz provider listing every live snapshotter, and a
+flight-recorder note per fault class (collect / write / commit).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..observability import debug_server as _debug_server
+from ..observability import flight as _flight
+from ..observability import stats as _obs_stats
+from ..observability.trace import flags_on as _telemetry_on
+from . import store as _store
+
+__all__ = ["AsyncSnapshotter"]
+
+_ckpt_metrics = None
+_live: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _cm():
+    global _ckpt_metrics
+    m = _ckpt_metrics
+    if m is None:
+        import types as _t
+        sc = _obs_stats.scope("checkpoint")
+        m = _t.SimpleNamespace(
+            snapshots=sc.counter(
+                "snapshots", "async snapshots accepted (collect started)"),
+            skipped=sc.counter(
+                "skipped_inflight",
+                "snapshot requests skipped because a previous snapshot "
+                "was still writing (cadence degraded, loop never blocked)"),
+            bytes=sc.counter("bytes", "checkpoint bytes written to disk"),
+            commits=sc.counter(
+                "commits", "two-phase commits this process completed"),
+            faults=sc.counter(
+                "faults", "checkpoint faults by any class (collect/"
+                "write/commit); each leaves a flight note"),
+            inflight=sc.gauge(
+                "inflight", "async snapshot writes currently in flight"),
+            save_ms=sc.gauge(
+                "save_ms", "background serialize+fsync+commit wall of "
+                "the last snapshot (off the step loop)"),
+            collect_ms=sc.gauge(
+                "collect_ms", "caller-thread collect wall of the last "
+                "snapshot (the ONLY step-loop cost)"),
+            last_step=sc.gauge("last_step", "last committed/written step"),
+        )
+        _ckpt_metrics = m
+    return m
+
+
+def _statusz() -> dict:
+    return {"snapshotters": [s.status() for s in list(_live)]}
+
+
+_debug_server.register_provider("checkpoint", _statusz)
+
+
+class AsyncSnapshotter:
+    """Write sharded checkpoint pieces off the step loop.
+
+    ``collect_fn(step) -> {local_name: host array}`` runs on the CALLER
+    thread (keep it lock-coherent and cheap); everything else runs on a
+    single background thread per snapshotter.  ``extents`` maps local
+    names to manifest extents (see store.write_piece); ``keep`` prunes
+    old COMPLETE steps after each commit this process wins."""
+
+    def __init__(self, root: str, writer: str,
+                 collect_fn: Callable[[int], Dict[str, np.ndarray]],
+                 extents: Optional[Dict[str, dict]] = None,
+                 topology: Optional[dict] = None,
+                 expected_writers: Optional[Sequence[str]] = None,
+                 keep: Optional[int] = None):
+        self.root = root
+        self.writer = writer
+        self.collect_fn = collect_fn
+        self.extents = extents
+        self.topology = topology
+        self.expected_writers = (sorted(expected_writers)
+                                 if expected_writers else None)
+        self.keep = keep
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._last = {"step": None, "save_ms": None, "collect_ms": None,
+                      "bytes": 0, "committed": False, "fault": None}
+        self.faults = 0
+        self.snapshots = 0
+        self.skipped = 0
+        _live.add(self)
+
+    # -- public -----------------------------------------------------------
+    def snapshot(self, step: int, wait: bool = False) -> bool:
+        """Request a snapshot of ``step``.  Returns False (counted) when
+        a previous snapshot is still in flight — never blocks the caller
+        on serialization.  ``wait=True`` joins the write (tests,
+        shutdown barriers)."""
+        collect_exc = None
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                self.skipped += 1
+                if _telemetry_on():
+                    _cm().skipped.inc()
+                return False
+            t0 = time.perf_counter()
+            try:
+                arrays = self.collect_fn(step)
+            except Exception as e:
+                # _fault re-takes the (non-reentrant) lock — record the
+                # exception and handle it OUTSIDE the with-block
+                collect_exc = e
+            else:
+                collect_ms = (time.perf_counter() - t0) * 1e3
+                self._last["collect_ms"] = round(collect_ms, 3)
+                if _telemetry_on():
+                    _cm().snapshots.inc()
+                    _cm().collect_ms.set(collect_ms)
+                    _cm().inflight.set(1)
+                self.snapshots += 1
+                t = threading.Thread(target=self._write,
+                                     args=(step, arrays), daemon=True,
+                                     name=f"ckpt-{self.writer}")
+                self._thread = t
+                t.start()
+        if collect_exc is not None:
+            self._fault("collect", step, collect_exc)
+            return False
+        if wait:
+            t.join()
+        return True
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Join any in-flight write (shutdown path).  True when idle."""
+        with self._lock:
+            t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            return not t.is_alive()
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain (bounded when ``timeout`` given) and unregister.  A
+        write wedged past the timeout (dead mount mid-fsync) is
+        abandoned to its daemon thread rather than hanging shutdown —
+        an uncommitted piece is exactly what the two-phase commit
+        tolerates."""
+        self.flush(timeout)
+        _live.discard(self)
+
+    def status(self) -> dict:
+        with self._lock:
+            inflight = self._thread is not None and self._thread.is_alive()
+            d = dict(self._last)
+        d.update({"root": self.root, "writer": self.writer,
+                  "inflight": inflight, "snapshots": self.snapshots,
+                  "skipped_inflight": self.skipped, "faults": self.faults})
+        return d
+
+    # -- background -------------------------------------------------------
+    def _write(self, step: int, arrays: Dict[str, np.ndarray]) -> None:
+        from ..distributed import faults as _faults
+        t0 = time.perf_counter()
+        try:
+            # chaos hook: kill_after:ckpt_piece dies HERE, mid-snapshot —
+            # the two-phase commit must leave only COMPLETE steps behind
+            _faults.event("ckpt_piece")
+            _store.write_piece(
+                self.root, step, self.writer, arrays,
+                extents=self.extents, topology=self.topology,
+                expected_writers=self.expected_writers)
+            nbytes = sum(int(np.asarray(a).nbytes)
+                         for a in arrays.values())
+        except Exception as e:
+            self._fault("write", step, e)
+            return
+        finally:
+            if _telemetry_on():
+                _cm().inflight.set(0)
+        committed = False
+        try:
+            committed = _store.try_commit(self.root, step,
+                                          self.expected_writers)
+        except Exception as e:
+            self._fault("commit", step, e)
+            return
+        save_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._last.update({"step": step, "bytes": nbytes,
+                               "save_ms": round(save_ms, 3),
+                               "committed": committed, "fault": None})
+        if _telemetry_on():
+            m = _cm()
+            m.bytes.inc(nbytes)
+            m.save_ms.set(save_ms)
+            m.last_step.set(step)
+            if committed:
+                m.commits.inc()
+        if committed and self.keep:
+            try:
+                _store.prune(self.root, keep=self.keep)
+            except Exception as e:   # retention is best-effort
+                _flight.note("ckpt_prune_failed", root=self.root,
+                             error=repr(e)[:200])
+
+    def _fault(self, phase: str, step: int, e: Exception) -> None:
+        self.faults += 1
+        with self._lock:
+            self._last["fault"] = f"{phase}: {e!r}"[:200]
+        if _telemetry_on():
+            _cm().faults.inc()
+            _cm().inflight.set(0)
+        _flight.note("ckpt_fault", phase=phase, step=step,
+                     writer=self.writer, root=self.root,
+                     error=repr(e)[:200])

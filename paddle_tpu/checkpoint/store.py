@@ -1,0 +1,379 @@
+"""On-disk checkpoint store: two-phase commit over step directories.
+
+Layout under a checkpoint root::
+
+    root/
+      _tmp/step_00000012/        # phase 1: every writer lands here
+        shard-ps0.npz            # writer ps0's shard arrays
+        manifest-ps0.json        # writer ps0's manifest piece
+        MANIFEST.json            # written by the committer, atomically
+      step_00000012/             # phase 2: ONE atomic directory rename
+        ...                      # (the commit marker IS the final name)
+
+Phase 1: each writer serializes its shards + manifest piece into the
+SHARED in-flight directory ``_tmp/step_<N>/`` — every individual file
+lands via unique-tmp + ``os.replace`` + fsync, so a torn write can
+never masquerade as a complete piece.  Phase 2: once every expected
+writer's piece is present, any caller's :func:`try_commit` merges the
+pieces into ``MANIFEST.json`` (atomic) and renames the whole directory
+to its final ``step_<N>`` name — one atomic rename.  A crash at ANY
+point before the rename leaves only ``_tmp`` residue, which restore
+never reads: a half-checkpoint is unrestorable by construction, and
+:func:`latest_complete_step` always resolves to the newest COMPLETE
+step.  Concurrent committers are safe: the merge is deterministic, the
+manifest write is last-wins-identical, and the rename race resolves to
+"the final directory exists" for everyone.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import uuid
+from io import BytesIO
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .manifest import (Manifest, array_digest, file_digest, merge_pieces,
+                       shard_entry)
+
+__all__ = ["CheckpointError", "atomic_file_write", "write_piece",
+           "try_commit", "commit_single", "complete_steps",
+           "inflight_steps", "latest_complete_step", "load_manifest",
+           "step_dir", "prune", "verify_step"]
+
+STEP_RE = re.compile(r"^step_(\d{8})$")
+TMP_SUBDIR = "_tmp"
+MANIFEST_NAME = "MANIFEST.json"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written, committed or read; the message
+    always names the file/step/var at fault."""
+
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{int(step):08d}")
+
+
+def _tmp_step_dir(root: str, step: int) -> str:
+    return os.path.join(root, TMP_SUBDIR, f"step_{int(step):08d}")
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_file_write(path: str, write_fn) -> None:
+    """THE atomic-write discipline: unique-tmp + fsync + os.replace —
+    a reader can never observe a half-written file under the final
+    name, and the tmp is reaped on a failed write (an orphan here would
+    ride a commit rename into a final step directory forever).
+    ``write_fn(f)`` writes to the open binary file.  Shared with io.py's
+    save paths so the crash-safety invariant has one implementation."""
+    tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    atomic_file_write(path, lambda f: f.write(data))
+
+
+# ---------------------------------------------------------------------------
+# phase 1: writers
+# ---------------------------------------------------------------------------
+
+def write_piece(root: str, step: int, writer: str,
+                arrays: Dict[str, np.ndarray],
+                extents: Optional[Dict[str, dict]] = None,
+                topology: Optional[dict] = None,
+                expected_writers: Optional[Sequence[str]] = None) -> str:
+    """Write one writer's shard file + manifest piece into the in-flight
+    step directory.  ``extents`` maps each array's LOCAL name to
+    ``{"var": global name, "offset": int or None (replicated),
+    "global_shape": [...]}``; names absent from ``extents`` are whole
+    vars owned by this writer (offset 0, global shape = own shape).
+
+    Returns the in-flight directory path.  Never commits — call
+    :func:`try_commit` (any process sharing the filesystem may)."""
+    tmp_dir = _tmp_step_dir(root, step)
+    os.makedirs(tmp_dir, exist_ok=True)
+    extents = extents or {}
+
+    shard_file = f"shard-{writer}.npz"
+    shards: List[dict] = []
+    npz: Dict[str, np.ndarray] = {}
+    for local_name in sorted(arrays):
+        arr = np.asarray(arrays[local_name])
+        ext = extents.get(local_name)
+        var = ext["var"] if ext else local_name
+        offset = ext.get("offset") if ext else 0
+        gshape = (ext.get("global_shape") if ext else None) or arr.shape
+        key = f"{var}@@{'rep' if offset is None else int(offset)}"
+        if key in npz:
+            if offset is None:
+                # two local names replicating the same global var (e.g.
+                # per-section scalar accumulators of one param on one
+                # pserver): identical by construction, keep the first
+                continue
+            raise CheckpointError(
+                f"writer {writer!r} produced two shards with identical "
+                f"extent for var {var!r} (local names collide on "
+                f"key {key!r})")
+        if offset is not None:
+            bad = (tuple(gshape) != tuple(arr.shape) or offset != 0) \
+                if arr.ndim == 0 else (
+                    tuple(gshape[1:]) != tuple(arr.shape[1:])
+                    or offset + arr.shape[0] > int(gshape[0]))
+            if bad:
+                raise CheckpointError(
+                    f"shard of {var!r} (local {local_name!r}) shape "
+                    f"{arr.shape} at offset {offset} does not fit global "
+                    f"shape {list(gshape)}")
+        npz[key] = arr
+        shards.append(shard_entry(
+            var=var, key=key, file=shard_file, writer=writer,
+            shape=arr.shape, dtype=str(arr.dtype),
+            digest=array_digest(arr), offset=offset, global_shape=gshape))
+
+    buf = BytesIO()
+    np.savez(buf, **npz)
+    data = buf.getvalue()
+    _atomic_write(os.path.join(tmp_dir, shard_file), data)
+
+    piece = Manifest(step, topology=topology, writers=[writer],
+                     shards=shards,
+                     files={shard_file: {"digest": file_digest(data),
+                                         "nbytes": len(data),
+                                         "writer": writer}},
+                     expected_writers=(sorted(expected_writers)
+                                       if expected_writers else None))
+    _atomic_write(os.path.join(tmp_dir, f"manifest-{writer}.json"),
+                  piece.dumps().encode("utf-8"))
+    _fsync_dir(tmp_dir)
+    return tmp_dir
+
+
+# ---------------------------------------------------------------------------
+# phase 2: commit
+# ---------------------------------------------------------------------------
+
+def _read_pieces(tmp_dir: str) -> List[Manifest]:
+    pieces = []
+    for fn in sorted(os.listdir(tmp_dir)):
+        if fn.startswith("manifest-") and fn.endswith(".json"):
+            with open(os.path.join(tmp_dir, fn), encoding="utf-8") as f:
+                pieces.append(Manifest.loads(f.read()))
+    return pieces
+
+
+def try_commit(root: str, step: int,
+               expected_writers: Optional[Sequence[str]] = None) -> bool:
+    """Commit step ``step`` if every expected writer's piece is present.
+
+    ``expected_writers=None`` uses the writer set recorded inside the
+    pieces themselves (``expected_writers`` stamped by write_piece), or
+    commits whatever pieces exist when nothing recorded one.  Returns
+    True when the step is COMPLETE on return (committed now or already),
+    False when pieces are still missing.  Safe to call from every
+    writer and from pollers: idempotent, concurrent-committer safe."""
+    final = step_dir(root, step)
+    if os.path.isdir(final):
+        return True
+    tmp_dir = _tmp_step_dir(root, step)
+    if not os.path.isdir(tmp_dir):
+        return False
+    try:
+        pieces = _read_pieces(tmp_dir)
+        if not pieces:
+            return False
+        have = {w for p in pieces for w in p.writers}
+        expect = (set(expected_writers) if expected_writers is not None
+                  else None)
+        if expect is None:
+            for p in pieces:
+                if p.expected_writers:
+                    expect = set(p.expected_writers)
+                    break
+        if expect is not None and not expect <= have:
+            return False
+        merged = merge_pieces(pieces)
+        _atomic_write(os.path.join(tmp_dir, MANIFEST_NAME),
+                      merged.dumps().encode("utf-8"))
+        _fsync_dir(tmp_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        # a racing committer renamed tmp_dir away mid-read/mid-write:
+        # complete if the final directory landed, else genuinely gone
+        return os.path.isdir(final)
+    except ValueError as e:
+        # torn/foreign piece set (step disagreement, duplicate writer,
+        # cross-writer var inconsistency): normalize to the store's
+        # error type so every caller handles ONE exception class
+        raise CheckpointError(
+            f"step {step} piece set under {root!r} cannot commit: {e}")
+    try:
+        os.rename(tmp_dir, final)
+    except OSError:
+        # a racing committer won the rename (src gone / dst exists):
+        # complete either way, or genuinely failed — re-check
+        if not os.path.isdir(final):
+            raise
+    _fsync_dir(root)
+    return True
+
+
+def commit_single(root: str, step: int, writer: str,
+                  arrays: Dict[str, np.ndarray],
+                  extents: Optional[Dict[str, dict]] = None,
+                  topology: Optional[dict] = None) -> str:
+    """Single-writer convenience: write + commit in one call (the plain
+    one-host checkpoint).  Returns the committed step directory."""
+    write_piece(root, step, writer, arrays, extents=extents,
+                topology=topology, expected_writers=[writer])
+    if not try_commit(root, step, expected_writers=[writer]):
+        raise CheckpointError(
+            f"single-writer commit of step {step} under {root!r} did not "
+            "complete (piece missing after write)")
+    return step_dir(root, step)
+
+
+# ---------------------------------------------------------------------------
+# discovery / maintenance
+# ---------------------------------------------------------------------------
+
+def complete_steps(root: str) -> List[int]:
+    """COMPLETE step ids under ``root``, ascending.  Only directories
+    that went through the atomic commit rename (and so contain a merged
+    MANIFEST.json) qualify — in-flight ``_tmp`` residue never does."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for fn in os.listdir(root):
+        m = STEP_RE.match(fn)
+        if m and os.path.isfile(os.path.join(root, fn, MANIFEST_NAME)):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def inflight_steps(root: str) -> List[int]:
+    """Step ids with UNCOMMITTED residue under ``_tmp`` (crashed or
+    still-writing snapshots)."""
+    tmp = os.path.join(root, TMP_SUBDIR)
+    if not os.path.isdir(tmp):
+        return []
+    out = []
+    for fn in os.listdir(tmp):
+        m = STEP_RE.match(fn)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_complete_step(root: str) -> Optional[int]:
+    steps = complete_steps(root)
+    return steps[-1] if steps else None
+
+
+def load_manifest(root: str, step: int) -> Manifest:
+    path = os.path.join(step_dir(root, step), MANIFEST_NAME)
+    try:
+        with open(path, encoding="utf-8") as f:
+            return Manifest.loads(f.read())
+    except FileNotFoundError:
+        raise CheckpointError(
+            f"no COMPLETE checkpoint step {step} under {root!r} "
+            f"(missing {path}); complete steps: {complete_steps(root)}")
+    except (ValueError, KeyError) as e:
+        raise CheckpointError(
+            f"corrupt checkpoint manifest {path!r}: {e}")
+
+
+def prune(root: str, keep: int, reap_inflight: bool = False) -> dict:
+    """Delete the oldest COMPLETE steps beyond the newest ``keep``
+    (never the newest), optionally reaping in-flight ``_tmp`` residue.
+    Returns {"removed_steps": [...], "reaped_inflight": [...]}."""
+    import shutil
+    if keep < 1:
+        raise ValueError("prune keep must be >= 1")
+    steps = complete_steps(root)
+    doomed = steps[:-keep] if len(steps) > keep else []
+    for s in doomed:
+        shutil.rmtree(step_dir(root, s), ignore_errors=True)
+    reaped = []
+    if reap_inflight:
+        for s in inflight_steps(root):
+            shutil.rmtree(_tmp_step_dir(root, s), ignore_errors=True)
+            reaped.append(s)
+    return {"removed_steps": doomed, "reaped_inflight": reaped}
+
+
+def verify_step(root: str, step: int, deep: bool = True) -> dict:
+    """Digest-verify one COMPLETE step: every shard file's bytes against
+    the manifest's file digest, and (``deep``) every shard array against
+    its array digest.  Returns a summary dict; raises CheckpointError
+    naming the first corrupt file/var."""
+    man = load_manifest(root, step)
+    sdir = step_dir(root, step)
+    checked_files = 0
+    for fn, info in sorted(man.files.items()):
+        path = os.path.join(sdir, fn)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            raise CheckpointError(
+                f"checkpoint step {step}: shard file {path!r} named by "
+                "the manifest is missing")
+        got = file_digest(data)
+        if info.get("digest") and got != info["digest"]:
+            raise CheckpointError(
+                f"checkpoint step {step}: shard file {path!r} digest "
+                f"mismatch (manifest {info['digest']}, file {got})")
+        checked_files += 1
+    checked_arrays = 0
+    if deep:
+        by_file: Dict[str, List[dict]] = {}
+        for s in man.shards:
+            by_file.setdefault(s["file"], []).append(s)
+        for fn, shards in sorted(by_file.items()):
+            with np.load(os.path.join(sdir, fn)) as data:
+                for s in shards:
+                    if s["key"] not in data.files:
+                        raise CheckpointError(
+                            f"checkpoint step {step}: shard key "
+                            f"{s['key']!r} of var {s['var']!r} missing "
+                            f"from {fn!r}")
+                    if array_digest(data[s["key"]]) != s["digest"]:
+                        raise CheckpointError(
+                            f"checkpoint step {step}: var {s['var']!r} "
+                            f"shard {s['key']!r} in {fn!r} fails its "
+                            "content digest")
+                    checked_arrays += 1
+    return {"step": step, "writers": man.writers,
+            "files": checked_files, "arrays": checked_arrays,
+            "vars": len(man.vars()), "nbytes": man.nbytes(), "ok": True}
+
+
+def piece_writers(root: str, step: int) -> List[str]:
+    """Writers whose pieces have landed for an IN-FLIGHT step (admin /
+    commit-poll introspection)."""
+    tmp_dir = _tmp_step_dir(root, step)
+    if not os.path.isdir(tmp_dir):
+        return []
+    return sorted(w for p in _read_pieces(tmp_dir) for w in p.writers)

@@ -33,5 +33,23 @@ def notify_complete(endpoints, trainer_id: int = 0) -> None:
     client.parallel([(client.complete, ep) for ep in endpoints])
 
 
+def notify_checkpoint(endpoints, dirname, step=None,
+                      trainer_id: int = 0,
+                      connect_timeout: float = 10.0):
+    """Ask every pserver to checkpoint into ``dirname`` — the fleet-cut
+    trigger of the elastic-resize story.  ``step`` stamps an explicit
+    cut step id (sharded checkpoints commit two-phase once every
+    pserver's piece for that step lands; poll
+    ``checkpoint.wait_step_complete`` on a shared filesystem to learn
+    the commit happened).  Best-effort-ALL fan-out: one unreachable
+    pserver is counted + summarized, the rest are still notified, and
+    only an all-endpoints failure raises.  Returns
+    ``[(endpoint, error-or-None), ...]``."""
+    client = transport.get_client(trainer_id)
+    return ps_ops.broadcast_checkpoint_notify(
+        client, endpoints, dirname, step=step,
+        connect_timeout=connect_timeout)
+
+
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
-           "notify_complete", "wait_server_ready"]
+           "notify_checkpoint", "notify_complete", "wait_server_ready"]

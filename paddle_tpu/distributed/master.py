@@ -30,6 +30,7 @@ from ..observability import health as _health
 from ..observability import stats as _obs_stats
 from ..observability.trace import flags_on as _telemetry_on
 
+CKPT_CUT = 15
 GET_TASK = 16
 TASK_FINISHED = 17
 TASK_FAILED = 18
@@ -37,7 +38,8 @@ SET_DATASET = 19
 MASTER_STATE = 20
 
 # name these in the transport's RPC counters (rpc.*.requests.get_task)
-transport.MSG_NAMES.update({GET_TASK: "get_task",
+transport.MSG_NAMES.update({CKPT_CUT: "ckpt_cut",
+                            GET_TASK: "get_task",
                             TASK_FINISHED: "task_finished",
                             TASK_FAILED: "task_failed",
                             SET_DATASET: "set_dataset",
@@ -87,6 +89,10 @@ class TaskMaster:
         self.next_id = 0
         self.pass_id = 0
         self._pass_rolled = True  # no pass in flight yet
+        # fleet checkpoint cut: the last stamped (step, root) — rides
+        # every snapshot/publish so standby mirrors, a restarted master
+        # and late joiners all agree which step the fleet cut at
+        self.ckpt_cut: Optional[dict] = None
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
 
@@ -106,6 +112,7 @@ class TaskMaster:
             "next_id": self.next_id,
             "pass_id": self.pass_id,
             "pass_rolled": self._pass_rolled,
+            "ckpt_cut": self.ckpt_cut,
             "seq": self._transitions,
         }
 
@@ -168,6 +175,7 @@ class TaskMaster:
         self.next_id = state["next_id"]
         self.pass_id = state.get("pass_id", 0)
         self._pass_rolled = state.get("pass_rolled", not (self.todo or self.pending))
+        self.ckpt_cut = state.get("ckpt_cut")
 
     # -- HA standby mirror / takeover --------------------------------------
     def adopt_state(self, state: dict, takeover: bool = False) -> bool:
@@ -202,6 +210,7 @@ class TaskMaster:
             self.pass_id = int(state.get("pass_id", 0))
             self._pass_rolled = bool(state.get(
                 "pass_rolled", not (self.todo or self.pending)))
+            self.ckpt_cut = state.get("ckpt_cut")
             self._transitions = seq
             return True
 
@@ -318,18 +327,48 @@ class TaskMaster:
         finally:
             self._flush_publish()
 
+    def stamp_checkpoint(self, step: int, root: Optional[str] = None,
+                         meta: Optional[dict] = None) -> dict:
+        """Stamp the fleet's checkpoint cut: 'the consistent snapshot
+        of this job is step ``step`` under ``root``'.  Rides the normal
+        snapshot/publish path, so the stamp survives master failover
+        (standby mirrors carry it) and restart (snapshot file), and a
+        joining worker can ask any master replica which step to hydrate
+        from instead of guessing from the filesystem."""
+        try:
+            with self.lock:
+                self.ckpt_cut = {"step": int(step), "root": root,
+                                 **(meta or {})}
+                self._snapshot(force=True)
+                cut = dict(self.ckpt_cut)
+        finally:
+            self._flush_publish()
+        if _telemetry_on():
+            _obs_stats.counter(
+                "master.ckpt_cuts",
+                "fleet checkpoint cuts stamped through the master's "
+                "snapshot/publish path").inc()
+        return cut
+
+    def checkpoint_cut(self) -> Optional[dict]:
+        with self.lock:
+            return dict(self.ckpt_cut) if self.ckpt_cut else None
+
     def state(self) -> dict:
         with self.lock:
             self._requeue_expired()
             return {"todo": len(self.todo), "pending": len(self.pending),
                     "done": sorted(self.done),
                     "discarded": sorted(self.discarded),
-                    "pass_id": self.pass_id}
+                    "pass_id": self.pass_id,
+                    "ckpt_cut": (dict(self.ckpt_cut)
+                                 if self.ckpt_cut else None)}
 
     # -- transport glue ----------------------------------------------------
     def handle(self, msg_type, trainer_id, name, payload):
         if not self.leader and msg_type in (GET_TASK, TASK_FINISHED,
-                                            TASK_FAILED, SET_DATASET):
+                                            TASK_FAILED, SET_DATASET,
+                                            CKPT_CUT):
             # a STANDBY mirrors but must not act: granting from the
             # mirror while the leader lives would double-grant.  Only
             # the registry's promotion (serve_master_ha) flips this.
@@ -348,6 +387,12 @@ class TaskMaster:
             return OK, b""
         if msg_type == MASTER_STATE:
             return OK, json.dumps(self.state()).encode("utf-8")
+        if msg_type == CKPT_CUT:
+            info = json.loads(bytes(payload).decode("utf-8")) \
+                if payload else {}
+            cut = self.stamp_checkpoint(int(name), info.pop("root", None),
+                                        meta=info or None)
+            return OK, json.dumps(cut).encode("utf-8")
         raise ValueError(f"unknown master message type {msg_type}")
 
 
@@ -635,6 +680,21 @@ class MasterClient:
 
     def task_failed(self, task_id: int) -> None:
         self._request(TASK_FAILED, str(task_id))
+
+    def stamp_checkpoint(self, step: int, root: Optional[str] = None,
+                         meta: Optional[dict] = None) -> dict:
+        """Stamp the fleet checkpoint cut at the (leader) master; the
+        stamp is published/mirrored like every lease-table transition."""
+        payload = dict(meta or {})
+        if root is not None:
+            payload["root"] = root
+        out = self._request(CKPT_CUT, str(int(step)),
+                            json.dumps(payload).encode("utf-8"))
+        return json.loads(bytes(out).decode("utf-8"))
+
+    def checkpoint_cut(self) -> Optional[dict]:
+        """The fleet's last stamped cut ({"step", "root", ...}) or None."""
+        return self.state().get("ckpt_cut")
 
     def state(self) -> dict:
         out = self._request(MASTER_STATE)

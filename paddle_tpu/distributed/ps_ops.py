@@ -164,12 +164,81 @@ def _fetch_barrier(exe, program, op, scope):
                      for ep in op.attr("endpoints")])
 
 
+def ckpt_notify_name(dirname: str, step=None) -> str:
+    """Wire encoding of a checkpoint notify: the dirname, optionally
+    carrying an explicit fleet-cut step id (``<dir>@@step=<N>``).  A
+    bare dirname keeps the legacy wire byte-identical."""
+    return dirname if step is None else f"{dirname}@@step={int(step)}"
+
+
+def parse_ckpt_notify(name: str):
+    """Inverse of :func:`ckpt_notify_name`: (dirname, step-or-None)."""
+    if "@@step=" in name:
+        dirname, _, step = name.rpartition("@@step=")
+        try:
+            return dirname, int(step)
+        except ValueError:
+            pass
+    return name, None
+
+
+def broadcast_checkpoint_notify(client, endpoints, dirname, step=None,
+                                connect_timeout: float = 10.0
+                                ) -> List[tuple]:
+    """Best-effort-ALL checkpoint-notify fan-out: every endpoint is
+    notified even when an earlier one fails; failures are counted
+    (``rpc.ckpt_notify_failures``), summarized per endpoint in a flight
+    note + warning, and only an ALL-endpoints failure raises (nothing
+    checkpointed at all).  Returns ``[(endpoint, error-or-None), ...]``.
+
+    Rationale: a checkpoint is an optimization of future recovery — one
+    unreachable pserver must not abort the other shards' snapshots (the
+    step simply won't commit until that writer returns), and it must
+    never kill the training step that triggered the notify.  The same
+    logic bounds the connect: a dead endpoint costs ``connect_timeout``
+    per attempt, not the transport's full crash-recovery grace — while
+    still riding the failover-aware client path, so an HA promotion
+    retargets the notify at the promoted replica instead of counting a
+    spurious failure."""
+    name = ckpt_notify_name(dirname, step)
+
+    def _notify(ep):
+        try:
+            client.checkpoint_notify(ep, name,
+                                     connect_timeout=connect_timeout)
+            return (ep, None)
+        except Exception as e:  # noqa: BLE001 - summarized below
+            return (ep, e)
+
+    results = client.parallel([(_notify, ep) for ep in endpoints])
+    failures = [(ep, e) for ep, e in results if e is not None]
+    if failures:
+        if _telemetry_on():
+            _obs_stats.counter(
+                "rpc.ckpt_notify_failures",
+                "checkpoint_notify fan-out endpoints that failed "
+                "(best-effort-all: the rest were still notified)"
+            ).inc(len(failures))
+        summary = {ep: repr(e)[:120] for ep, e in failures}
+        _flight.note("ckpt_notify_failures", dirname=dirname, step=step,
+                     failed=len(failures), total=len(endpoints),
+                     errors=summary)
+        import warnings
+        warnings.warn(
+            f"checkpoint_notify: {len(failures)}/{len(endpoints)} "
+            f"endpoints failed (best-effort, rest notified): {summary}")
+        if len(failures) == len(endpoints):
+            raise RuntimeError(
+                f"checkpoint_notify failed on EVERY endpoint: {summary}")
+    return results
+
+
 @register_host_op("checkpoint_notify")
 def _checkpoint_notify(exe, program, op, scope):
     client = transport.get_client(op.attr("trainer_id", 0))
-    dirname = op.attr("dirname")
-    client.parallel([(client.checkpoint_notify, ep, dirname)
-                     for ep in op.attr("endpoints")])
+    broadcast_checkpoint_notify(client, op.attr("endpoints"),
+                                op.attr("dirname"),
+                                step=op.attr("step", None))
 
 
 @register_host_op("prefetch")
@@ -353,11 +422,39 @@ class PServerLoop:
 
         self.ckpt_dir = op.attr("checkpoint_dir") or None
         self.ckpt_every = int(op.attr("checkpoint_every_rounds", 0) or 0)
-        if self.ckpt_dir and os.path.exists(self._ckpt_path()):
-            with np.load(self._ckpt_path()) as data:
-                for n in data.files:
-                    self.scope.set_var(n, data[n])
+        # sharded-checkpoint plane (paddle_tpu/checkpoint/): extent
+        # table mapping each local persist var onto its global row
+        # range, the expected writer count for two-phase commit, and
+        # one AsyncSnapshotter per target dirname
+        self.ckpt_sharded = bool(op.attr("ckpt_sharded", False))
+        self.shard_extents = dict(op.attr("shard_extents", {}) or {})
+        self.ckpt_writers = int(op.attr("ckpt_writers", 1) or 1)
+        self._snapshotters: Dict[str, object] = {}
+        self.recovered_step = None
+        if self.ckpt_dir:
+            if self.ckpt_sharded:
+                self._recover_sharded()
+            elif os.path.exists(self._ckpt_path()):
+                with np.load(self._ckpt_path()) as data:
+                    for n in data.files:
+                        self.scope.set_var(n, data[n])
         self._warm_start()
+
+    def _recover_sharded(self) -> None:
+        """Hydrate this pserver's sections from the newest COMPLETE
+        sharded checkpoint step — written by ANY topology (a restarted
+        peer of the same fleet, or a differently-sized previous fleet:
+        the N→M resize path).  No COMPLETE step means a fresh start."""
+        from .. import checkpoint as _ckpt
+        step = _ckpt.latest_complete_step(self.ckpt_dir)
+        if step is None:
+            return
+        vals = _ckpt.load_locals(self.ckpt_dir, step, self.shard_extents)
+        for n, v in vals.items():
+            self.scope.set_var(n, v)
+        self.recovered_step = step
+        _flight.note("pserver_sharded_recover", step=step,
+                     nvars=len(vals), ps_index=self.op.attr("ps_index", 0))
 
     def _warm_start(self) -> None:
         """Elastic-restart hydration (FLAGS_compile_cache_dir): load
@@ -416,8 +513,11 @@ class PServerLoop:
         idx = self.op.attr("ps_index", 0)
         return os.path.join(self.ckpt_dir, f"pserver_{idx}.npz")
 
-    def _checkpoint(self, dirname: str = None) -> None:
+    def _checkpoint(self, dirname: str = None, step: int = None) -> None:
         dirname = dirname or self.ckpt_dir
+        if self.ckpt_sharded and dirname:
+            self._sharded_checkpoint(dirname, step)
+            return
         os.makedirs(dirname, exist_ok=True)
         path = os.path.join(dirname,
                             f"pserver_{self.op.attr('ps_index', 0)}.npz")
@@ -431,6 +531,105 @@ class PServerLoop:
         tmp = path + ".tmp.npz"
         np.savez(tmp, **arrs)
         os.replace(tmp, path)  # atomic like the Go rename
+
+    # -- sharded async checkpoints (paddle_tpu/checkpoint/) ----------------
+    def _collect_persist(self, step=None) -> Dict[str, np.ndarray]:
+        """Phase-1 collect for the async snapshotter: host snapshots of
+        every persist var, coherent with concurrent applies.  Vars are
+        grouped by the lock that guards them (_read_var's invariant);
+        within one lock hold every device→host copy is kicked async
+        first (``copy_to_host_async``) and only then materialized, so
+        the waits overlap instead of serializing — the step loop pays
+        one lock-scoped overlapped readback, nothing else."""
+        out: Dict[str, np.ndarray] = {}
+        groups: Dict[tuple, List[str]] = defaultdict(list)
+        for n in self.persist_names:
+            bidx = self.var_to_block.get(n)
+            if bidx is not None:
+                groups[("block", bidx)].append(n)
+            elif n in self.lr_fetch:
+                groups[("lr",)].append(n)
+            else:
+                groups[("free",)].append(n)
+
+        def grab(names):
+            vals = {n: self.scope.find_var(n) for n in names}
+            for v in vals.values():
+                if v is not None:
+                    _start_readback(v)
+            for n, v in vals.items():
+                if v is not None:
+                    out[n] = np.asarray(_to_host(v))
+
+        for key, names in groups.items():
+            if key[0] == "block":
+                with self.block_locks[key[1]]:
+                    grab(names)
+            elif key[0] == "lr":
+                with self.lr_lock:
+                    grab(names)
+            else:
+                grab(names)
+        return out
+
+    def _sharded_checkpoint(self, dirname: str, step: int = None) -> None:
+        """Async sharded snapshot: enqueue and return — serialization,
+        fsync and the two-phase commit run on the snapshotter's
+        background thread.  ``step`` defaults to the applied round
+        count, which sync-mode barriers make identical across the fleet
+        at the moment each pserver passes the same round: periodic
+        every-N-round snapshots and an explicit checkpoint_notify
+        between rounds are both consistent cuts.  (Async/hogwild mode
+        has no fleet-wide round; give notify an explicit step there —
+        per-writer pieces only commit when step ids agree.)"""
+        from .. import checkpoint as _ckpt
+        explicit = step is not None
+        if step is None:
+            # monotonic across restarts/resizes: a recovered pserver's
+            # round counter restarts at 0, but its checkpoint step ids
+            # continue from the step it hydrated
+            rounds = (self.applied_rounds if self.sync_mode else
+                      self._async_sends // max(1, len(self.grad_to_block)))
+            step = (self.recovered_step or 0) + rounds
+        snap = self._snapshotters.get(dirname)
+        if snap is None:
+            idx = int(self.op.attr("ps_index", 0))
+            snap = _ckpt.AsyncSnapshotter(
+                dirname, f"ps{idx}", self._collect_persist,
+                extents=self.shard_extents,
+                topology={"kind": "pserver",
+                          "num_pservers": self.ckpt_writers,
+                          "sync_mode": bool(self.sync_mode)},
+                expected_writers=[f"ps{i}"
+                                  for i in range(self.ckpt_writers)])
+            self._snapshotters[dirname] = snap
+        if explicit:
+            # an EXPLICIT fleet cut (checkpoint_notify with a step id)
+            # must not be skip-dropped behind an in-flight periodic
+            # write — without this writer's piece the step can never
+            # commit and the cut caller burns its whole commit-poll
+            # timeout.  Drain the in-flight write first (bounded; this
+            # blocks only the notify RPC handler thread, never the
+            # apply loop), then take the cut; a still-failed accept is
+            # loud.
+            snap.flush(timeout=60.0)
+            if not snap.snapshot(step):
+                _flight.note("ckpt_cut_dropped", dirname=dirname,
+                             step=step,
+                             ps_index=self.op.attr("ps_index", 0))
+        else:
+            snap.snapshot(step)
+
+    def close_snapshotters(self) -> None:
+        """Drain in-flight async checkpoint writes (clean shutdown: the
+        writer threads are daemons and would die mid-write at interpreter
+        exit, leaving an uncommittable piece — harmless for correctness,
+        wasteful for recovery freshness)."""
+        for snap in self._snapshotters.values():
+            try:
+                snap.close(timeout=30.0)
+            except Exception:  # pragma: no cover - shutdown best-effort
+                pass
 
     # -- optimize-block execution -----------------------------------------
     def _run_lr(self):
@@ -855,7 +1054,8 @@ class PServerLoop:
             return OK, b""
 
         if msg_type == CHECKPOINT_NOTIFY:
-            self._checkpoint(dirname=name)
+            dirname, step = parse_ckpt_notify(name)
+            self._checkpoint(dirname=dirname, step=step)
             return OK, b""
 
         if msg_type == COMPLETE:
@@ -1000,6 +1200,7 @@ def _listen_and_serv(exe, program, op, scope):
             # the lease ages out and, when armed, the flight recorder
             # writes this pserver's post-mortem
             hb.stop(bye=clean)
+        loop.close_snapshotters()
         server.stop()
 
 

@@ -48,13 +48,24 @@ class DistributeTranspilerConfig:
     (idempotent retries), and the registry promotes the backup on the
     primary's lease expiry.  ``lease_ttl`` (seconds; 0 = registry
     default) bounds how long a death stays unnoticed — promotion and
-    health transitions are measured in these lease terms."""
+    health transitions are measured in these lease terms.
+
+    ``checkpoint_sharded`` switches the pserver checkpoint path to the
+    topology-independent sharded store (``paddle_tpu/checkpoint/``):
+    every pserver writes only the row shards it owns plus a manifest
+    extent table, saves are ASYNC (the apply loop never blocks on
+    serialization), steps commit two-phase (a crash mid-save can never
+    leave a loadable half-checkpoint), and a restarted OR RESIZED fleet
+    re-shards the newest COMPLETE step onto its own layout — N→M
+    pserver counts both directions.  Off (default) keeps the legacy
+    per-endpoint ``pserver_<i>.npz`` format byte-identical."""
 
     slice_var_up: bool = True
     min_block_size: int = 8192
     split_method: str = "RoundRobin"  # or "HashName"
     checkpoint_dir: Optional[str] = None
     checkpoint_every_rounds: int = 0
+    checkpoint_sharded: bool = False
     backup_endpoints: str = ""
     lease_ttl: float = 0.0
 
@@ -401,6 +412,17 @@ class DistributeTranspiler:
 
         # LR vars live in block 0 of the pserver program
         persist_names: List[str] = []
+        # sharded-checkpoint extent table: local persist var -> its
+        # row range of the GLOBAL (topology-independent) var, so the
+        # checkpoint store can re-shard state onto any other layout.
+        # offset None = replicated (identical on every pserver by
+        # construction: LR state, per-section scalar accumulators)
+        shard_extents: Dict[str, dict] = {}
+
+        def _replicated_extent(name: str, shape) -> None:
+            shard_extents[name] = {
+                "var": name, "offset": None, "rows": None,
+                "global_shape": [int(s) for s in (shape or ())]}
         lr_block_idx = -1
         lr_fetch: List[str] = []
         if self.lr_ops:
@@ -413,6 +435,7 @@ class DistributeTranspiler:
                     gb.vars[n] = Variable.from_dict(gb, v.to_dict())
                     if v.persistable:
                         persist_names.append(n)
+                        _replicated_extent(n, v.shape)
             with prog.block_guard() as lb:
                 for op in self.lr_ops:
                     lb.ops.append(Operator(lb, op.type, op.inputs,
@@ -424,6 +447,7 @@ class DistributeTranspiler:
             if v.persistable and n not in gb.vars:
                 gb.vars[n] = Variable.from_dict(gb, v.to_dict())
                 persist_names.append(n)
+                _replicated_extent(n, v.shape)
 
         grad_to_block: Dict[str, int] = {}
         for sec in secs:
@@ -433,6 +457,10 @@ class DistributeTranspiler:
                           shape=(sec.rows,) + tuple(pvar.shape[1:]),
                           dtype=pvar.dtype, persistable=True)
             persist_names.append(sec.pname)
+            shard_extents[sec.pname] = {
+                "var": sec.param, "offset": int(sec.offset),
+                "rows": int(sec.rows),
+                "global_shape": [int(s) for s in pvar.shape]}
             gvar = src.var_or_none(sec.grad)
             gshape = (sec.rows,) + tuple(pvar.shape[1:])
             gb.create_var(name=sec.gname, shape=gshape,
@@ -459,6 +487,21 @@ class DistributeTranspiler:
                                 shape=self._section_shape(v, sec, pvar.shape),
                                 dtype=v.dtype, persistable=True)
                             persist_names.append(nn)
+                            if v.shape is not None and \
+                                    tuple(v.shape) == tuple(pvar.shape):
+                                # param-shaped accumulator: rides the
+                                # section's row range of the global acc
+                                shard_extents[nn] = {
+                                    "var": n, "offset": int(sec.offset),
+                                    "rows": int(sec.rows),
+                                    "global_shape": [int(s)
+                                                     for s in v.shape]}
+                            else:
+                                # scalar/odd-shaped accumulator (e.g.
+                                # beta1_pow): every section's copy
+                                # evolves identically — replicated
+                                _replicated_extent(nn, v.shape)
+                                shard_extents[nn]["var"] = n
                 return out
 
             with prog.block_guard() as ob:
@@ -483,6 +526,10 @@ class DistributeTranspiler:
                 "dense_merge": "mean",
                 "checkpoint_dir": self.config.checkpoint_dir,
                 "checkpoint_every_rounds": self.config.checkpoint_every_rounds,
+                "ckpt_sharded": bool(self.config.checkpoint_sharded),
+                "shard_extents": (shard_extents
+                                  if self.config.checkpoint_sharded else {}),
+                "ckpt_writers": len(self.endpoints),
                 "persist_names": sorted(set(persist_names)),
                 "dist_tables": {
                     s.param: {"var": s.pname, "offset": s.offset,

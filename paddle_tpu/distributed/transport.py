@@ -1095,15 +1095,20 @@ class RPCClient:
         return rpayload
 
     def _request(self, endpoint: str, msg_type: int, name: str = "",
-                 payload=b"", n_vars: int = 0, idempotent: bool = False):
+                 payload=b"", n_vars: int = 0, idempotent: bool = False,
+                 connect_timeout=None):
         """``idempotent=True`` marks a normally-non-retryable message as
         safe to re-send (the HA barrier carries a round sequence number
         the server dedups on), so a failover or transient drop retries
-        it instead of surfacing the error."""
+        it instead of surfacing the error.  ``connect_timeout`` bounds
+        each connect attempt (best-effort callers like checkpoint
+        notify must not ride out the full crash-recovery grace on a
+        dead endpoint)."""
         phys = self._resolve(endpoint)
         try:
             return self._raw_request(phys, msg_type, name, payload,
-                                     n_vars=n_vars, retry_all=idempotent)
+                                     n_vars=n_vars, retry_all=idempotent,
+                                     connect_timeout=connect_timeout)
         except ConnectionError:
             if self._registry is None or endpoint == self._registry:
                 raise
@@ -1130,7 +1135,8 @@ class RPCClient:
                          old=phys, new=new_phys)
             if idempotent:
                 return self._raw_request(new_phys, msg_type, name, payload,
-                                         n_vars=n_vars, retry_all=True)
+                                         n_vars=n_vars, retry_all=True,
+                                         connect_timeout=connect_timeout)
             if new_phys == phys and msg_type not in self._RETRYABLE:
                 # same address answering the probe: could be the SAME live
                 # server after a transient drop — re-sending a SEND_VAR or
@@ -1148,7 +1154,8 @@ class RPCClient:
             # one-extra-async-grad tolerance.  Read-only messages still
             # retry via _raw_request's own _RETRYABLE gate.
             return self._raw_request(new_phys, msg_type, name, payload,
-                                     n_vars=n_vars)
+                                     n_vars=n_vars,
+                                     connect_timeout=connect_timeout)
 
     # -- public API (grpc_client.h:180-206 signatures) ---------------------
     def send_var(self, endpoint: str, name: str, value) -> None:
@@ -1274,16 +1281,28 @@ class RPCClient:
     def fetch_barrier(self, endpoint: str) -> None:
         self._request(endpoint, FETCH_BARRIER)
 
-    def checkpoint_notify(self, endpoint: str, dirname: str) -> None:
-        self._request(endpoint, CHECKPOINT_NOTIFY, dirname)
+    def checkpoint_notify(self, endpoint: str, dirname: str,
+                          connect_timeout=None) -> None:
+        """Ask one pserver to checkpoint (``dirname`` may carry an
+        explicit fleet-cut step, see ps_ops.ckpt_notify_name).  Rides
+        the failover-aware ``_request`` path — CHECKPOINT_NOTIFY is
+        retryable, so an HA promotion retargets instead of failing —
+        with an optionally bounded per-attempt connect."""
+        self._request(endpoint, CHECKPOINT_NOTIFY, dirname,
+                      connect_timeout=connect_timeout)
 
     def complete(self, endpoint: str) -> None:
         """Best-effort: the last trainer's COMPLETE makes the pserver shut
         down, which can race the response/connection teardown — a dropped
-        connection here means the server exited, i.e. success.  Never
+        connection here means the server exited, i.e. success.  That
+        includes failing to CONNECT at all: a pserver that already died
+        (e.g. chaos-killed mid-snapshot) needs no COMPLETE.  Never
         retried (a duplicate COMPLETE would double-count the trainer)."""
         endpoint = self._resolve(endpoint)
-        c = self._conn(endpoint)
+        try:
+            c = self._conn(endpoint)
+        except ConnectionError:
+            return              # already down: nothing to shut down
         try:
             with c.lock:
                 c.io.send_frame(_pack_body(COMPLETE, self.trainer_id, "",

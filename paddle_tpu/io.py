@@ -23,6 +23,34 @@ MODEL_FILENAME = "__model__"
 PARAMS_FILENAME = "__params__.npz"
 
 
+def _atomic_np_write(path: str, save_fn) -> None:
+    """Write a numpy file atomically: a crash mid-save can no longer
+    leave a silently half-written checkpoint under the final name —
+    the previous complete file, if any, stays intact.  One shared
+    implementation with the sharded-checkpoint store (unique-tmp +
+    fsync + ``os.replace`` + tmp reap)."""
+    from .checkpoint.store import atomic_file_write
+    atomic_file_write(path, save_fn)
+
+
+def _load_npz(path: str):
+    """np.load with errors that NAME the file: a missing or corrupt
+    checkpoint must say which file, not surface a bare KeyError/
+    zipfile traceback from deep inside numpy."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"checkpoint file {path!r} does not exist — nothing was "
+            "saved there, or the directory is wrong")
+    try:
+        return np.load(path, allow_pickle=False)
+    except Exception as e:
+        raise RuntimeError(
+            f"checkpoint file {path!r} is corrupt or not a checkpoint "
+            f"({type(e).__name__}: {e}); a crash mid-save cannot "
+            "produce this (saves are atomic) — look for disk faults or "
+            "a foreign file") from e
+
+
 def _persistable_vars(program: Program) -> List[Variable]:
     return [v for v in program.global_block.vars.values()
             if v.persistable and v.name != "@RNG_STATE@"]
@@ -41,14 +69,18 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             val = scope.find_var(v.name)
             if val is None:
                 continue
-            np.save(os.path.join(dirname, v.name.replace("/", "__")), np.asarray(val))
+            arr = np.asarray(val)
+            path = os.path.join(dirname,
+                                v.name.replace("/", "__") + ".npy")
+            _atomic_np_write(path, lambda f, a=arr: np.save(f, a))
     else:
         arrays = {}
         for v in vars:
             val = scope.find_var(v.name)
             if val is not None:
                 arrays[v.name] = np.asarray(val)
-        np.savez(os.path.join(dirname, filename), **arrays)
+        path = os.path.join(dirname, filename)
+        _atomic_np_write(path, lambda f: np.savez(f, **arrays))
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None):
@@ -75,9 +107,16 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         for v in vars:
             path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
             if os.path.exists(path):
-                scope.set_var(v.name, np.load(path))
+                try:
+                    scope.set_var(v.name, np.load(path,
+                                                  allow_pickle=False))
+                except Exception as e:
+                    raise RuntimeError(
+                        f"checkpoint file {path!r} for variable "
+                        f"{v.name!r} is corrupt "
+                        f"({type(e).__name__}: {e})") from e
     else:
-        data = np.load(os.path.join(dirname, filename))
+        data = _load_npz(os.path.join(dirname, filename))
         for v in vars:
             if v.name in data:
                 scope.set_var(v.name, data[v.name])

@@ -346,6 +346,131 @@ class ParallelExecutor(Executor):
                     self.mesh, P(self._dp_axis, *([None] * (val.ndim - 1))))
         return self._replicated()
 
+    # -- sharded checkpoints (paddle_tpu/checkpoint/) ----------------------
+    def _persist_names(self, program: Program, scope: Scope):
+        from ..core.executor import RNG_STATE_VAR
+        return [v.name for v in program.global_block.vars.values()
+                if v.persistable and v.name != RNG_STATE_VAR
+                and scope.find_var(v.name) is not None]
+
+    def _local_extent(self, val):
+        """(start, stop) of THIS process's contiguous dim-0 row range of
+        a sharded global array, or None when the value is replicated /
+        fully addressable here (write it whole).  Non-contiguous local
+        shard sets (exotic meshes) also return None — correctness first:
+        whoever holds the whole array writes the whole array."""
+        if not isinstance(val, jax.Array) or val.ndim == 0:
+            return None
+        if val.is_fully_addressable or val.is_fully_replicated:
+            return None
+        idx = sorted((s.index[0].start or 0,
+                      s.index[0].stop if s.index[0].stop is not None
+                      else val.shape[0])
+                     for s in val.addressable_shards)
+        lo, hi = idx[0][0], idx[0][1]
+        for s_lo, s_hi in idx[1:]:
+            if s_lo > hi:
+                return None            # non-contiguous: punt to gather
+            hi = max(hi, s_hi)
+        if (lo, hi) == (0, val.shape[0]):
+            return None                # locally complete after all
+        return lo, hi
+
+    def save_sharded_state(self, root: str, step: int,
+                           program: Optional[Program] = None,
+                           scope: Optional[Scope] = None,
+                           commit: bool = True) -> bool:
+        """Write this process's shards of the persistable state (params,
+        optimizer moments — incl. ZeRO/kReduce dim-0-sharded state) into
+        the two-phase checkpoint store.  Single-process meshes hold the
+        whole state and write one full piece; multi-host meshes write
+        one piece per process covering its addressable row ranges, and
+        the step commits when every process's piece lands.  The written
+        manifest is topology-independent: restore onto ANY layout —
+        including a plain single-host Executor (ZeRO off) — re-shards
+        from the same files."""
+        from .. import checkpoint as _ckpt
+        program = program or self._program
+        scope = scope or self._scope
+        names = self._persist_names(program, scope)
+        pidx, pcount = jax.process_index(), jax.process_count()
+        arrays, extents = {}, {}
+        for n in names:
+            val = scope.find_var(n)
+            ext = self._local_extent(val)
+            if ext is None:
+                # whole-array write.  A distributed-but-noncontiguous
+                # value gathers COLLECTIVELY (every process must
+                # participate) before the host0 gate; everything else
+                # (numpy, fully-addressable, replicated) is identical
+                # on every host by the named-PRNG/state invariant, so
+                # host0 alone writes it — two hosts writing the same
+                # dense extent would be an overlap disagreement restore
+                # refuses
+                gathered = None
+                if isinstance(val, jax.Array) \
+                        and not val.is_fully_addressable:
+                    gathered = self._fetch_to_numpy(val)
+                if pidx != 0 and pcount > 1:
+                    continue
+                arrays[n] = (gathered if gathered is not None
+                             else self._fetch_to_numpy(val))
+            else:
+                lo, hi = ext
+                # dedup by dim-0 range: a var replicated over a second
+                # mesh axis holds the SAME rows on several local
+                # devices — concatenating the copies would write a
+                # shard whose recorded span contains duplicated data
+                by_range = {}
+                for s in val.addressable_shards:
+                    start = s.index[0].start or 0
+                    by_range.setdefault(start, s)
+                parts = [np.asarray(by_range[k].data)
+                         for k in sorted(by_range)]
+                arrays[n] = (parts[0] if len(parts) == 1
+                             else np.concatenate(parts, axis=0))
+                extents[n] = {"var": n, "offset": int(lo),
+                              "rows": int(hi - lo),
+                              "global_shape": [int(s) for s in val.shape]}
+        topology = {
+            "kind": "mesh",
+            "mesh": {ax: int(self.mesh.shape[ax])
+                     for ax in self.mesh.axis_names},
+            "zero": self._build_strategy.reduce_strategy
+            == ReduceStrategy.kReduce,
+            "processes": pcount,
+        }
+        writers = [f"host{i}" for i in range(pcount)]
+        _ckpt.write_piece(root, step, f"host{pidx}", arrays,
+                          extents=extents, topology=topology,
+                          expected_writers=writers)
+        if commit:
+            return _ckpt.try_commit(root, step, writers)
+        return False
+
+    def load_sharded_state(self, root: str,
+                           step: Optional[int] = None,
+                           program: Optional[Program] = None,
+                           scope: Optional[Scope] = None,
+                           verify: bool = True) -> int:
+        """Restore persistable state from the newest (or given) COMPLETE
+        step, written under ANY topology.  Restored values land in the
+        scope as host arrays and are re-placed under THIS executor's
+        sharding rules on the next run — which is exactly how ZeRO
+        on↔off conversion happens: the checkpoint stores global rows,
+        placement is a property of the reader."""
+        from .. import checkpoint as _ckpt
+        from ..checkpoint.elastic import restore_scope
+        program = program or self._program
+        scope = scope or self._scope
+        step = restore_scope(root, program, scope, step=step,
+                             verify=verify)
+        # restored vars must be RE-PLACED (their old placement died with
+        # the host copy); _put_state runs again on next dispatch
+        for v in program.global_block.vars.values():
+            self._placed.discard(v.name)
+        return step
+
     @property
     def device_count(self) -> int:
         return self.mesh.size

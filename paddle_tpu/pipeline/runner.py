@@ -117,6 +117,40 @@ class _StageExecutor(Executor):
         return self._place(val)
 
 
+def _make_stage_parallel_executor(build_strategy, stage_program):
+    """Stage executor for the pp×dp(×ZeRO) composition (SCAN mode
+    only — PipelineTrainer.run gates the rest): each stage's programs
+    run as ONE sharded jit over a dp mesh — state sharded per the
+    BuildStrategy (kReduce = ZeRO), microbatch feeds sharded along
+    their WITHIN-microbatch batch axis.  Scan-mode feeds are stacked
+    ``[M, batch, ...]``, so the batch axis is axis 1, not axis 0 (the
+    plain ParallelExecutor convention); axis-0 sharding would partition
+    the scan, which is wrong by construction."""
+    from ..parallel.parallel_executor import ParallelExecutor
+
+    class _StagePE(ParallelExecutor):
+        def run(self, program=None, feed=None, fetch_list=None,
+                scope=None, return_numpy=True, **kwargs):
+            # Executor-shaped signature: the pipeline drivers call every
+            # stage executor positionally as run(program, ...)
+            return ParallelExecutor.run(
+                self, fetch_list=fetch_list, feed=feed, program=program,
+                scope=scope, return_numpy=return_numpy, **kwargs)
+
+        def _put_feed(self, arr):
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            dp = self.mesh.shape[self._dp_axis]
+            nd = getattr(arr, "ndim", 0)
+            if nd >= 2 and arr.shape[1] % dp == 0 and arr.shape[1] > 0:
+                spec = P(None, self._dp_axis, *([None] * (nd - 2)))
+                return jax.device_put(arr, NamedSharding(self.mesh, spec))
+            return jax.device_put(arr, self._replicated())
+
+    return _StagePE(main_program=stage_program,
+                    build_strategy=build_strategy)
+
+
 class PipelineTrainer:
     """Drive a transpiled pipeline for training steps.
 
@@ -134,10 +168,21 @@ class PipelineTrainer:
                  schedule: str = "gpipe",
                  devices: Optional[List] = None,
                  concurrent: Optional[bool] = None,
-                 transport: str = "local"):
+                 transport: str = "local",
+                 parallel=None):
         self.pp = pipeline_program
         self.K = pipeline_program.num_stages
         self.M = pipeline_program.num_microbatches
+        # pp×dp(×ZeRO) composition: a BuildStrategy turns every stage
+        # executor into a dp-mesh ParallelExecutor (state sharded per
+        # reduce_strategy — kReduce is the ZeRO cell of the reshard
+        # matrix); scan/sequential modes only, the slot runner pins
+        # stages to single devices instead
+        self.parallel = parallel
+        if parallel is not None and devices is not None:
+            raise ValueError(
+                "parallel= (dp mesh per stage) and devices= (one device "
+                "per stage) are mutually exclusive stage placements")
         if schedule not in ("gpipe", "1f1b", "one_f_one_b"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = "1f1b" if schedule == "one_f_one_b" else schedule
@@ -161,9 +206,15 @@ class PipelineTrainer:
                     "pipeline has skip boundaries — use the local or "
                     "RPC transport")
         self.transport = transport
-        self.executors = [
-            _StageExecutor(self.devices[s] if self.devices else None)
-            for s in range(self.K)]
+        if self.parallel is not None:
+            self.executors = [
+                _make_stage_parallel_executor(self.parallel,
+                                              st.fwd_program)
+                for st in self.pp.stages]
+        else:
+            self.executors = [
+                _StageExecutor(self.devices[s] if self.devices else None)
+                for s in range(self.K)]
         self.scopes = [Scope() for _ in range(self.K)]
         self._initialized = False
 
@@ -187,6 +238,106 @@ class PipelineTrainer:
                 out[name] = np.asarray(scope.find_var(name))
         return out
 
+    # -- sharded checkpoints (paddle_tpu/checkpoint/) ----------------------
+    def _stage_persist_names(self, k: int) -> List[str]:
+        """Persistable vars a stage owns: declared persistable in any of
+        its programs AND present in its scope (grad @ACC accumulators
+        only exist after the first backward)."""
+        from ..core.executor import RNG_STATE_VAR
+        st = self.pp.stages[k]
+        progs = [st.startup_program, st.fwd_program, st.bwd_program,
+                 st.opt_program]
+        names = set()
+        for p in progs:
+            if p is None:
+                continue
+            for v in p.global_block.vars.values():
+                if v.persistable and v.name != RNG_STATE_VAR:
+                    names.add(v.name)
+        scope = self.scopes[k]
+        return sorted(n for n in names if scope.find_var(n) is not None)
+
+    def save_checkpoint(self, root: str, step: int,
+                        commit: bool = True) -> bool:
+        """Write one checkpoint piece per stage (writer ``stage<k>``)
+        and two-phase commit the step.  Pipeline sharding partitions the
+        VAR SET, not rows — each stage's vars are whole shards, and vars
+        replicated across stages (the LR closure every optimizing stage
+        carries) are marked replicated so any stage's copy restores
+        them.  The manifest is topology-independent: restore onto a
+        different stage count or a plain single host re-shards from the
+        same files (``checkpoint.restore_scope`` / ``load_vars``)."""
+        from .. import checkpoint as _ckpt
+        per_stage = [self._stage_persist_names(k) for k in range(self.K)]
+        count: Dict[str, int] = {}
+        for names in per_stage:
+            for n in names:
+                count[n] = count.get(n, 0) + 1
+        writers = [f"stage{k}" for k in range(self.K)]
+        topo = {"kind": "pipeline", "pp": self.K,
+                "schedule": self.schedule}
+        if self.parallel is not None:
+            topo["dp_mesh"] = dict(self.parallel.mesh_shape or {})
+            from ..parallel.strategy import ReduceStrategy
+            topo["zero"] = (self.parallel.reduce_strategy
+                            == ReduceStrategy.kReduce)
+        for k, names in enumerate(per_stage):
+            scope = self.scopes[k]
+            arrays, extents = {}, {}
+            for n in names:
+                arr = np.asarray(scope.find_var(n))
+                arrays[n] = arr
+                if count[n] > 1:
+                    # stage-replicated (LR closure): identical
+                    # deterministic evolution on every stage
+                    extents[n] = {"var": n, "offset": None, "rows": None,
+                                  "global_shape": list(arr.shape)}
+            _ckpt.write_piece(root, step, f"stage{k}", arrays,
+                              extents=extents, topology=topo,
+                              expected_writers=writers)
+        if commit:
+            return _ckpt.try_commit(root, step, writers)
+        return False
+
+    def restore_checkpoint(self, root: str, step: Optional[int] = None,
+                           verify: bool = True) -> int:
+        """Hydrate every stage scope from the newest (or given) COMPLETE
+        step — written by ANY topology (a different stage count, a
+        plain single-host save, a pserver fleet).  Restored values are
+        re-placed by each stage executor on its next dispatch."""
+        from .. import checkpoint as _ckpt
+        if step is None:
+            step = _ckpt.latest_complete_step(root)
+            if step is None:
+                raise _ckpt.CheckpointError(
+                    f"no COMPLETE checkpoint step under {root!r}")
+        from .transpiler import ACC_SUFFIX
+        man = _ckpt.load_manifest(root, step)
+        have = man.vars()
+        for k in range(self.K):
+            names = self._stage_persist_names(k)
+            # <grad>@ACC microbatch accumulators are pipeline-transpiler
+            # transients, zeroed between minibatches: a checkpoint from a
+            # NON-pipeline topology legitimately lacks them — keep the
+            # startup zeros.  Anything else missing is a real hole.
+            missing = [n for n in names if n not in have
+                       and not n.endswith(ACC_SUFFIX)]
+            if missing:
+                raise _ckpt.CheckpointError(
+                    f"checkpoint step {step} is missing stage {k} "
+                    f"persistable vars {missing[:8]}")
+            names = [n for n in names if n in have]
+            vals = _ckpt.load_vars(root, step,
+                                   {n: (None, None) for n in names},
+                                   verify=verify)
+            scope = self.scopes[k]
+            for n, v in vals.items():
+                scope.set_var(n, v)
+            placed = getattr(self.executors[k], "_placed", None)
+            if placed is not None:
+                placed.clear()
+        return step
+
     # -- feed plumbing -----------------------------------------------------
     def _split_feed(self, feed: Dict[str, object]):
         from .transpiler import split_microbatches
@@ -203,6 +354,14 @@ class PipelineTrainer:
             raise RuntimeError("call PipelineTrainer.init() first")
         if mode is None:
             mode = "slots" if self.concurrent else "scan"
+        if self.parallel is not None and mode != "scan":
+            # sequential mode feeds per-microbatch [batch, ...] arrays
+            # whose axis 1 is a FEATURE axis — the stage PE's scan-
+            # stacked feed sharding would partition the wrong axis; and
+            # the slot runner wants one device per stage, not a mesh
+            raise ValueError(
+                "parallel= stage composition supports scan mode only "
+                f"(got mode={mode!r})")
         t0 = time.perf_counter()
         if mode == "slots":
             res = self._run_slots(feed)

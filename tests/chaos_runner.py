@@ -43,13 +43,18 @@ def _build_transpiler():
     from dist_model import build
 
     endpoints = os.environ["PADDLE_PSERVER_ENDPOINTS"].split(",")
-    prog, startup, loss = build(lr=0.05)
+    prog, startup, loss = build(
+        lr=0.05, optimizer=os.environ.get("CHAOS_OPTIMIZER", "sgd"))
     cfg = DistributeTranspilerConfig()
     cfg.backup_endpoints = os.environ.get("CHAOS_BACKUPS", "")
     cfg.lease_ttl = float(os.environ.get("CHAOS_LEASE_TTL", "0") or 0)
     cfg.checkpoint_dir = os.environ.get("CHAOS_CKPT_DIR") or None
+    cfg.checkpoint_sharded = os.environ.get("CHAOS_CKPT_SHARDED") == "1"
+    cfg.min_block_size = int(os.environ.get("CHAOS_MIN_BLOCK",
+                                            "8192") or 8192)
     if cfg.checkpoint_dir:
-        cfg.checkpoint_every_rounds = 1
+        cfg.checkpoint_every_rounds = int(
+            os.environ.get("CHAOS_CKPT_EVERY", "1"))
     t = fluid.DistributeTranspiler(config=cfg)
     t.transpile(trainer_id=0, program=prog, pservers=",".join(endpoints),
                 trainers=1, sync_mode=True, startup_program=startup)
@@ -104,19 +109,49 @@ def main():
 
     # TRAINER
     tp = t.get_trainer_program()
-    exe.run(startup, scope=scope)
-    from dist_model import batches
     n_steps = int(os.environ.get("DIST_STEPS", "20"))
+    # elastic-resume phase window: steps [start, start + n_steps) of a
+    # DIST_TOTAL_STEPS-long deterministic batch stream (a resized
+    # trainer resumes from the checkpoint's cut over the same data)
+    start = int(os.environ.get("DIST_START_STEP", "0"))
+    if start > 0:
+        # resuming mid-run: pull the LIVE (checkpoint-restored) params
+        # from the pservers instead of fresh local init — the joining-
+        # trainer hydration path of get_trainer_startup_program
+        exe.run(t.get_trainer_startup_program(), scope=scope)
+    else:
+        exe.run(startup, scope=scope)
+    from dist_model import batches
+    total = int(os.environ.get("DIST_TOTAL_STEPS", str(start + n_steps)))
+    # CHAOS_NOTIFY_AT: "6:wait,12" = checkpoint_notify at global steps
+    # 6 and 12, blocking on the two-phase commit for entries tagged
+    # ":wait" (the fleet-cut trigger of the resize story)
+    notify_spec = {}
+    for ent in filter(None,
+                      os.environ.get("CHAOS_NOTIFY_AT", "").split(",")):
+        step_s, _, tag = ent.partition(":")
+        notify_spec[int(step_s)] = tag == "wait"
     progress_path = os.environ["CHAOS_PROGRESS"]
     losses = []
     try:
-        for i, (x, y) in enumerate(batches(n_steps)):
+        for i, (x, y) in enumerate(batches(total)[start:start + n_steps],
+                                   start=start + 1):
             (l,) = exe.run(tp, feed={"x": x, "y": y}, fetch_list=[loss],
                            scope=scope)
             losses.append(float(np.asarray(l)))
             with open(progress_path + ".tmp", "w") as f:
-                json.dump({"step": i + 1, "losses": losses}, f)
+                json.dump({"step": i - start, "global_step": i,
+                           "losses": losses}, f)
             os.replace(progress_path + ".tmp", progress_path)
+            if i in notify_spec:
+                from paddle_tpu.distributed import notify_checkpoint
+                notify_checkpoint(endpoints,
+                                  os.environ["CHAOS_CKPT_DIR"], step=i)
+                if notify_spec[i]:
+                    import paddle_tpu.checkpoint as pckpt
+                    assert pckpt.wait_step_complete(
+                        os.environ["CHAOS_CKPT_DIR"], i, timeout=120), \
+                        f"checkpoint step {i} never committed"
         notify_complete(endpoints, trainer_id=0)
     finally:
         _dump_events("trainer")

@@ -1,0 +1,119 @@
+"""Subprocess child for the reshard-matrix cells that need a multi-
+device CPU mesh (``--xla_force_host_platform_device_count``): the ZeRO
+(kReduce dp2) cell and the composed pp2 × dp2 × ZeRO cell — the
+matrix's hardest corner (ISSUE 12 satellite / PR-9 residual).
+
+Each cell trains half a run under the sharded topology, saves through
+the two-phase store, restores onto a PLAIN single-host layout, finishes
+the run there, and compares the stitched loss curve against the
+uninterrupted single-host reference.  Prints one ``CKPTMATRIX=<json>``
+line the test asserts on."""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    out = {"devices": len(jax.devices())}
+
+    import tempfile
+
+    import paddle_tpu.checkpoint as ckpt
+    import paddle_tpu.pipeline as pipe
+    from paddle_tpu.core.executor import Executor, Scope
+    from paddle_tpu.parallel import (BuildStrategy, ParallelExecutor,
+                                     ReduceStrategy)
+    from dist_model import batches, build
+    from test_pipeline import build_mlp, mlp_feed, reference_losses
+
+    n, k = 6, 3
+
+    # -- cell 1: ZeRO (kReduce dp2) -> plain single host -------------------
+    def local_ref():
+        prog, startup, loss = build(optimizer="adam", lr=0.05)
+        sc, exe = Scope(), Executor()
+        exe.run(startup, scope=sc)
+        losses = []
+        for x, y in batches(n):
+            (lv,) = exe.run(prog, feed={"x": x, "y": y},
+                            fetch_list=[loss], scope=sc)
+            losses.append(float(lv))
+        return losses
+
+    ref = local_ref()
+    prog, startup, loss = build(optimizer="adam", lr=0.05)
+    scope = Scope()
+    bs = BuildStrategy(mesh_shape={"dp": 2},
+                       reduce_strategy=ReduceStrategy.kReduce)
+    pe = ParallelExecutor(loss_name=loss.name, main_program=prog,
+                          build_strategy=bs, scope=scope)
+    pe.run(program=startup, scope=scope)
+    zl = []
+    for x, y in batches(n)[:k]:
+        (lv,) = pe.run(feed={"x": x, "y": y}, fetch_list=[loss])
+        zl.append(float(np.asarray(lv)))
+    root = os.path.join(tempfile.mkdtemp(prefix="ckpt_zero_"), "ck")
+    committed = pe.save_sharded_state(root, step=k)
+    man = ckpt.load_manifest(root, k)
+    prog2, startup2, loss2 = build(optimizer="adam", lr=0.05)
+    sc2, exe2 = Scope(), Executor()
+    exe2.run(startup2, scope=sc2)
+    ckpt.restore_scope(root, prog2, sc2)
+    for x, y in batches(n)[k:]:
+        (lv,) = exe2.run(prog2, feed={"x": x, "y": y},
+                         fetch_list=[loss2], scope=sc2)
+        zl.append(float(lv))
+    out["zero"] = {
+        "committed": bool(committed), "topology": man.topology,
+        "losses": zl, "ref": ref,
+        "max_rel": float(np.max(np.abs(np.array(zl) - np.array(ref))
+                                / np.abs(np.array(ref)))),
+    }
+
+    # -- cell 2: pp2 x dp2 x ZeRO -> plain single host ---------------------
+    feed = mlp_feed()
+    pref = reference_losses(build_mlp, feed, steps=n)
+    pprog, pstartup, ploss = build_mlp()
+    pp = pipe.PipelineTranspiler().transpile(
+        pprog, pstartup, num_stages=2, num_microbatches=4,
+        loss_name=ploss.name)
+    tr = pipe.PipelineTrainer(pp, parallel=bs).init()
+    pl = [tr.run(feed).loss for _ in range(k)]
+    root2 = os.path.join(tempfile.mkdtemp(prefix="ckpt_pp_"), "ck")
+    committed2 = tr.save_checkpoint(root2, step=k)
+    man2 = ckpt.load_manifest(root2, k)
+    qprog, qstartup, qloss = build_mlp()
+    sc3, exe3 = Scope(), Executor()
+    exe3.run(qstartup, scope=sc3)
+    ckpt.restore_scope(root2, qprog, sc3, strict=False)
+    for _ in range(n - k):
+        (lv,) = exe3.run(qprog, feed=feed, fetch_list=[qloss], scope=sc3)
+        pl.append(float(lv))
+    out["composed"] = {
+        "committed": bool(committed2), "topology": man2.topology,
+        "writers": man2.writers,
+        "losses": pl, "ref": pref,
+        "max_rel": float(np.max(np.abs(np.array(pl) - np.array(pref))
+                                / np.abs(np.array(pref)))),
+    }
+
+    # -- and the reverse direction: plain save -> composed restore --------
+    root3 = os.path.join(tempfile.mkdtemp(prefix="ckpt_rev_"), "ck")
+    ckpt.save_scope(root3, n, qprog, sc3)
+    tr2 = pipe.PipelineTrainer(pp, parallel=bs).init()
+    tr2.restore_checkpoint(root3)
+    l_pipe = tr2.run(feed).loss
+    (l_ref,) = exe3.run(qprog, feed=feed, fetch_list=[qloss], scope=sc3)
+    out["reverse"] = {"pipe_loss": float(l_pipe),
+                      "plain_loss": float(np.asarray(l_ref))}
+
+    print("CKPTMATRIX=" + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -439,6 +439,136 @@ def test_wait_server_ready_retargets_on_promotion():
         registry.stop()
 
 
+@pytest.mark.chaos_lite
+@retry_flaky()
+def test_kill_mid_snapshot_then_resize_2_to_3_pservers():
+    """ISSUE 12 chaos scenario: sharded checkpoints under faults + a
+    live fleet resize.
+
+    Phase A: 2 pservers with topology-independent sharded checkpoints.
+    The trainer cuts at step 3 (two-phase commit COMPLETES) and at step
+    6 — but pserver ps0 is fault-armed to die MID-SNAPSHOT on its
+    second piece write, so step 6 never commits.  Two-phase pin: the
+    store must list ONLY the complete step (3); the torn step-6 residue
+    stays in _tmp, invisible to restore.
+
+    Phase B: a 3-pserver fleet (grown 2→3) on fresh ports hydrates from
+    the newest COMPLETE step — each new pserver re-shards the manifest
+    onto its own sections — and the trainer resumes from global step 3.
+    Acceptance: the stitched loss curve matches the no-fault local run
+    at rtol 1e-4 (phase A in full, including the steps the crash threw
+    away, AND phase B's replay from the cut)."""
+    n_total, cut = 12, 3
+    kill_at = 6
+    registry = RegistryServer("127.0.0.1:0")
+    registry.start()
+    reg_ep = f"127.0.0.1:{registry.port}"
+    with tempfile.TemporaryDirectory() as tmp:
+        import paddle_tpu.checkpoint as pckpt
+        root = os.path.join(tmp, "ck")
+        flight_dir = os.path.join(tmp, "flight")
+        base_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "FLAGS_pserver_registry": reg_ep,
+            "CHAOS_CKPT_DIR": root,
+            "CHAOS_CKPT_SHARDED": "1",
+            "CHAOS_CKPT_EVERY": "0",   # cuts come from notify only
+            "CHAOS_OPTIMIZER": "adam",
+            "CHAOS_MIN_BLOCK": "4",    # the tiny model still slices
+            "CHAOS_EVENTS": os.path.join(tmp, "events"),
+            "PADDLE_READY_DIR": os.path.join(tmp, "ready"),
+            "PYTHONPATH": os.pathsep.join(
+                [os.path.dirname(HERE), HERE,
+                 os.environ.get("PYTHONPATH", "")]),
+        }
+        procs = []
+        try:
+            # ---- phase A: 2 pservers, ps0 dies mid-snapshot #2 -------
+            eps_a = [f"127.0.0.1:{p}" for p in free_ports(2)]
+            env_a = {**base_env,
+                     "PADDLE_PSERVER_ENDPOINTS": ",".join(eps_a)}
+            ps0 = _spawn("PSERVER", env_a, PADDLE_CURRENT_ENDPOINT=eps_a[0],
+                         FLAGS_fault_inject="kill_after:ckpt_piece:n=2",
+                         FLAGS_flight_record_dir=flight_dir)
+            ps1 = _spawn("PSERVER", env_a, PADDLE_CURRENT_ENDPOINT=eps_a[1])
+            procs += [ps0, ps1]
+            transport.wait_server_ready(eps_a, timeout=300,
+                                        ready_dir=env_a["PADDLE_READY_DIR"])
+            prog_a = os.path.join(tmp, "progress_a.json")
+            tr_a = _spawn("TRAINER", env_a, CHAOS_PROGRESS=prog_a,
+                          DIST_STEPS=str(kill_at),
+                          DIST_TOTAL_STEPS=str(n_total),
+                          CHAOS_NOTIFY_AT=f"{cut}:wait,{kill_at}")
+            procs.append(tr_a)
+            out, err = tr_a.communicate(timeout=600)
+            assert tr_a.returncode == 0, (
+                "phase-A trainer failed:\n" + err.decode()[-2000:])
+            assert ps0.wait(timeout=120) == 137   # died mid-snapshot
+            assert ps1.wait(timeout=120) == 0     # clean COMPLETE exit
+
+            # the two-phase pin: only the committed cut is COMPLETE; the
+            # kill's torn step is _tmp residue restore never reads
+            assert pckpt.complete_steps(root) == [cut]
+            assert kill_at in pckpt.inflight_steps(root)
+            assert pckpt.verify_step(root, cut)["ok"]
+            # ps0's flight dump names the mid-snapshot death
+            dumps = glob.glob(os.path.join(flight_dir, "flight_*.json"))
+            assert dumps, "killed pserver left no flight dump"
+            kill_notes = [e for d in dumps
+                          for e in json.load(open(d))["events"]
+                          if e["msg"] == "fault_kill"]
+            assert kill_notes and \
+                kill_notes[0]["target"] == "ckpt_piece"
+
+            losses_a = json.load(open(prog_a))["losses"]
+
+            # ---- phase B: 3-pserver fleet grown from the checkpoint --
+            eps_b = [f"127.0.0.1:{p}" for p in free_ports(3)]
+            env_b = {**base_env,
+                     "PADDLE_PSERVER_ENDPOINTS": ",".join(eps_b)}
+            ps_b = [_spawn("PSERVER", env_b, PADDLE_CURRENT_ENDPOINT=ep)
+                    for ep in eps_b]
+            procs += ps_b
+            transport.wait_server_ready(eps_b, timeout=300,
+                                        ready_dir=env_b["PADDLE_READY_DIR"])
+            prog_b = os.path.join(tmp, "progress_b.json")
+            tr_b = _spawn("TRAINER", env_b, CHAOS_PROGRESS=prog_b,
+                          DIST_START_STEP=str(cut),
+                          DIST_STEPS=str(n_total - cut),
+                          DIST_TOTAL_STEPS=str(n_total),
+                          CHAOS_NOTIFY_AT=f"{n_total}:wait")
+            procs.append(tr_b)
+            out, err = tr_b.communicate(timeout=600)
+            assert tr_b.returncode == 0, (
+                "phase-B trainer failed:\n" + err.decode()[-2000:])
+            for p in ps_b:
+                assert p.wait(timeout=120) == 0
+            losses_b = json.load(open(prog_b))["losses"]
+            # the resized fleet checkpoints too: monotonic step ids
+            # continue from the recovered cut
+            assert pckpt.complete_steps(root) == [cut, n_total]
+
+            # ---- acceptance: no-fault loss parity --------------------
+            from dist_model import build
+            local_losses, _ = run_local(
+                n_total,
+                build_fn=lambda: build(lr=0.05, optimizer="adam"))
+            # phase A matched the no-fault run in full (async snapshots
+            # + the mid-snapshot kill never perturbed the step loop)
+            np.testing.assert_allclose(losses_a, local_losses[:kill_at],
+                                       rtol=1e-4, atol=1e-5)
+            # phase B replays from the cut and matches the rest
+            np.testing.assert_allclose(losses_b, local_losses[cut:],
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            registry.stop()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+
+
 @pytest.mark.slow
 @retry_flaky()
 def test_network_flap_during_batch_barrier():

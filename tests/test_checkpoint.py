@@ -1,0 +1,655 @@
+"""Elastic topology reshard (ISSUE 12): topology-independent sharded
+checkpoints, two-phase commit, async no-pause snapshotting, reshard
+matrix, and the hardened legacy io paths.
+
+Acceptance pins covered here:
+- two-phase commit: a crash mid-save (pieces without the commit rename)
+  is invisible to restore; only COMPLETE steps are listed/loadable;
+- the reshard matrix: a checkpoint written under a dp×pserver-sharded
+  layout restores BIT-IDENTICALLY onto >=3 different target layouts,
+  N→M pserver counts in both directions (in-process, numpy-exact);
+  the live end-to-end (real pserver fleet → notify → commit → 3-layout
+  reshard) runs against a 2-pserver thread cluster;
+- the ZeRO and pp×dp×ZeRO composed cells run in a 2-device subprocess
+  (tests/ckpt_matrix_runner.py) with loss-curve parity onto a plain
+  single-host restore;
+- io.py satellites: atomic saves (a failed save leaves the previous
+  file intact), clear errors naming missing/corrupt files;
+- checkpoint_notify best-effort-all fan-out + rpc.ckpt_notify_failures;
+- master cut stamping through snapshot/publish/recover.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.checkpoint as ckpt
+from paddle_tpu.checkpoint import store as ckpt_store
+from paddle_tpu.core.executor import Executor, Scope
+from paddle_tpu.distributed import (notify_checkpoint, notify_complete,
+                                    wait_server_ready)
+from dist_model import batches, build, free_ports, retry_flaky, run_local
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---------------------------------------------------------------------------
+# store: two-phase commit
+# ---------------------------------------------------------------------------
+
+def _piece(root, step, writer, arrays, extents=None, expected=None):
+    return ckpt.write_piece(root, step, writer, arrays, extents=extents,
+                            expected_writers=expected)
+
+
+def test_two_phase_commit_and_crash_invisibility(tmp_path):
+    root = str(tmp_path / "ck")
+    w = np.arange(12, dtype=np.float32).reshape(6, 2)
+    ext0 = {"w0": {"var": "w", "offset": 0, "rows": 3,
+                   "global_shape": [6, 2]}}
+    ext1 = {"w1": {"var": "w", "offset": 3, "rows": 3,
+                   "global_shape": [6, 2]}}
+    _piece(root, 4, "ps0", {"w0": w[:3]}, ext0, ["ps0", "ps1"])
+    # half-written step: one piece only — uncommittable and invisible
+    assert not ckpt.try_commit(root, 4)
+    assert ckpt.complete_steps(root) == []
+    assert ckpt.inflight_steps(root) == [4]
+    assert ckpt.latest_complete_step(root) is None
+    with pytest.raises(ckpt.CheckpointError):
+        ckpt.load_vars(root, 4)
+
+    _piece(root, 4, "ps1", {"w1": w[3:]}, ext1, ["ps0", "ps1"])
+    assert ckpt.try_commit(root, 4)            # all pieces -> COMPLETE
+    assert ckpt.try_commit(root, 4)            # idempotent
+    assert ckpt.complete_steps(root) == [4]
+    assert ckpt.inflight_steps(root) == []
+    assert np.array_equal(ckpt.load_vars(root, 4)["w"], w)
+
+    # a NEWER half-written step never shadows the COMPLETE one
+    _piece(root, 9, "ps0", {"w0": w[:3] + 1}, ext0, ["ps0", "ps1"])
+    assert ckpt.latest_complete_step(root) == 4
+    assert np.array_equal(ckpt.load_vars(root)["w"], w)
+    assert ckpt.verify_step(root, 4)["ok"]
+
+
+def test_reshard_matrix_bit_identical(tmp_path):
+    """The matrix core, numpy-exact: write under 2-writer row sharding,
+    restore onto >=3 target layouts (1-way, 3-way, uneven) plus the
+    reverse M→N direction — every cell bit-identical."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(7, 3).astype(np.float32)
+    m = rng.randn(7, 3).astype(np.float32)       # param-shaped moment
+    lr = np.float32([0.01])                      # replicated
+    pow_ = np.float32(0.9)                       # replicated 0-d
+
+    def write(root, cuts):
+        """cuts: list of (lo, hi) per writer."""
+        writers = [f"ps{i}" for i in range(len(cuts))]
+        for i, (lo, hi) in enumerate(cuts):
+            arrays = {"w@B": w[lo:hi], "m@B": m[lo:hi],
+                      "lr": lr, "pow": pow_}
+            ext = {"w@B": {"var": "w", "offset": lo, "rows": hi - lo,
+                           "global_shape": [7, 3]},
+                   "m@B": {"var": "m", "offset": lo, "rows": hi - lo,
+                           "global_shape": [7, 3]},
+                   "lr": {"var": "lr", "offset": None, "rows": None,
+                          "global_shape": [1]},
+                   "pow": {"var": "pow", "offset": None, "rows": None,
+                           "global_shape": []}}
+            _piece(root, 1, writers[i], arrays, ext, writers)
+        assert ckpt.try_commit(root, 1, writers)
+
+    rootA = str(tmp_path / "A")                  # N=2 writers
+    write(rootA, [(0, 4), (4, 7)])
+    rootB = str(tmp_path / "B")                  # N=3 writers (uneven)
+    write(rootB, [(0, 2), (2, 3), (3, 7)])
+
+    for root in (rootA, rootB):                  # both source layouts
+        # target 1: plain single host (full arrays)
+        full = ckpt.load_vars(root, 1)
+        assert np.array_equal(full["w"], w)
+        assert np.array_equal(full["m"], m)
+        assert np.array_equal(full["lr"], lr)
+        assert np.array_equal(full["pow"], pow_)
+        # targets 2..n: every slicing, incl. boundaries CROSSING the
+        # writer cuts (the actual reshard case)
+        for cuts in ([(0, 7)], [(0, 3), (3, 7)],
+                     [(0, 1), (1, 5), (5, 7)],
+                     [(0, 2), (2, 4), (4, 6), (6, 7)]):
+            wants = {f"w@{i}": {"var": "w", "offset": lo, "rows": hi - lo}
+                     for i, (lo, hi) in enumerate(cuts)}
+            out = ckpt.load_locals(root, 1, wants)
+            for i, (lo, hi) in enumerate(cuts):
+                assert np.array_equal(out[f"w@{i}"], w[lo:hi]), (root, cuts)
+
+
+def test_coverage_gap_and_corruption_are_loud(tmp_path):
+    root = str(tmp_path / "ck")
+    w = np.ones((6, 2), np.float32)
+    # writers covering [0,2) and [4,6): rows [2,4) exist nowhere
+    _piece(root, 1, "a", {"w": w[:2]},
+           {"w": {"var": "w", "offset": 0, "rows": 2,
+                  "global_shape": [6, 2]}}, ["a", "b"])
+    _piece(root, 1, "b", {"w": w[4:]},
+           {"w": {"var": "w", "offset": 4, "rows": 2,
+                  "global_shape": [6, 2]}}, ["a", "b"])
+    assert ckpt.try_commit(root, 1)
+    with pytest.raises(ckpt.CheckpointError, match=r"rows \[2, 4\)"):
+        ckpt.load_vars(root, 1)
+    # a slice entirely inside one writer still loads
+    out = ckpt.load_locals(root, 1,
+                           {"x": {"var": "w", "offset": 0, "rows": 2}})
+    assert np.array_equal(out["x"], w[:2])
+    # unknown var names itself
+    with pytest.raises(ckpt.CheckpointError, match="nope"):
+        ckpt.load_locals(root, 1, {"x": {"var": "nope", "offset": 0,
+                                         "rows": 1}})
+    # flip bytes in a shard file: digest verification refuses, loudly
+    sdir = ckpt_store.step_dir(root, 1)
+    path = os.path.join(sdir, "shard-a.npz")
+    data = bytearray(open(path, "rb").read())
+    data[-20] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(ckpt.CheckpointError):
+        ckpt.load_locals(root, 1, {"x": {"var": "w", "offset": 0,
+                                         "rows": 2}})
+
+
+def test_dense_want_against_replicated_shard_slices(tmp_path):
+    """A reader's extent table must not care whether the writer stored
+    a var sharded or replicated: a dense row-range want against a
+    replicated copy gets exactly its rows (a pserver section hydrating
+    a stage-replicated var), with out-of-range wants loud."""
+    root = str(tmp_path / "ck")
+    w = np.arange(16, dtype=np.float32).reshape(8, 2)
+    ckpt.commit_single(root, 1, "s0", {"w": w},
+                       extents={"w": {"var": "w", "offset": None,
+                                      "rows": None,
+                                      "global_shape": [8, 2]}})
+    out = ckpt.load_locals(root, 1,
+                           {"w@B1": {"var": "w", "offset": 4, "rows": 4}})
+    assert np.array_equal(out["w@B1"], w[4:])
+    full = ckpt.load_vars(root, 1)
+    assert np.array_equal(full["w"], w)
+    with pytest.raises(ckpt.CheckpointError, match="outside"):
+        ckpt.load_locals(root, 1,
+                         {"x": {"var": "w", "offset": 6, "rows": 4}})
+
+
+def test_overlapping_dense_shards_refused(tmp_path):
+    """Two writers claiming the same rows of one var is a disagreement,
+    not redundancy: restore refuses loudly, naming both shards (the
+    sanctioned duplication mechanism is replicated extents)."""
+    root = str(tmp_path / "ck")
+    w = np.ones((4, 2), np.float32)
+    _piece(root, 1, "a", {"w": w[:3]},
+           {"w": {"var": "w", "offset": 0, "rows": 3,
+                  "global_shape": [4, 2]}}, ["a", "b"])
+    _piece(root, 1, "b", {"w": w[2:] * 2},
+           {"w": {"var": "w", "offset": 2, "rows": 2,
+                  "global_shape": [4, 2]}}, ["a", "b"])
+    assert ckpt.try_commit(root, 1)
+    with pytest.raises(ckpt.CheckpointError, match="overlap"):
+        ckpt.load_vars(root, 1)
+
+
+def test_prune_keeps_newest(tmp_path):
+    root = str(tmp_path / "ck")
+    for s in (1, 2, 3, 4):
+        ckpt.commit_single(root, s, "h", {"w": np.full(3, s, np.float32)})
+    _piece(root, 9, "h", {"w": np.zeros(3, np.float32)})   # in-flight
+    res = ckpt.prune(root, keep=2, reap_inflight=True)
+    assert res["removed_steps"] == [1, 2]
+    assert res["reaped_inflight"] == [9]
+    assert ckpt.complete_steps(root) == [3, 4]
+    assert ckpt.load_vars(root)["w"][0] == 4
+
+
+# ---------------------------------------------------------------------------
+# async snapshotter
+# ---------------------------------------------------------------------------
+
+def test_async_snapshotter_no_pause_and_faults(tmp_path, monkeypatch):
+    root = str(tmp_path / "ck")
+    state = {"v": np.arange(4, dtype=np.float32)}
+    gate = threading.Event()
+
+    real_write = ckpt_store.write_piece
+
+    def slow_write(*a, **kw):
+        gate.wait(timeout=30)
+        return real_write(*a, **kw)
+
+    # ckpt.snapshot resolves write_piece through the store module, so
+    # one module-attribute patch covers it
+    monkeypatch.setattr(ckpt_store, "write_piece", slow_write)
+    snap = ckpt.AsyncSnapshotter(root, "h", lambda step: dict(state),
+                                 expected_writers=["h"])
+    t0 = time.perf_counter()
+    assert snap.snapshot(1)                  # returns before the write
+    accept_ms = (time.perf_counter() - t0) * 1e3
+    assert accept_ms < 1000, accept_ms       # never blocked on the gate
+    # while in flight, a second request is SKIPPED, not queued
+    assert not snap.snapshot(2)
+    assert snap.skipped == 1
+    gate.set()
+    assert snap.flush(timeout=30)
+    assert ckpt.complete_steps(root) == [1]
+    st = snap.status()
+    assert st["snapshots"] == 1 and st["skipped_inflight"] == 1
+    assert st["step"] == 1 and st["committed"]
+
+    # COLLECT fault: counted + recorded on the CALLER thread without
+    # deadlocking it (the fault handler re-takes the snapshotter lock,
+    # so it must run outside the accept critical section), and the
+    # snapshotter stays usable afterwards
+    boom = ckpt.AsyncSnapshotter(root, "h2", lambda step: 1 / 0,
+                                 expected_writers=["h2"])
+    assert not boom.snapshot(5)
+    assert boom.faults == 1
+    assert "ZeroDivisionError" in boom.status()["fault"]
+    boom.collect_fn = lambda step: {"v": np.ones(2, np.float32)}
+    assert boom.snapshot(6, wait=True)
+    assert ckpt.complete_steps(root) == [1, 6]
+    boom.close()
+
+    # WRITE fault: counted + recorded on the background thread, never
+    # raised, nothing half-written
+    def bad_write(*a, **kw):
+        raise OSError("disk on fire")
+    monkeypatch.setattr(ckpt.snapshot._store, "write_piece", bad_write)
+    assert snap.snapshot(3)
+    snap.flush(timeout=30)
+    assert snap.faults == 1
+    assert "disk on fire" in snap.status()["fault"]
+    assert ckpt.complete_steps(root) == [1, 6]
+    snap.close()
+
+
+def test_torn_piece_set_cannot_commit_and_wait_times_out(tmp_path):
+    """Two writers disagreeing on a var's global shape is a torn/foreign
+    piece set: try_commit refuses with the store's own error type, and
+    wait_step_complete absorbs it as a timeout (the previous COMPLETE
+    step stays authoritative) instead of crashing the cut caller."""
+    root = str(tmp_path / "ck")
+    _piece(root, 2, "a", {"w": np.ones((2, 2), np.float32)},
+           {"w": {"var": "w", "offset": 0, "rows": 2,
+                  "global_shape": [4, 2]}}, ["a", "b"])
+    _piece(root, 2, "b", {"w": np.ones((2, 3), np.float32)},
+           {"w": {"var": "w", "offset": 2, "rows": 2,
+                  "global_shape": [4, 3]}}, ["a", "b"])
+    with pytest.raises(ckpt.CheckpointError, match="cannot commit"):
+        ckpt.try_commit(root, 2)
+    assert not ckpt.wait_step_complete(root, 2, timeout=0.3)
+    assert ckpt.complete_steps(root) == []
+
+
+def test_snapshotter_statusz_provider(tmp_path):
+    from paddle_tpu.checkpoint.snapshot import _statusz
+    snap = ckpt.scope_snapshotter(str(tmp_path / "ck"),
+                                  fluid.default_main_program(), Scope())
+    try:
+        roots = [s["root"] for s in _statusz()["snapshotters"]]
+        assert str(tmp_path / "ck") in roots
+    finally:
+        snap.close()
+    assert str(tmp_path / "ck") not in [
+        s["root"] for s in _statusz()["snapshotters"]]
+
+
+# ---------------------------------------------------------------------------
+# io.py satellites
+# ---------------------------------------------------------------------------
+
+def test_io_atomic_save_keeps_previous_on_failure(tmp_path, monkeypatch):
+    d = str(tmp_path / "m")
+    prog, startup, _ = build()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    fluid.io.save_persistables(exe, d, prog)
+    path = os.path.join(d, fluid.io.PARAMS_FILENAME)
+    before = open(path, "rb").read()
+
+    def boom(f, **kw):
+        f.write(b"half")
+        raise OSError("simulated crash mid-save")
+    monkeypatch.setattr(np, "savez", boom)
+    with pytest.raises(OSError):
+        fluid.io.save_persistables(exe, d, prog)
+    # the crash left the PREVIOUS complete file intact, no tmp residue
+    assert open(path, "rb").read() == before
+    assert [f for f in os.listdir(d) if ".tmp." in f] == []
+
+
+def test_io_load_errors_name_the_file(tmp_path):
+    prog, startup, _ = build()
+    scope, exe = Scope(), Executor()
+    exe.run(startup, scope=scope)
+    missing = str(tmp_path / "nowhere")
+    os.makedirs(missing)
+    with pytest.raises(FileNotFoundError, match="__params__.npz"):
+        fluid.io.load_persistables(exe, missing, prog)
+    # corrupt npz: the error names the file, not a bare KeyError
+    bad_dir = str(tmp_path / "bad")
+    os.makedirs(bad_dir)
+    bad = os.path.join(bad_dir, fluid.io.PARAMS_FILENAME)
+    open(bad, "wb").write(b"this is not a zip file")
+    with pytest.raises(RuntimeError, match="corrupt"):
+        fluid.io.load_persistables(exe, bad_dir, prog)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint_notify best-effort-all (satellite)
+# ---------------------------------------------------------------------------
+
+class _NotifyRecorder:
+    """Minimal RPC service recording checkpoint notifies."""
+
+    def __init__(self):
+        self.seen = []
+
+    def handle(self, msg_type, trainer_id, name, payload):
+        from paddle_tpu.distributed import transport as tr
+        if msg_type == tr.CHECKPOINT_NOTIFY:
+            self.seen.append(name)
+            return tr.OK, b""
+        raise ValueError(msg_type)
+
+
+def test_checkpoint_notify_best_effort_all():
+    from paddle_tpu.distributed import transport
+    from paddle_tpu.distributed.ps_ops import broadcast_checkpoint_notify
+    from paddle_tpu.observability import stats as obs_stats
+
+    rec = _NotifyRecorder()
+    srv = transport.RPCServer("127.0.0.1:0", rec)
+    srv.start()
+    live = f"127.0.0.1:{srv.port}"
+    (dead_port,) = free_ports(1)
+    dead = f"127.0.0.1:{dead_port}"
+    client = transport.RPCClient(0)
+    before = obs_stats.counter("rpc.ckpt_notify_failures").value
+    try:
+        with pytest.warns(UserWarning, match="1/2"):
+            results = broadcast_checkpoint_notify(
+                client, [dead, live], "/tmp/ckdir", step=7,
+                connect_timeout=1.5)
+        # the live endpoint was STILL notified despite the dead one
+        assert rec.seen == ["/tmp/ckdir@@step=7"]
+        errs = dict(results)
+        assert errs[live] is None and errs[dead] is not None
+        after = obs_stats.counter("rpc.ckpt_notify_failures").value
+        assert after == before + 1
+        # every endpoint dead -> raises with the per-endpoint summary
+        with pytest.raises(RuntimeError, match="EVERY endpoint"):
+            with pytest.warns(UserWarning):
+                broadcast_checkpoint_notify(client, [dead], "/tmp/x",
+                                            connect_timeout=1.5)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# master cut stamping
+# ---------------------------------------------------------------------------
+
+def test_master_stamps_checkpoint_cut(tmp_path):
+    from paddle_tpu.distributed.master import TaskMaster
+    snap_path = str(tmp_path / "master.json")
+    m = TaskMaster(snapshot_path=snap_path)
+    m.set_dataset([[1], [2]])
+    cut = m.stamp_checkpoint(12, root="/ck/root")
+    assert cut == {"step": 12, "root": "/ck/root"}
+    assert m.state()["ckpt_cut"]["step"] == 12
+    # the stamp rides the snapshot: a restarted master recovers it
+    m2 = TaskMaster(snapshot_path=snap_path)
+    assert m2.checkpoint_cut()["step"] == 12
+    # and the publish/mirror path: a standby adopting state carries it
+    standby = TaskMaster(leader=False)
+    with m.lock:
+        state = m._state_dict()
+    assert standby.adopt_state(state)
+    assert standby.checkpoint_cut() == {"step": 12, "root": "/ck/root"}
+
+
+def test_master_client_ckpt_cut_rpc():
+    from paddle_tpu.distributed.master import MasterClient, serve_master
+    master, server = serve_master("127.0.0.1:0")
+    try:
+        mc = MasterClient(f"127.0.0.1:{server.port}", trainer_id=5)
+        assert mc.checkpoint_cut() is None
+        out = mc.stamp_checkpoint(3, root="/r", meta={"job": "j1"})
+        assert out == {"step": 3, "root": "/r", "job": "j1"}
+        assert mc.checkpoint_cut()["step"] == 3
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# elastic controller (registry health gauges)
+# ---------------------------------------------------------------------------
+
+def test_elastic_controller_decisions():
+    from paddle_tpu.distributed.registry import Heartbeat, RegistryServer
+    reg = RegistryServer("127.0.0.1:0")
+    reg.start()
+    reg_ep = f"127.0.0.1:{reg.port}"
+    hbs = []
+    try:
+        for i in range(2):
+            hb = Heartbeat(reg_ep, f"tr-{i}", f"127.0.0.1:{9100 + i}",
+                           ttl=5.0, role="TRAINER", trainer_id=i)
+            hb.start()
+            hbs.append(hb)
+        ctl = ckpt.ElasticController(reg_ep, poll_ttl=0.0)
+        deadline = time.monotonic() + 20
+        while len(ctl.alive("TRAINER")) < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ctl.alive("TRAINER") == ["tr-0", "tr-1"]
+        assert ctl.decide("TRAINER", 2)["action"] == "hold"
+        grow = ctl.decide("TRAINER", 3)
+        assert grow["action"] == "grow" and grow["delta"] == 1
+        shrink = ctl.decide("TRAINER", 1)
+        assert shrink["action"] == "shrink" and shrink["delta"] == 1
+        assert ctl.decide("PSERVER", 0)["action"] == "hold"
+    finally:
+        for hb in hbs:
+            hb.stop(bye=True)
+        reg.stop()
+
+
+# ---------------------------------------------------------------------------
+# tools/ckpt_admin.py (stdlib-only operator CLI)
+# ---------------------------------------------------------------------------
+
+def test_ckpt_admin_cli(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+    try:
+        import ckpt_admin
+    finally:
+        sys.path.pop(0)
+    root = str(tmp_path / "ck")
+    w = np.arange(8, dtype=np.float32).reshape(4, 2)
+    for s in (1, 2):
+        ckpt.commit_single(root, s, "h0", {"w": w * s},
+                           topology={"kind": "local"})
+    _piece(root, 5, "h0", {"w": w}, expected=["h0", "h1"])  # in-flight
+
+    recs = ckpt_admin.list_steps(root)
+    by_state = {}
+    for r in recs:
+        by_state.setdefault(r["state"], []).append(r["step"])
+    assert by_state == {"COMPLETE": [1, 2], "in-flight": [5]}
+    inflight = next(r for r in recs if r["state"] == "in-flight")
+    assert inflight["writers"] == ["h0"]
+    assert inflight["expected_writers"] == ["h0", "h1"]
+
+    desc = ckpt_admin.describe_step(root)          # newest by default
+    assert desc["step"] == 2 and "w" in desc["vars"]
+    assert desc["vars"]["w"]["global_shape"] == [4, 2]
+
+    out = ckpt_admin.verify_files(root, deep=True)
+    assert out["steps"] == [1, 2] and out["arrays"] == 2
+
+    # corrupt a file: verify exits nonzero naming the file
+    path = os.path.join(ckpt_store.step_dir(root, 1), "shard-h0.npz")
+    open(path, "ab").write(b"x")
+    with pytest.raises(SystemExit, match="CORRUPT"):
+        ckpt_admin.verify_files(root, step=1)
+
+    # prune via the CLI entry point (exit code contract)
+    rc = ckpt_admin.main(["prune", root, "--keep", "1", "--reap-tmp"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out.splitlines()[-1]) == {
+        "removed_steps": [1], "reaped_inflight": [5], "kept": [2]}
+    assert ckpt.complete_steps(root) == [2]
+    rc = ckpt_admin.main(["ls", root])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# live end-to-end: 2-pserver fleet -> notify cut -> commit -> reshard
+# ---------------------------------------------------------------------------
+
+def _make_transpiler(endpoints, root):
+    prog, startup, loss = build(optimizer="adam", lr=0.05)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.min_block_size = 4                 # tiny model still slices
+    cfg.checkpoint_dir = root
+    cfg.checkpoint_sharded = True
+    t = fluid.DistributeTranspiler(config=cfg)
+    t.transpile(trainer_id=0, program=prog, pservers=",".join(endpoints),
+                trainers=1, sync_mode=True, startup_program=startup)
+    return t, startup, loss
+
+
+def _shard_extents_of(t, ep):
+    for op in t.get_pserver_program(ep).global_block.ops:
+        if op.type == "listen_and_serv":
+            return op.attr("shard_extents")
+    raise AssertionError("no listen_and_serv op")
+
+
+@retry_flaky()
+def test_pserver_sharded_checkpoint_end_to_end():
+    """Train against a REAL 2-pserver fleet with sharded checkpoints,
+    cut via notify_checkpoint(step), wait for the two-phase commit,
+    then (a) full restore matches the local run's params, (b) the
+    manifest re-shards bit-identically onto 1- and 3-pserver layouts
+    (extents straight from the transpiler — the exact slices a resized
+    fleet would hydrate)."""
+    n = 6
+    endpoints = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    tmp = tempfile.mkdtemp(prefix="ckpt_e2e_")
+    root = os.path.join(tmp, "ck")
+    t, startup, loss = _make_transpiler(endpoints, root)
+    ps_progs = [(t.get_startup_program(ep), t.get_pserver_program(ep))
+                for ep in endpoints]
+    trainer_prog = t.get_trainer_program()
+
+    errors = []
+
+    def ps_thread(sp, pp, i):
+        try:
+            sc, exe = Scope(), Executor()
+            exe.run(sp, scope=sc)
+            exe.run(pp, scope=sc)
+        except Exception as e:  # pragma: no cover
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=ps_thread, args=(sp, pp, i),
+                                daemon=True)
+               for i, (sp, pp) in enumerate(ps_progs)]
+    for th in threads:
+        th.start()
+    wait_server_ready(endpoints, timeout=300)
+
+    sc, exe = Scope(), Executor()
+    exe.run(startup, scope=sc)
+    losses = []
+    for x, y in batches(n):
+        (lv,) = exe.run(trainer_prog, feed={"x": x, "y": y},
+                        fetch_list=[loss], scope=sc)
+        losses.append(float(lv))
+    notify_checkpoint(endpoints, root, step=n)
+    assert ckpt.wait_step_complete(root, n, timeout=60), \
+        (ckpt.complete_steps(root), ckpt.inflight_steps(root))
+    notify_complete(endpoints, trainer_id=0)
+    for th in threads:
+        th.join(timeout=60)
+    assert not errors, errors
+
+    local_losses, local_params = run_local(
+        n, build_fn=lambda: build(optimizer="adam", lr=0.05))
+    np.testing.assert_allclose(losses, local_losses, rtol=1e-4,
+                               atol=1e-5)
+    # (a) plain single-host restore == the local params
+    full = ckpt.load_vars(root, n)
+    for k, v in local_params.items():
+        np.testing.assert_allclose(full[k], v, rtol=1e-5, atol=1e-6)
+    man = ckpt.load_manifest(root, n)
+    assert man.topology["kind"] == "pserver"
+    assert sorted(man.writers) == ["ps0", "ps1"]
+    # (b) reshard onto 1- and 3-pserver layouts: the exact extents a
+    # resized fleet's listen_and_serv would hydrate, bit-identical
+    for m in (1, 3):
+        eps_m = [f"127.0.0.1:{p}" for p in free_ports(m)]
+        t_m, _, _ = _make_transpiler(eps_m, root)
+        for ep in eps_m:
+            ext = _shard_extents_of(t_m, ep)
+            vals = ckpt.load_locals(root, n, ext)
+            for lname, e in ext.items():
+                if e["offset"] is None:
+                    ref = full[e["var"]]
+                else:
+                    ref = full[e["var"]][e["offset"]:
+                                         e["offset"] + e["rows"]]
+                assert np.array_equal(vals[lname], ref), (m, lname)
+
+
+# ---------------------------------------------------------------------------
+# the multi-device matrix cells (subprocess: ZeRO + pp x dp x ZeRO)
+# ---------------------------------------------------------------------------
+
+def test_zero_and_composed_cells_subprocess():
+    """The reshard matrix's ZeRO (kReduce dp2) and composed pp2×dp2×ZeRO
+    cells: half-run under the sharded topology, two-phase save, restore
+    onto a PLAIN single host, finish — the stitched loss curve matches
+    the uninterrupted single-host reference at rtol 1e-4.  Subprocess:
+    needs a 2-device CPU mesh."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                        ).strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE), HERE, env.get("PYTHONPATH", "")])
+    out = subprocess.run(
+        [sys.executable, os.path.join(HERE, "ckpt_matrix_runner.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    line = next((l for l in out.stdout.splitlines()
+                 if l.startswith("CKPTMATRIX=")), None)
+    assert line, f"rc={out.returncode}\n{out.stderr[-2000:]}"
+    res = json.loads(line[len("CKPTMATRIX="):])
+    assert res["devices"] == 2
+    zero = res["zero"]
+    assert zero["committed"] and zero["topology"]["zero"] is True
+    np.testing.assert_allclose(zero["losses"], zero["ref"], rtol=1e-4)
+    comp = res["composed"]
+    assert comp["committed"]
+    assert comp["topology"]["kind"] == "pipeline"
+    assert comp["topology"]["zero"] is True
+    assert comp["topology"]["dp_mesh"] == {"dp": 2}
+    assert sorted(comp["writers"]) == ["stage0", "stage1"]
+    np.testing.assert_allclose(comp["losses"], comp["ref"], rtol=1e-4)
+    rev = res["reverse"]
+    np.testing.assert_allclose(rev["pipe_loss"], rev["plain_loss"],
+                               rtol=1e-4)

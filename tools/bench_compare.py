@@ -38,7 +38,8 @@ __all__ = ["load_round", "measured_configs", "find_baseline", "compare",
            "render_text", "main"]
 
 # headline throughput keys, in priority order; the first key present in
-# BOTH rounds' config dicts is the compared metric (higher is better)
+# BOTH rounds' config dicts is the compared metric (higher is better
+# unless listed in LOWER_BETTER_KEYS)
 METRIC_KEYS = (
     "images_per_sec",
     "tokens_per_sec",
@@ -53,7 +54,13 @@ METRIC_KEYS = (
     "cold_vs_warm_speedup",
     "eff_flops",
     "pipeline_vs_link",
+    "ckpt_overhead_frac",
 )
+
+# cost-style headlines where SMALLER is the good direction (e.g. the
+# async-snapshot step-loop overhead fraction): the delta sign flips for
+# classification, the reported delta stays raw
+LOWER_BETTER_KEYS = frozenset({"ckpt_overhead_frac"})
 
 DEFAULT_THRESHOLD = 0.10
 
@@ -170,13 +177,16 @@ def compare(old: dict, new: dict,
             why = f"baseline {_not_measured(oc)}"
         elif _not_measured(nc):
             why = f"new {_not_measured(nc)}"
+        key = ov = nv = None
         if not why:
             key, ov, nv = _headline(oc, nc)
             if key is None:
                 why = "no shared headline metric"
-            elif not ov or ov <= 0:
+            elif (not ov or ov <= 0) and key not in LOWER_BETTER_KEYS:
                 # a zero/negative baseline is a broken round, not a
-                # clean within-noise verdict — surface, don't launder
+                # clean within-noise verdict — surface, don't launder.
+                # (Lower-better FRACTIONS compare by absolute delta, so
+                # a 0.0 baseline there is legitimate — and excellent.)
                 why = f"degenerate baseline value {key}={ov!r}"
         if why:
             ent["status"] = "incomparable"
@@ -184,9 +194,17 @@ def compare(old: dict, new: dict,
             out["incomparable"].append(name)
             out["configs"][name] = ent
             continue
-        delta = (nv - ov) / ov
+        if key in LOWER_BETTER_KEYS:
+            # cost fraction: absolute delta, sign flipped so "delta
+            # below -threshold" still reads regression downstream
+            delta = -(nv - ov)
+        else:
+            delta = (nv - ov) / ov
         ent.update({"metric": key, "old": ov, "new": nv,
                     "delta": round(delta, 4)})
+        if key in LOWER_BETTER_KEYS:
+            ent["lower_better"] = True
+            ent["delta_abs"] = round(nv - ov, 4)
         analysis = _is_analysis(name, oc) or _is_analysis(name, nc)
         if analysis:
             ent["analysis"] = True

@@ -1,0 +1,254 @@
+"""Operator CLI for sharded checkpoint roots (paddle_tpu/checkpoint/).
+
+Inspect and maintain a checkpoint directory from the command line — the
+companion to ``tools/cache_admin.py`` for the training-state store:
+
+    python tools/ckpt_admin.py ls       /path/to/ckpt
+    python tools/ckpt_admin.py describe /path/to/ckpt [--step N]
+    python tools/ckpt_admin.py verify   /path/to/ckpt [--step N] [--deep]
+    python tools/ckpt_admin.py prune    /path/to/ckpt --keep 3 [--reap-tmp]
+
+``ls`` prints one line per step — COMPLETE steps (committed manifest)
+and in-flight ``_tmp`` residue (writers landed so far vs expected).
+``describe`` dumps a step's manifest summary: topology, writers, vars
+with global shapes and shard extents.  ``verify`` checks every shard
+FILE digest against the manifest (exit 1 on the first mismatch);
+``--deep`` additionally verifies every shard ARRAY digest (requires
+numpy).  ``prune`` keeps the newest N COMPLETE steps and optionally
+reaps in-flight residue.
+
+Everything except ``verify --deep`` is stdlib-only (the manifest is
+JSON, file digests are crc32): the CLI runs on any host that can see
+the checkpoint directory — a storage box with no numpy/jax included.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+import zlib
+
+__all__ = ["list_steps", "describe_step", "verify_files", "prune_root",
+           "main"]
+
+# kept in sync with paddle_tpu/checkpoint/store.py (the CLI must not
+# import paddle_tpu — stdlib-only contract)
+STEP_RE = re.compile(r"^step_(\d{8})$")
+TMP_SUBDIR = "_tmp"
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def _manifest_path(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step:08d}", MANIFEST_NAME)
+
+
+def _load_manifest(root: str, step: int) -> dict:
+    with open(_manifest_path(root, step), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _scan(root: str):
+    """(complete_steps, inflight: {step: [writers...]}) under root."""
+    complete = []
+    if os.path.isdir(root):
+        for fn in os.listdir(root):
+            m = STEP_RE.match(fn)
+            if m and os.path.isfile(os.path.join(root, fn, MANIFEST_NAME)):
+                complete.append(int(m.group(1)))
+    inflight = {}
+    tmp = os.path.join(root, TMP_SUBDIR)
+    if os.path.isdir(tmp):
+        for fn in os.listdir(tmp):
+            m = STEP_RE.match(fn)
+            if not m:
+                continue
+            writers = []
+            for p in sorted(os.listdir(os.path.join(tmp, fn))):
+                if p.startswith("manifest-") and p.endswith(".json"):
+                    writers.append(p[len("manifest-"):-len(".json")])
+            inflight[int(m.group(1))] = writers
+    return sorted(complete), inflight
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for fn in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, fn))
+            except OSError:
+                pass
+    return total
+
+
+def list_steps(root: str):
+    """One record per step (both COMPLETE and in-flight)."""
+    complete, inflight = _scan(root)
+    out = []
+    for s in complete:
+        man = _load_manifest(root, s)
+        sdir = os.path.join(root, f"step_{s:08d}")
+        out.append({
+            "step": s, "state": "COMPLETE",
+            "writers": man.get("writers", []),
+            "vars": len({sh["var"] for sh in man.get("shards", [])}),
+            "bytes": _dir_bytes(sdir),
+            "age_s": round(time.time()
+                           - os.path.getmtime(sdir), 1),
+            "topology": (man.get("topology") or {}).get("kind", "?"),
+        })
+    for s, writers in sorted(inflight.items()):
+        expected = None
+        for w in writers:
+            try:
+                with open(os.path.join(root, TMP_SUBDIR, f"step_{s:08d}",
+                                       f"manifest-{w}.json"),
+                          encoding="utf-8") as f:
+                    expected = json.load(f).get("expected_writers")
+                if expected:
+                    break
+            except (OSError, ValueError):
+                continue
+        out.append({"step": s, "state": "in-flight",
+                    "writers": writers,
+                    "expected_writers": expected})
+    return out
+
+
+def describe_step(root: str, step=None) -> dict:
+    complete, _ = _scan(root)
+    if step is None:
+        if not complete:
+            raise SystemExit(f"no COMPLETE step under {root!r}")
+        step = complete[-1]
+    if step not in complete:
+        raise SystemExit(
+            f"step {step} is not COMPLETE under {root!r} "
+            f"(complete: {complete})")
+    man = _load_manifest(root, step)
+    vars_out = {}
+    for sh in man.get("shards", []):
+        ent = vars_out.setdefault(sh["var"], {
+            "global_shape": sh["global_shape"], "dtype": sh["dtype"],
+            "shards": []})
+        ent["shards"].append(
+            {"writer": sh["writer"],
+             "rows": ("replicated" if sh["offset"] is None else
+                      [sh["offset"], sh["offset"] + sh["shape"][0]])})
+    return {"step": step, "topology": man.get("topology"),
+            "writers": man.get("writers"),
+            "files": man.get("files"), "vars": vars_out}
+
+
+def verify_files(root: str, step=None, deep: bool = False) -> dict:
+    """File-digest verification (stdlib); ``deep`` adds per-array
+    digests via numpy.  Returns a summary; raises SystemExit(1) with a
+    message naming the first corrupt file/var."""
+    complete, _ = _scan(root)
+    steps = complete if step is None else [step]
+    checked = {"steps": [], "files": 0, "arrays": 0}
+    for s in steps:
+        if s not in complete:
+            raise SystemExit(f"step {s} is not COMPLETE under {root!r}")
+        man = _load_manifest(root, s)
+        sdir = os.path.join(root, f"step_{s:08d}")
+        for fn, info in sorted((man.get("files") or {}).items()):
+            path = os.path.join(sdir, fn)
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                raise SystemExit(
+                    f"CORRUPT step {s}: cannot read {path!r}: {e}")
+            got = "crc32:%08x" % (zlib.crc32(data) & 0xFFFFFFFF)
+            if info.get("digest") and got != info["digest"]:
+                raise SystemExit(
+                    f"CORRUPT step {s}: {path!r} digest mismatch "
+                    f"(manifest {info['digest']}, file {got})")
+            checked["files"] += 1
+        if deep:
+            import numpy as np
+            by_file = {}
+            for sh in man.get("shards", []):
+                by_file.setdefault(sh["file"], []).append(sh)
+            for fn, shards in sorted(by_file.items()):
+                with np.load(os.path.join(sdir, fn)) as data:
+                    for sh in shards:
+                        arr = np.ascontiguousarray(data[sh["key"]])
+                        got = "crc32:%08x" % (
+                            zlib.crc32(arr.tobytes()) & 0xFFFFFFFF)
+                        if got != sh["digest"]:
+                            raise SystemExit(
+                                f"CORRUPT step {s}: var {sh['var']!r} "
+                                f"shard {sh['key']!r} in {fn!r} fails "
+                                "its content digest")
+                        checked["arrays"] += 1
+        checked["steps"].append(s)
+    return checked
+
+
+def prune_root(root: str, keep: int, reap_tmp: bool = False) -> dict:
+    import shutil
+    if keep < 1:
+        raise SystemExit("--keep must be >= 1")
+    complete, inflight = _scan(root)
+    doomed = complete[:-keep] if len(complete) > keep else []
+    for s in doomed:
+        shutil.rmtree(os.path.join(root, f"step_{s:08d}"),
+                      ignore_errors=True)
+    reaped = []
+    if reap_tmp:
+        for s in inflight:
+            shutil.rmtree(os.path.join(root, TMP_SUBDIR, f"step_{s:08d}"),
+                          ignore_errors=True)
+            reaped.append(s)
+    return {"removed_steps": doomed, "reaped_inflight": sorted(reaped),
+            "kept": complete[-keep:] if complete else []}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="inspect/maintain a sharded checkpoint root")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_ls = sub.add_parser("ls", help="list COMPLETE + in-flight steps")
+    p_ls.add_argument("root")
+    p_desc = sub.add_parser("describe", help="dump a step's manifest")
+    p_desc.add_argument("root")
+    p_desc.add_argument("--step", type=int, default=None)
+    p_ver = sub.add_parser("verify", help="digest-verify shard files")
+    p_ver.add_argument("root")
+    p_ver.add_argument("--step", type=int, default=None)
+    p_ver.add_argument("--deep", action="store_true",
+                       help="also verify per-array digests (needs numpy)")
+    p_pr = sub.add_parser("prune", help="keep the newest N steps")
+    p_pr.add_argument("root")
+    p_pr.add_argument("--keep", type=int, required=True)
+    p_pr.add_argument("--reap-tmp", action="store_true",
+                      help="also delete in-flight _tmp residue")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "ls":
+        for rec in list_steps(args.root):
+            print(json.dumps(rec, sort_keys=True))
+        return 0
+    if args.cmd == "describe":
+        print(json.dumps(describe_step(args.root, args.step), indent=2,
+                         sort_keys=True))
+        return 0
+    if args.cmd == "verify":
+        out = verify_files(args.root, args.step, deep=args.deep)
+        print(json.dumps({"ok": True, **out}, sort_keys=True))
+        return 0
+    if args.cmd == "prune":
+        print(json.dumps(prune_root(args.root, args.keep,
+                                    reap_tmp=args.reap_tmp),
+                         sort_keys=True))
+        return 0
+    return 2  # pragma: no cover
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,6 +104,16 @@ MODULES = [
     # gather/update entry points + the lowering peephole planner): frozen
     # so the optimizer-wiring contract drifts loudly
     "paddle_tpu.kernels.sparse",
+    # the sharded-checkpoint plane (manifest/store/reshard/snapshot/
+    # elastic) + its operator CLI: frozen so the on-disk format and the
+    # restore-planner contract drift loudly
+    "paddle_tpu.checkpoint",
+    "paddle_tpu.checkpoint.manifest",
+    "paddle_tpu.checkpoint.store",
+    "paddle_tpu.checkpoint.reshard",
+    "paddle_tpu.checkpoint.snapshot",
+    "paddle_tpu.checkpoint.elastic",
+    "ckpt_admin",   # tools/ckpt_admin.py (tools/ on sys.path here)
 ]
 
 

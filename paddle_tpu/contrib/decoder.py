@@ -29,7 +29,7 @@ from ..layer_helper import LayerHelper
 from ..layers.nn import _tile_rows
 
 __all__ = ["InitState", "StateCell", "TrainingDecoder",
-           "BeamSearchDecoder"]
+           "BeamSearchDecoder", "IncrementalBeamDecoder"]
 
 
 class _DecoderType:
@@ -359,6 +359,19 @@ class BeamSearchDecoder:
         cell = self._state_cell
         bw = self._beam_size
 
+        # materialize the loop-carried [beam, ...] states in the PARENT
+        # block BEFORE entering the While: StateCell switches lazily on
+        # the first get_state(), which used to happen inside the loop
+        # body — so the carried vars (and their assign-from-init) were
+        # created in the SUB-block, never qualified as loop carries,
+        # and re-initialized every iteration: beam states silently
+        # froze at their init values (decode degenerated to
+        # conditioning on the last token only).  Pinned by the
+        # incremental-vs-whole-sequence exactness test in
+        # tests/test_contrib_decoder.py.
+        if not cell._switched_decoder:
+            cell._switch_decoder()
+
         pre_ids = L.assign(self._init_ids)
         pre_scores = L.assign(self._init_scores)
         ids_arr = L.create_array("int64", [bw], max_len=self._max_len)
@@ -429,3 +442,158 @@ class BeamSearchDecoder:
     def result(self):
         """The full BeamDecodeResult (ids/scores/cand_len/src_len)."""
         return self._decode_result
+
+
+class IncrementalBeamDecoder:
+    """Beam search one decode step at a time — the decode plane's
+    incremental twin of :class:`BeamSearchDecoder`.
+
+    Where ``BeamSearchDecoder.decode()`` compiles the whole beam loop
+    into ONE While program, this class carries the beam state
+    (``pre_ids`` / ``pre_scores`` / the per-step selections) ACROSS
+    executor dispatches, so a serving loop can interleave beam steps of
+    many requests (token-level continuous batching) and stream partial
+    hypotheses.  Exactness contract: each :meth:`step` runs the same op
+    chain the While body compiles (``log`` → ``elementwise_add`` →
+    ``beam_search``) as a one-step program, and :meth:`finalize` runs
+    the same ``beam_search_decode`` backtrack op over the stacked
+    per-step selections — so after ``max_len`` steps the result is
+    bit-identical to the whole-sequence decoder's (pinned by
+    tests/test_contrib_decoder.py on the machine-translation model).
+
+    The caller owns the model half of each step (embed the previous
+    ids, run the cell, score, top-k — exactly what it would put inside
+    ``decoder.block()``) and must gather its carried states by the
+    returned ``parent`` pointers, the role the whole-sequence decoder's
+    in-loop ``L.gather`` plays.
+    """
+
+    def __init__(self, beam_size: int, end_id: int, topk_size: int,
+                 executor=None):
+        from ..core.executor import Executor, Scope
+        from ..core.program import Program, program_guard
+        from ..core import unique_name
+
+        self.beam_size = int(beam_size)
+        self.end_id = int(end_id)
+        self.topk_size = int(topk_size)
+        self._exe = executor if executor is not None \
+            else Executor(training=False)
+        self._scope = Scope()
+        self._ids = []       # per-step selected ids     [bw]
+        self._parents = []   # per-step parent pointers  [bw]
+        self._scores = []    # per-step selected scores  [bw]
+        self.pre_ids = None      # [bw, 1] int64
+        self.pre_scores = None   # [bw, 1] float32
+        # the one-step program: the While body's scoring-to-selection
+        # tail (log + add + beam_search), compiled once, hit thereafter
+        self._step_prog = Program()
+        with program_guard(self._step_prog, Program()), \
+                unique_name.guard():
+            from .. import layers as L
+            pre_ids = L.data("ibd_pre_ids", [1], dtype="int64")
+            pre_scores = L.data("ibd_pre_scores", [1])
+            cand_ids = L.data("ibd_cand_ids", [self.topk_size],
+                              dtype="int64")
+            cand_probs = L.data("ibd_cand_probs", [self.topk_size])
+            acc = L.elementwise_add(L.log(cand_probs), pre_scores)
+            sel_ids, sel_scores, parent = L.beam_search(
+                pre_ids, pre_scores, cand_ids, acc,
+                beam_size=self.beam_size, end_id=self.end_id)
+            self._step_fetches = [sel_ids.name, sel_scores.name,
+                                  parent.name]
+
+    def start(self, init_ids=None, init_scores=None) -> None:
+        """Seed the beam (the ``init_ids``/``init_scores`` contract of
+        BeamSearchDecoder: zeros, and 0 / -1e9 scores so identical
+        initial beams don't multiply)."""
+        import numpy as np
+        bw = self.beam_size
+        self.pre_ids = (np.zeros((bw, 1), "int64") if init_ids is None
+                        else np.asarray(init_ids, "int64").reshape(bw, 1))
+        if init_scores is None:
+            init_scores = [[0.0]] + [[-1e9]] * (bw - 1)
+        self.pre_scores = np.asarray(init_scores,
+                                     "float32").reshape(bw, 1)
+        self._ids, self._parents, self._scores = [], [], []
+
+    def step(self, cand_ids, cand_probs):
+        """Advance one beam step.  ``cand_ids``/``cand_probs``:
+        [beam, topk_size] top-k tokens and their (softmax) probabilities
+        from the caller's cell+scoring dispatch.  Returns ``(sel_ids
+        [bw, 1], parent [bw])`` — gather every carried model state by
+        ``parent`` before computing the next step's candidates."""
+        import numpy as np
+        if self.pre_ids is None:
+            self.start()
+        bw = self.beam_size
+        feed = {"ibd_pre_ids": self.pre_ids,
+                "ibd_pre_scores": self.pre_scores,
+                "ibd_cand_ids": np.asarray(cand_ids,
+                                           "int64").reshape(bw, -1),
+                "ibd_cand_probs": np.asarray(cand_probs,
+                                             "float32").reshape(bw, -1)}
+        sel_ids, sel_scores, parent = self._exe.run(
+            self._step_prog, feed=feed, fetch_list=self._step_fetches,
+            scope=self._scope, sync=True)
+        sel_ids = np.asarray(sel_ids).reshape(bw, 1)
+        sel_scores = np.asarray(sel_scores).reshape(bw, 1)
+        parent = np.asarray(parent).reshape(bw)
+        self._ids.append(sel_ids[:, 0].copy())
+        self._parents.append(parent.copy())
+        self._scores.append(sel_scores[:, 0].copy())
+        self.pre_ids, self.pre_scores = sel_ids, sel_scores
+        return sel_ids, parent
+
+    @property
+    def steps(self) -> int:
+        return len(self._ids)
+
+    def finalize(self):
+        """Backtrack the accumulated selections through the SAME
+        ``beam_search_decode`` op the whole-sequence decoder ends with;
+        returns a numpy ``BeamDecodeResult`` (ids [bw, T], scores,
+        cand_len [bw], src_len [1])."""
+        import numpy as np
+        from ..core.program import Program, program_guard
+        from ..core import unique_name
+        from ..layer_helper import LayerHelper
+        from ..layers.control_flow import BeamDecodeResult
+        from .. import layers as L
+
+        if not self._ids:
+            raise ValueError("finalize() before any step()")
+        bw, t = self.beam_size, len(self._ids)
+        prog = Program()
+        with program_guard(prog, Program()), unique_name.guard():
+            ids_v = L.data("ibd_arr_ids", [bw], dtype="int64")
+            par_v = L.data("ibd_arr_parents", [bw], dtype="int64")
+            sc_v = L.data("ibd_arr_scores", [bw])
+            len_v = L.data("ibd_arr_len", [1], dtype="int64",
+                           append_batch_size=False)
+            helper = LayerHelper("beam_search_decode")
+            sents = helper.create_variable_for_type_inference(
+                "int64", shape=(bw, t))
+            cand_len = helper.create_variable_for_type_inference(
+                "int64", shape=(bw,), stop_gradient=True)
+            src_len = helper.create_variable_for_type_inference(
+                "int64", shape=(1,), stop_gradient=True)
+            scores = helper.create_variable_for_type_inference(
+                "float32", shape=(bw, t))
+            helper.append_op(
+                "beam_search_decode",
+                {"Ids": [ids_v], "Parents": [par_v], "Scores": [sc_v],
+                 "ArrayLen": [len_v]},
+                {"SentenceIds": [sents], "SentenceLen": [cand_len],
+                 "SourceLen": [src_len], "SentenceScores": [scores]},
+                {"end_id": self.end_id, "beam_size": self.beam_size})
+            fetches = [sents.name, scores.name, cand_len.name,
+                       src_len.name]
+        feed = {"ibd_arr_ids": np.stack(self._ids),
+                "ibd_arr_parents": np.stack(self._parents),
+                "ibd_arr_scores": np.stack(self._scores),
+                "ibd_arr_len": np.asarray([t], "int64")}
+        out = self._exe.run(prog, feed=feed, fetch_list=fetches,
+                            scope=self._scope, sync=True)
+        ids, scores, cand_len, src_len = (np.asarray(v) for v in out)
+        return BeamDecodeResult(ids, scores, cand_len, src_len)

@@ -724,6 +724,82 @@ class Executor:
                 batch=_obs_runlog.batch_of(feed_vals))
         return out
 
+    def run_callable(self, key: str, build_fn, feed: Sequence,
+                     state: Sequence = (), const: Sequence = ()):
+        """Dispatch a pure JAX callable through THIS executor's
+        executable cache — the decode plane's entry point, and the
+        general mechanism for cache-resident device state across
+        dispatches.
+
+        ``build_fn()`` returns ``fn(feed, state, const) -> (outs,
+        new_state)`` (lists in, lists out).  The compiled executable is
+        cached per ``(key, feed/state/const shape-dtype signature)`` in
+        the SAME cache as program runs, and counts against the same
+        ``executor.*`` telemetry (cache_hits / cache_misses /
+        shape_recompiles / steps / run_wall_ms) — so a serving plane
+        can pin "zero recompiles under mixed traffic" for callable
+        dispatches exactly as it does for program dispatches.
+
+        ``state`` buffers are DONATED: they stay device-resident and
+        update in place in HBM across dispatches (a paged KV cache
+        never round-trips to host); the caller must carry the returned
+        ``new_state`` handles forward — the old ones are consumed.
+        ``const`` values (model params) are neither donated nor copied.
+        No persistent-cache tier: a callable has no canonical program
+        fingerprint to key a disk entry by.
+
+        Returns ``(outs, new_state)`` as device arrays (wrap in
+        ``np.asarray`` to materialize)."""
+        feed = [v if isinstance(v, jax.Array) else jnp.asarray(v)
+                for v in feed]
+        state = list(state)
+        const = list(const)
+        tel = _obs_trace.flags_on()
+        t_run0 = time.perf_counter_ns() if tel else None
+        sig = (self._feed_sig([str(i) for i in range(len(feed))], feed)
+               + self._feed_sig([f"s{i}" for i in range(len(state))], state)
+               + self._feed_sig([f"c{i}" for i in range(len(const))], const))
+        base = ("callable", key, self._training)
+        mem_key = ("callable", key, sig, self._training)
+        entry = self._cache.get(mem_key)
+        cache_hit = entry is not None
+        lowering_ms = 0.0
+        if entry is None:
+            t_low0 = time.perf_counter_ns()
+            jitted = jax.jit(build_fn(), donate_argnums=(1,))
+            lowering_ms = (time.perf_counter_ns() - t_low0) / 1e6
+            entry = _CacheEntry(None, jitted)
+            self._cache[mem_key] = entry
+            self._evict_cache_overflow()
+            if tel:
+                self._note_cache_miss(base, sig)
+        elif tel:
+            _em().hits.inc()
+        compile_ms = 0.0
+        t_disp0 = time.perf_counter_ns() if tel else None
+        with _obs_trace.start_span("executor::dispatch", cat="executor",
+                                   root=False):
+            outs, new_state = entry.jitted(feed, state, const)
+        if tel:
+            t_disp1 = time.perf_counter_ns()
+            if not cache_hit:
+                # first call of a fresh executable: the synchronous part
+                # is jax trace + XLA compile (execution is async)
+                compile_ms = (t_disp1 - t_disp0) / 1e6
+            m = _em()
+            m.steps.inc()
+            wall_ms = (time.perf_counter_ns() - t_run0) / 1e6
+            m.wall.observe(wall_ms)
+            _obs_step.record(_obs_step.StepStats(
+                program_key=f"callable:{key}",
+                cache_hit=cache_hit,
+                lowering_ms=round(lowering_ms, 3),
+                compile_ms=round(compile_ms, 3),
+                feed_bytes=sum(_obs_step.approx_nbytes(v) for v in feed),
+                fetch_bytes=sum(_obs_step.approx_nbytes(v) for v in outs),
+                wall_ms=round(wall_ms, 3)))
+        return outs, new_state
+
     def run_steps(
         self,
         program: Optional[Program] = None,

@@ -284,6 +284,32 @@ define_flag("serving_queue_delay_slo_ms", 0.0,
             "request cannot be answered within this many ms, it is shed "
             "with a typed Overloaded reply.  0 (default) disables the "
             "estimate — only the serving_max_queue_rows bound sheds")
+define_flag("decode_block_tokens", 16,
+            "paged-KV-cache block size in TOKENS for the autoregressive "
+            "decode plane (paddle_tpu/decode): per-request key/value "
+            "state lives in fixed-size device blocks drawn from a "
+            "preallocated pool, so admission/eviction moves block-table "
+            "ENTRIES, never compiled shapes.  Latched when a "
+            "DecodeEngine is built")
+define_flag("decode_max_slots", 8,
+            "decode-batch width of the continuous-batching decode step "
+            "(paddle_tpu/decode/engine.py): requests join and leave a "
+            "running batch of this many slots at token granularity; the "
+            "slot count is a compiled shape, so it is fixed per engine "
+            "(inactive slots ride along masked into the reserved trash "
+            "block)")
+define_flag("decode_prefill_buckets", "16,32,64,128",
+            "prompt-length bucket ladder for the decode plane's split "
+            "prefill dispatch (the serving_buckets discipline applied "
+            "to the TIME axis): a joining prompt pads to the smallest "
+            "bucket that fits, so a handful of prefill executables "
+            "cover all prompt lengths and a long new prompt never "
+            "recompiles (or stalls) the running decode step")
+define_flag("decode_max_queue", 64,
+            "admission-control bound on a decode engine's pending "
+            "request queue: past it, new generation requests are shed "
+            "with the serving plane's typed Overloaded reply (counted "
+            "in decode.shed) instead of queueing into timeout")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

@@ -1,0 +1,63 @@
+"""Autoregressive decode plane: stateful generative serving.
+
+The PR-8 serving plane (:mod:`paddle_tpu.serving`) does one-shot
+fixed-shape inference; generative traffic — the transformer / LSTM
+token-by-token story (survey §2.9 inference subsystem + the level-2
+``beam_search_decode`` machinery the reference ships in
+``contrib/decoder.py``) — needs per-request state that survives across
+dispatches.  Without a KV cache every generated token re-prefills the
+whole prefix, so latency scales quadratically in output length.  This
+package is that state plane, built on the repo's own primitives:
+
+- **Paged KV cache** (:mod:`cache`): per-request key/value state lives
+  in device memory as fixed-size blocks (``FLAGS_decode_block_tokens``)
+  drawn from a preallocated pool; a request holds a block TABLE, so
+  admission/eviction moves table entries and never changes a compiled
+  shape.  The cache arrays ride
+  :meth:`~paddle_tpu.core.executor.Executor.run_callable` as donated
+  cache-resident state — they update in place in HBM and never
+  round-trip to host.
+- **Token-level continuous batching** (:mod:`engine`): requests join
+  and leave a running decode batch at token granularity — the serving
+  batcher's bucket-ladder discipline applied to the TIME axis.
+  Prefill dispatches are SPLIT from the decode step (their own
+  prompt-length bucket ladder, ``FLAGS_decode_prefill_buckets``), so a
+  long new prompt never stalls in-flight streams.
+- **Pallas decode-attention kernel**
+  (:func:`paddle_tpu.kernels.attention.decode_attention`): one query
+  token per slot against its gathered block list via scalar-prefetch
+  block tables, with a counted XLA-gather fallback and interpret-mode
+  CPU coverage (the ``kernels/sparse.py`` contract).
+- **On-device sampling** (:mod:`model`): greedy / top-k / temperature
+  inside the decode dispatch; incremental beam search rides
+  :class:`paddle_tpu.contrib.decoder.IncrementalBeamDecoder` (the
+  reference beam machinery, one ``beam_search`` step per decode step).
+- **Streaming serving** (:mod:`server` / :mod:`client`): tokens stream
+  to clients over a new framed ``DECODE`` msg type on the existing
+  zero-copy transport (multi-frame replies — the transport's STREAM
+  handler contract), with per-model replica announce/health riding the
+  PR-8 registry path and ``decode.*`` counters + ``/decodez`` on the
+  observability plane.
+
+Nothing here is imported by the core framework: a process that never
+builds an engine gets no new arrays, threads, or sockets.
+"""
+from __future__ import annotations
+
+from .cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .model import (LMConfig, TransformerLM, load_lm,  # noqa: F401
+                    save_lm)
+from .engine import (DecodeEngine, DecodeHandle,  # noqa: F401
+                     DecodeRequest, SamplingParams)
+from .server import DecodeServer, DecodeService  # noqa: F401
+from .client import DecodeClient  # noqa: F401
+from ..contrib.decoder import IncrementalBeamDecoder  # noqa: F401
+from ..serving.batcher import Overloaded, RequestTooLong  # noqa: F401
+
+__all__ = [
+    "BlockAllocator", "PagedKVCache",
+    "LMConfig", "TransformerLM", "save_lm", "load_lm",
+    "DecodeEngine", "DecodeHandle", "DecodeRequest", "SamplingParams",
+    "DecodeServer", "DecodeService", "DecodeClient",
+    "IncrementalBeamDecoder", "Overloaded", "RequestTooLong",
+]

@@ -1,0 +1,110 @@
+"""Paged KV cache: fixed-size device blocks + a host-side allocator.
+
+The device half (:class:`PagedKVCache`) is two preallocated arrays
+``[num_layers, num_blocks, block_tokens, n_head, head_dim]`` (keys and
+values) that ride :meth:`Executor.run_callable` as donated state —
+every prefill/decode dispatch consumes the old buffers and returns the
+updated ones, so the cache is resident in device memory for the
+engine's whole life and no dispatch ever copies it to host.
+
+The host half (:class:`BlockAllocator`) is a free list over block ids.
+Block 0 is RESERVED as the trash block: padded prompt positions and
+inactive decode slots write their (garbage) K/V there, which keeps
+every dispatch a fixed-shape scatter with no branching — the price of
+one wasted block buys shape-stable admission/eviction (the whole point
+of paging: a request joining or leaving moves block-table entries,
+never compiled shapes).
+
+Sizing: a request admitted with prompt length P and output budget M
+reserves ``ceil((P + M) / block_tokens)`` blocks up front — admission
+is the only point that can fail for lack of memory; a running stream
+can never hit cache OOM mid-generation.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core import flags as _flags
+
+
+def blocks_for(tokens: int, block_tokens: int) -> int:
+    """Blocks needed to hold ``tokens`` tokens."""
+    return max(1, -(-int(tokens) // int(block_tokens)))
+
+
+class BlockAllocator:
+    """Free-list allocator over cache block ids 1..num_blocks-1
+    (block 0 is the reserved trash block — module doc)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 usable + trash), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(1, self.num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or None (caller queues) when short — never a
+        partial grant."""
+        if n > len(self._free):
+            return None
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def release(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 1 <= b < self.num_blocks:
+                raise ValueError(f"bad block id {b}")
+        self._free.extend(blocks)
+
+
+class PagedKVCache:
+    """The device arrays (module doc).  ``state()`` hands the [k, v]
+    list to ``Executor.run_callable``; ``update()`` swaps in the
+    returned (donated-in-place) handles."""
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_blocks: int, block_tokens: Optional[int] = None,
+                 dtype="float32"):
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(
+            _flags.get_flags("decode_block_tokens")
+            if block_tokens is None else block_tokens)
+        if self.block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got "
+                             f"{self.block_tokens}")
+        shape = (self.num_layers, self.num_blocks, self.block_tokens,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.allocator = BlockAllocator(self.num_blocks)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.size) * self.k.dtype.itemsize * 2
+
+    def state(self) -> list:
+        return [self.k, self.v]
+
+    def update(self, new_state: list) -> None:
+        self.k, self.v = new_state
+
+    def max_context(self, max_blocks_per_seq: int) -> int:
+        return max_blocks_per_seq * self.block_tokens
+
+    def snapshot(self) -> dict:
+        return {
+            "num_blocks": self.num_blocks,
+            "block_tokens": self.block_tokens,
+            "free_blocks": self.allocator.free_blocks,
+            "bytes": self.nbytes,
+        }

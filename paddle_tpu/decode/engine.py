@@ -1,0 +1,610 @@
+"""DecodeEngine: token-level continuous batching over the paged cache.
+
+The decode-plane hot loop.  One scheduler thread drives two kinds of
+dispatch against one :class:`~paddle_tpu.core.executor.Executor`:
+
+- **prefill** — one dispatch per JOINING request, prompt padded to the
+  smallest bucket on the prefill ladder (``FLAGS_decode_prefill_buckets``
+  — the serving batcher's bucket discipline applied to the time axis).
+  It writes the prompt's K/V into the request's cache blocks and samples
+  the first token, so a joining stream emits immediately.  Prefill is a
+  SEPARATE executable from the decode step: a long new prompt costs the
+  in-flight streams exactly one prefill dispatch of latency, never a
+  recompile or a batch-shape change.
+- **decode step** — ONE dispatch advances every active slot by one
+  token: fixed ``[max_slots]`` shapes, inactive slots ride along into
+  the reserved trash block.  Requests join (slot assigned at admission)
+  and leave (slot freed the moment eos/length finishes it) at token
+  granularity — the running batch never drains to reshape.
+
+Both dispatches ride ``Executor.run_callable`` with the cache arrays as
+donated cache-resident state, so the executor's compile counters cover
+the decode plane: after the ladder + step are warm, a mixed join/leave
+load of varying prompt and output lengths is ZERO compiles — the
+acceptance pin.
+
+Admission control (the batcher discipline): a bounded pending queue
+(``FLAGS_decode_max_queue``) sheds with the serving plane's typed
+:class:`Overloaded`; an over-budget prompt/output (off the ladder, or
+past the block-table context bound) is a typed
+:class:`RequestTooLong`.  Block reservation happens at admission —
+``ceil((prompt+max_new)/block_tokens)`` blocks up front — so a running
+stream can never hit cache OOM mid-generation.
+
+Observability: ``decode.<name>.*`` counters/gauges/histograms plus the
+``/decodez`` debug page (:func:`DecodeEngine.decodez`).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .cache import PagedKVCache, blocks_for
+from .model import TransformerLM
+from ..core import flags as _flags
+from ..core.executor import Executor
+from ..observability import debug_server as _debug_server
+from ..observability import stats as _obs_stats
+from ..serving.batcher import BucketLadder, Overloaded, RequestTooLong
+
+
+class SamplingParams:
+    """Per-request sampling config.  ``temperature <= 0`` is greedy;
+    ``top_k == 0`` samples the full vocab (under the compiled
+    ``TOPK_MAX`` ceiling)."""
+
+    def __init__(self, temperature: float = 0.0, top_k: int = 0,
+                 max_new_tokens: int = 32, eos_id: Optional[int] = None,
+                 seed: int = 0):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.seed = int(seed)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+    def to_dict(self) -> dict:
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "max_new_tokens": self.max_new_tokens,
+                "eos_id": self.eos_id, "seed": self.seed}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SamplingParams":
+        return cls(temperature=d.get("temperature", 0.0),
+                   top_k=d.get("top_k", 0),
+                   max_new_tokens=d.get("max_new_tokens", 32),
+                   eos_id=d.get("eos_id"), seed=d.get("seed", 0) or 0)
+
+
+class DecodeRequest:
+    __slots__ = ("rid", "prompt", "sampling", "t_enq", "handle")
+
+    def __init__(self, rid: int, prompt: np.ndarray,
+                 sampling: SamplingParams):
+        self.rid = rid
+        self.prompt = prompt
+        self.sampling = sampling
+        self.t_enq = time.monotonic()
+        self.handle = DecodeHandle(rid)
+
+
+class DecodeHandle:
+    """Client-side view of one generation: iterate for the token
+    stream, or :meth:`result` for the aggregate."""
+
+    _DONE = object()
+
+    def __init__(self, rid: int):
+        self.rid = rid
+        self._q: "queue.Queue" = queue.Queue()
+        self._tokens: List[int] = []
+        self._logits: List[np.ndarray] = []   # capture_logits engines only
+        self._final: Optional[dict] = None
+        self._err: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+
+    # -- engine side -------------------------------------------------------
+    def _emit(self, token: int, logits: Optional[np.ndarray]) -> None:
+        self._tokens.append(int(token))
+        if logits is not None:
+            self._logits.append(logits)
+        self._q.put(int(token))
+
+    def _finish(self, reason: str) -> None:
+        self._final = {"tokens": list(self._tokens), "finish": reason,
+                       "n_tokens": len(self._tokens)}
+        self._done.set()
+        self._q.put(self._DONE)
+
+    def _fail(self, exc: BaseException) -> None:
+        self._err = exc
+        self._done.set()
+        self._q.put(self._DONE)
+
+    # -- client side -------------------------------------------------------
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item
+
+    def next_token(self, timeout: Optional[float] = None):
+        """One token id, or None when the stream is finished; raises
+        TimeoutError if the engine produces nothing for ``timeout``
+        seconds (the streaming server's bounded wait — a wedged engine
+        must surface as a typed error frame, not a parked connection)."""
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"decode request {self.rid}: no token within {timeout}s")
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            return None
+        return item
+
+    def cancel(self) -> None:
+        """Abandon the generation: the engine retires the request's
+        slot (freeing its cache blocks) at the next step boundary, or
+        drops it from the pending queue at the next admission sweep.
+        No-op once the stream already finished.  Called by the
+        streaming server when a client disconnects mid-stream — a
+        vanished reader must not keep generating into the void."""
+        if not self._done.is_set():
+            self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(f"decode request {self.rid} still running")
+        if self._err is not None:
+            raise self._err
+        return dict(self._final)
+
+    @property
+    def tokens(self) -> List[int]:
+        return list(self._tokens)
+
+    @property
+    def logits(self) -> List[np.ndarray]:
+        return list(self._logits)
+
+
+class _Slot:
+    __slots__ = ("req", "blocks", "pos_next", "n_generated", "last_token",
+                 "t_last")
+
+    def __init__(self, req: DecodeRequest, blocks: List[int],
+                 prompt_len: int, first_token: int):
+        self.req = req
+        self.blocks = blocks
+        self.pos_next = prompt_len   # where the last sampled token's
+        self.n_generated = 1         # K/V lands on the next step
+        self.last_token = first_token
+        self.t_last = time.monotonic()
+
+
+class _EngineStats:
+    def __init__(self, name: str):
+        sc = _obs_stats.scope(f"decode.{name}")
+        self.tokens = sc.counter("tokens", "generated tokens (all streams)")
+        self.prefills = sc.counter("prefills")
+        self.joins = sc.counter(
+            "joins", "requests admitted into the running decode batch")
+        self.leaves = sc.counter(
+            "leaves", "requests retired from the running batch (eos/length)")
+        self.shed = sc.counter(
+            "shed", "requests refused by admission control (typed "
+            "Overloaded/RequestTooLong)")
+        self.steps = sc.counter("steps", "decode-step dispatches")
+        self.queue = sc.gauge("queue_depth")
+        self.active = sc.gauge("slots_active")
+        self.blocks_free = sc.gauge("blocks_free")
+        self.step_ms = sc.histogram("step_ms")
+        self.prefill_ms = sc.histogram("prefill_ms")
+        self.token_ms = sc.histogram(
+            "token_ms",
+            help_str="per-stream inter-token interval (what a client "
+                     "perceives as per-token latency)")
+
+
+class DecodeEngine:
+    """One model's stateful generative scheduler (module doc)."""
+
+    def __init__(self, model: TransformerLM, params: Dict,
+                 name: str = "lm",
+                 max_slots: Optional[int] = None,
+                 block_tokens: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefill_buckets=None,
+                 max_queue: Optional[int] = None,
+                 executor: Optional[Executor] = None,
+                 capture_logits: bool = False,
+                 attn_impl: Optional[str] = None,
+                 cache_dtype: str = "float32"):
+        self.model = model
+        self.name = name
+        cfg = model.config
+        self.max_slots = int(_flags.get_flags("decode_max_slots")
+                             if max_slots is None else max_slots)
+        self.max_queue = int(_flags.get_flags("decode_max_queue")
+                             if max_queue is None else max_queue)
+        bs = int(_flags.get_flags("decode_block_tokens")
+                 if block_tokens is None else block_tokens)
+        # block TABLE width: enough blocks per slot for a full-length
+        # context — a compiled shape, so it derives from max_seq_len
+        self.max_blocks_per_seq = blocks_for(cfg.max_seq_len, bs)
+        if num_blocks is None:
+            num_blocks = 1 + self.max_slots * self.max_blocks_per_seq
+        self.cache = PagedKVCache(cfg.n_layer, cfg.n_head, cfg.head_dim,
+                                  num_blocks, bs, dtype=cache_dtype)
+        ladder = (prefill_buckets if prefill_buckets is not None
+                  else BucketLadder.parse(
+                      _flags.get_flags("decode_prefill_buckets")))
+        sizes = sorted({int(b) for b in
+                        (ladder.sizes if isinstance(ladder, BucketLadder)
+                         else ladder) if int(b) <= cfg.max_seq_len})
+        if not sizes:
+            sizes = [cfg.max_seq_len]
+        self.prefill_ladder = BucketLadder(sizes)
+        self.capture_logits = capture_logits
+        self._attn_impl = attn_impl
+        self._exe = executor if executor is not None \
+            else Executor(training=False)
+        self._plist = model.param_list(params)
+        self.stats = _EngineStats(name)
+
+        self._lock = threading.Condition()
+        self._pending: List[DecodeRequest] = []
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        # decode-step feed rows (host mirrors of the fixed-shape feeds)
+        self._tables = np.zeros((self.max_slots, self.max_blocks_per_seq),
+                                np.int32)
+        self._rid = itertools.count(1)
+        self._closed = False
+        _debug_server.register_decodez(name, self.decodez)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"decode-sched-{name}")
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+    def max_context(self) -> int:
+        return min(self.model.config.max_seq_len,
+                   self.cache.max_context(self.max_blocks_per_seq))
+
+    def submit(self, prompt, sampling: Optional[SamplingParams] = None
+               ) -> DecodeHandle:
+        """Enqueue one generation.  Raises :class:`RequestTooLong`
+        (prompt off the prefill ladder or prompt+budget past the
+        context bound) or :class:`Overloaded` (queue bound) — both
+        typed, never queued."""
+        sampling = sampling or SamplingParams()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        limit = self.max_context()
+        if prompt.size > self.prefill_ladder.max:
+            self.stats.shed.inc()
+            raise RequestTooLong(self.name, "prompt", prompt.size,
+                                 self.prefill_ladder.max)
+        if prompt.size + sampling.max_new_tokens > limit:
+            self.stats.shed.inc()
+            raise RequestTooLong(
+                self.name, "prompt+max_new_tokens",
+                prompt.size + sampling.max_new_tokens, limit)
+        need = blocks_for(prompt.size + sampling.max_new_tokens,
+                          self.cache.block_tokens)
+        if need > self.cache.num_blocks - 1:
+            # could never be admitted even with the pool idle — typed
+            # rejection now, not a head-of-line livelock later
+            self.stats.shed.inc()
+            raise RequestTooLong(
+                self.name, "blocks",
+                need * self.cache.block_tokens,
+                (self.cache.num_blocks - 1) * self.cache.block_tokens)
+        req = DecodeRequest(next(self._rid), prompt, sampling)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(f"decode engine {self.name!r} is closed")
+            if len(self._pending) >= self.max_queue:
+                self.stats.shed.inc()
+                raise Overloaded(self.name, len(self._pending),
+                                 self.max_queue)
+            self._pending.append(req)
+            self.stats.queue.set(len(self._pending))
+            self._lock.notify_all()
+        return req.handle
+
+    def generate(self, prompt, timeout: Optional[float] = 120.0,
+                 **sampling_kw) -> dict:
+        """Blocking convenience over :meth:`submit`."""
+        return self.submit(
+            prompt, SamplingParams(**sampling_kw)).result(timeout=timeout)
+
+    # -- scheduler loop ----------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._closed and not self._pending and \
+                        not any(self._slots):
+                    self._lock.wait()
+                if self._closed:
+                    pending = self._pending
+                    self._pending = []
+                    break_slots = [s for s in self._slots if s is not None]
+                    break
+                admit = self._admissible_locked()
+            for req in admit:
+                try:
+                    self._prefill(req)
+                except Exception as e:   # noqa: BLE001 — fail ONE stream
+                    self._release(req, None, error=e)
+            if any(s is not None for s in self._slots):
+                try:
+                    self._decode_step()
+                except Exception as e:   # noqa: BLE001
+                    self._fail_all(e)
+        for req in pending:
+            req.handle._fail(RuntimeError("decode engine closed"))
+        for slot in break_slots:
+            slot.req.handle._fail(RuntimeError("decode engine closed"))
+
+    def _admissible_locked(self) -> List[DecodeRequest]:
+        """Pop every pending request that has a free slot AND a full
+        block reservation right now (called under the lock)."""
+        out = []
+        # cancelled-before-admission requests drop from the queue head
+        # (a vanished client must not hold a queue slot); they never
+        # joined, so they count neither join nor leave
+        while self._pending and self._pending[0].handle.cancelled:
+            self._pending.pop(0).handle._finish("cancelled")
+        for i, slot in enumerate(self._slots):
+            if slot is not None or not self._pending:
+                continue
+            req = self._pending[0]
+            need = blocks_for(
+                req.prompt.size + req.sampling.max_new_tokens,
+                self.cache.block_tokens)
+            blocks = self.cache.allocator.alloc(need)
+            if blocks is None:
+                break   # head-of-line waits for blocks; keep FIFO order
+            self._pending.pop(0)
+            # the slot is claimed NOW (table row filled) so a later
+            # admission in the same sweep can't take it
+            row = self._tables[i]
+            row[:] = 0
+            row[:len(blocks)] = blocks
+            self._slots[i] = _Slot(req, blocks, req.prompt.size,
+                                   first_token=-1)   # token set by prefill
+            self.stats.joins.inc()   # every join has a matching leave
+            out.append(req)          # through _retire
+        self.stats.queue.set(len(self._pending))
+        self.stats.blocks_free.set(self.cache.allocator.free_blocks)
+        self.stats.active.set(sum(s is not None for s in self._slots))
+        return out
+
+    def _slot_of(self, req: DecodeRequest):
+        for i, s in enumerate(self._slots):
+            if s is not None and s.req is req:
+                return i, s
+        raise KeyError(f"request {req.rid} has no slot")
+
+    # -- dispatches --------------------------------------------------------
+    def _prefill(self, req: DecodeRequest) -> None:
+        t0 = time.perf_counter()
+        i, slot = self._slot_of(req)
+        if req.handle.cancelled:   # client vanished between admit and here
+            self._retire(i, slot, "cancelled")
+            return
+        P = req.prompt.size
+        bucket = self.prefill_ladder.snap(P)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :P] = req.prompt
+        model = self.model
+
+        def build():
+            def fn(feed, state, const):
+                kc, vc, tok, logits = model.prefill(
+                    const, state[0], state[1], *feed)
+                return [tok, logits], [kc, vc]
+            return fn
+
+        feed = [tokens,
+                np.int32(P),
+                self._tables[i].copy(),
+                np.uint32(req.sampling.seed & 0xFFFFFFFF),
+                np.float32(req.sampling.temperature),
+                np.int32(req.sampling.top_k)]
+        (tok, logits), new_state = self._exe.run_callable(
+            f"decode/{self.name}/prefill/{bucket}", build, feed,
+            state=self.cache.state(), const=self._plist)
+        self.cache.update(new_state)
+        first = int(np.asarray(tok))
+        slot.last_token = first
+        slot.t_last = time.monotonic()
+        self.stats.prefills.inc()
+        self.stats.tokens.inc()
+        self.stats.prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        req.handle._emit(
+            first, np.asarray(logits) if self.capture_logits else None)
+        self._maybe_finish(i, slot, first)
+
+    def _decode_step(self) -> None:
+        t0 = time.perf_counter()
+        # retire cancelled slots FIRST: their blocks free before this
+        # step's admission sweep ran, and they must not burn a batch
+        # lane generating for a vanished reader
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.req.handle.cancelled:
+                self._retire(i, slot, "cancelled")
+        tokens = np.zeros((self.max_slots,), np.int32)
+        positions = np.zeros((self.max_slots,), np.int32)
+        seeds = np.zeros((self.max_slots,), np.uint32)
+        steps = np.zeros((self.max_slots,), np.int32)
+        temps = np.zeros((self.max_slots,), np.float32)
+        topks = np.zeros((self.max_slots,), np.int32)
+        tables = self._tables.copy()
+        live = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                tables[i, :] = 0   # trash block: masked garbage
+                continue
+            live.append(i)
+            tokens[i] = slot.last_token
+            positions[i] = slot.pos_next
+            seeds[i] = slot.req.sampling.seed & 0xFFFFFFFF
+            steps[i] = slot.n_generated   # this dispatch samples token
+            temps[i] = slot.req.sampling.temperature  # index n_generated
+            topks[i] = slot.req.sampling.top_k
+        if not live:
+            return
+        model, impl = self.model, self._attn_impl
+
+        def build():
+            def fn(feed, state, const):
+                kc, vc, toks, logits = model.decode_step(
+                    const, state[0], state[1], *feed, attn_impl=impl)
+                return [toks, logits], [kc, vc]
+            return fn
+
+        (toks, logits), new_state = self._exe.run_callable(
+            f"decode/{self.name}/step", build,
+            [tokens, positions, tables, seeds, steps, temps, topks],
+            state=self.cache.state(), const=self._plist)
+        self.cache.update(new_state)
+        toks_np = np.asarray(toks)
+        logits_np = np.asarray(logits) if self.capture_logits else None
+        now = time.monotonic()
+        self.stats.steps.inc()
+        self.stats.step_ms.observe((time.perf_counter() - t0) * 1e3)
+        for i in live:
+            slot = self._slots[i]
+            tok = int(toks_np[i])
+            slot.pos_next += 1
+            slot.n_generated += 1
+            slot.last_token = tok
+            self.stats.tokens.inc()
+            self.stats.token_ms.observe((now - slot.t_last) * 1e3)
+            slot.t_last = now
+            slot.req.handle._emit(
+                tok, logits_np[i] if logits_np is not None else None)
+            self._maybe_finish(i, slot, tok)
+
+    # -- retirement --------------------------------------------------------
+    def _maybe_finish(self, i: int, slot: _Slot, token: int) -> None:
+        s = slot.req.sampling
+        if s.eos_id is not None and token == s.eos_id:
+            self._retire(i, slot, "eos")
+        elif slot.n_generated >= s.max_new_tokens:
+            self._retire(i, slot, "length")
+
+    def _retire(self, i: int, slot: _Slot, reason: str) -> None:
+        """Free the slot + its cache blocks and finish the stream
+        (eos / length / cancelled all leave through here)."""
+        with self._lock:
+            self._slots[i] = None
+            self.cache.allocator.release(slot.blocks)
+            self._tables[i, :] = 0
+            self.stats.leaves.inc()
+            self.stats.active.set(sum(x is not None for x in self._slots))
+            self.stats.blocks_free.set(self.cache.allocator.free_blocks)
+            self._lock.notify_all()   # blocks freed: admit the queue head
+        slot.req.handle._finish(reason)
+
+    def _release(self, req: DecodeRequest, slot_idx, error) -> None:
+        with self._lock:
+            for i, s in enumerate(self._slots):
+                if s is not None and s.req is req:
+                    self.cache.allocator.release(s.blocks)
+                    self._tables[i, :] = 0
+                    self._slots[i] = None
+                    self.stats.leaves.inc()
+            self.stats.blocks_free.set(self.cache.allocator.free_blocks)
+            self.stats.active.set(sum(x is not None for x in self._slots))
+        req.handle._fail(error)
+
+    def _fail_all(self, error) -> None:
+        with self._lock:
+            slots, self._slots = (list(self._slots),
+                                  [None] * self.max_slots)
+            for s in slots:
+                if s is not None:
+                    self.cache.allocator.release(s.blocks)
+                    self.stats.leaves.inc()
+            self._tables[:] = 0
+        for s in slots:
+            if s is not None:
+                s.req.handle._fail(error)
+
+    # -- observability -----------------------------------------------------
+    def decodez(self) -> dict:
+        """The /decodez payload: slots, cache, queue, recent rates."""
+        with self._lock:
+            slots = [
+                None if s is None else {
+                    "rid": s.req.rid, "prompt_len": int(s.req.prompt.size),
+                    "generated": s.n_generated,
+                    "context_len": int(s.pos_next),
+                    "max_new_tokens": s.req.sampling.max_new_tokens}
+                for s in self._slots]
+            pending = len(self._pending)
+        out = {
+            "model": self.name,
+            "config": self.model.config.to_dict(),
+            "cache": self.cache.snapshot(),
+            "max_blocks_per_seq": self.max_blocks_per_seq,
+            "prefill_buckets": list(self.prefill_ladder.sizes),
+            "max_slots": self.max_slots,
+            "slots": slots,
+            "queue_depth": pending,
+            "tokens": self.stats.tokens.value,
+            "steps": self.stats.steps.value,
+            "prefills": self.stats.prefills.value,
+            "joins": self.stats.joins.value,
+            "leaves": self.stats.leaves.value,
+            "shed": self.stats.shed.value,
+        }
+        snap = self.stats.step_ms.snapshot()
+        if snap.get("count"):
+            out["step_p50_ms"] = self.stats.step_ms.percentile(0.50)
+            out["step_p99_ms"] = self.stats.step_ms.percentile(0.99)
+        tsnap = self.stats.token_ms.snapshot()
+        if tsnap.get("count"):
+            out["token_p50_ms"] = self.stats.token_ms.percentile(0.50)
+            out["token_p99_ms"] = self.stats.token_ms.percentile(0.99)
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Wait until every accepted request has finished."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._pending or any(s is not None for s in self._slots):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._lock.wait(timeout=min(left, 0.2))
+        return True
+
+    def close(self, timeout: float = 30.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._lock.notify_all()
+        self._thread.join(timeout=timeout)
+        _debug_server.unregister_decodez(self.name)

@@ -1,0 +1,363 @@
+"""Decoder-only transformer LM adapter for the decode plane.
+
+The decode engine needs a model expressed as three pure-JAX functions
+sharing one parameter schema — a full causal forward (the re-prefill
+baseline and the parity anchor), a prompt prefill that WRITES the paged
+cache, and a one-token decode step that READS it through the paged
+attention kernel:
+
+- :meth:`TransformerLM.full_logits` — ``tokens [B, T] → logits
+  [B, T, V]``, plain causal attention over the whole prefix.
+- :meth:`TransformerLM.prefill` — padded prompt ``[1, Tb]`` (Tb on the
+  prefill bucket ladder) → last-position logits + first sampled token,
+  with every real position's K/V scattered into the request's cache
+  blocks (padded positions scatter into the reserved trash block 0).
+- :meth:`TransformerLM.decode_step` — the continuous-batching hot
+  dispatch: ``[S]`` last tokens at ``[S]`` positions, K/V appended to
+  the cache, attention via
+  :func:`paddle_tpu.kernels.attention.decode_attention`, next token
+  sampled ON DEVICE (greedy / top-k / temperature — only the sampled
+  ``[S]`` int32 vector needs a host readback per step).
+
+The layer math (post-LN residuals, sinusoidal positions, sqrt(D) embed
+scale) deliberately mirrors ``models/transformer.py``'s decoder stack
+so "the tiny transformer" means the same architecture family; the
+incremental path and the full forward share the SAME per-layer
+functions, which is what makes the paged-cache greedy parity an
+algebraic identity (same math, different association) rather than a
+coincidence.
+
+Persistence: :func:`save_lm` / :func:`load_lm` write a model dir
+(``decode_config.json`` + ``params.npz``) that ``tools/serve.py
+--decode`` serves directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels.attention import decode_attention, paged_attention_xla
+
+_LN_EPS = 1e-5
+# static top-k ceiling compiled into the sampling epilogue: per-slot k
+# varies at runtime UNDER it without a recompile (a fixed shape is the
+# whole decode-plane contract)
+TOPK_MAX = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    """Geometry of a decoder-only TransformerLM."""
+
+    vocab: int
+    d_model: int = 64
+    n_head: int = 4
+    d_ffn: int = 128
+    n_layer: int = 2
+    max_seq_len: int = 128
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LMConfig":
+        return cls(**{f.name: d[f.name] for f in dataclasses.fields(cls)
+                      if f.name in d})
+
+
+def _pos_table(max_len: int, d_model: int) -> np.ndarray:
+    """Sinusoidal positions (models/transformer.py `_pos_encoding_table`)."""
+    pos = np.arange(max_len)[:, None].astype("float64")
+    dim = np.arange(d_model // 2)[None, :].astype("float64")
+    angle = pos / np.power(10000.0, 2 * dim / d_model)
+    table = np.zeros((max_len, d_model))
+    table[:, 0::2] = np.sin(angle)
+    table[:, 1::2] = np.cos(angle)
+    return table.astype("float32")
+
+
+def _param_names(cfg: LMConfig) -> List[str]:
+    names = ["emb"]
+    for i in range(cfg.n_layer):
+        names += [f"l{i}.wq", f"l{i}.wk", f"l{i}.wv", f"l{i}.wo",
+                  f"l{i}.ln1.g", f"l{i}.ln1.b",
+                  f"l{i}.fc1", f"l{i}.fc2",
+                  f"l{i}.ln2.g", f"l{i}.ln2.b"]
+    names.append("out_proj")
+    return names
+
+
+class TransformerLM:
+    """One decoder-only LM: config + the three jit-ready functions.
+
+    Params are a plain name→array dict (``init_params`` /
+    ``save_lm``/``load_lm``); the engine device-puts them once and
+    passes them as ``const`` through ``Executor.run_callable``."""
+
+    def __init__(self, config: LMConfig):
+        self.config = config
+        self._pos = jnp.asarray(_pos_table(config.max_seq_len,
+                                           config.d_model))
+
+    # -- parameters --------------------------------------------------------
+    def init_params(self, seed: int = 0) -> Dict[str, np.ndarray]:
+        cfg = self.config
+        rng = np.random.RandomState(seed)
+        D, F, V = cfg.d_model, cfg.d_ffn, cfg.vocab
+
+        def mat(m, n, scale=None):
+            s = scale if scale is not None else (1.0 / np.sqrt(m))
+            return (rng.randn(m, n) * s).astype("float32")
+
+        p = {"emb": mat(V, D, scale=D ** -0.5), "out_proj": mat(D, V)}
+        for i in range(cfg.n_layer):
+            p[f"l{i}.wq"] = mat(D, D)
+            p[f"l{i}.wk"] = mat(D, D)
+            p[f"l{i}.wv"] = mat(D, D)
+            p[f"l{i}.wo"] = mat(D, D)
+            p[f"l{i}.ln1.g"] = np.ones((D,), "float32")
+            p[f"l{i}.ln1.b"] = np.zeros((D,), "float32")
+            p[f"l{i}.fc1"] = mat(D, F)
+            p[f"l{i}.fc2"] = mat(F, D)
+            p[f"l{i}.ln2.g"] = np.ones((D,), "float32")
+            p[f"l{i}.ln2.b"] = np.zeros((D,), "float32")
+        return p
+
+    def param_list(self, params: Dict) -> List:
+        """The ``const`` list in the fixed order the builders close
+        over (missing names fail loudly here, not inside a trace)."""
+        return [jnp.asarray(params[n]) for n in _param_names(self.config)]
+
+    def _unpack(self, plist) -> Dict[str, jnp.ndarray]:
+        return dict(zip(_param_names(self.config), plist))
+
+    # -- shared layer math -------------------------------------------------
+    @staticmethod
+    def _ln(x, g, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + _LN_EPS) * g + b
+
+    def _qkv(self, p, i, h):
+        """h [..., D] → q, k, v [..., H, Dh]."""
+        cfg = self.config
+        hd = cfg.head_dim
+
+        def split(x):
+            return x.reshape(x.shape[:-1] + (cfg.n_head, hd))
+        return (split(h @ p[f"l{i}.wq"]), split(h @ p[f"l{i}.wk"]),
+                split(h @ p[f"l{i}.wv"]))
+
+    def _post_attn(self, p, i, h, ctx):
+        """Residual + FFN half of one layer; ctx is the attention
+        output merged back to [..., D]."""
+        cfg = self.config
+        ctx = ctx.reshape(ctx.shape[:-2] + (cfg.d_model,))
+        h = self._ln(h + ctx @ p[f"l{i}.wo"],
+                     p[f"l{i}.ln1.g"], p[f"l{i}.ln1.b"])
+        f = jax.nn.relu(h @ p[f"l{i}.fc1"]) @ p[f"l{i}.fc2"]
+        return self._ln(h + f, p[f"l{i}.ln2.g"], p[f"l{i}.ln2.b"])
+
+    # -- full forward (baseline / parity anchor) ---------------------------
+    def full_logits(self, plist, tokens, lengths=None):
+        """tokens [B, T] int32 → logits [B, T, V]; positions ≥ length
+        masked out of attention when ``lengths`` [B] is given."""
+        p = self._unpack(plist)
+        cfg = self.config
+        B, T = tokens.shape
+        sc = float(1.0 / np.sqrt(cfg.head_dim))
+        h = p["emb"][tokens] * (cfg.d_model ** 0.5) + self._pos[:T]
+        qi = jnp.arange(T)
+        causal = qi[:, None] >= qi[None, :]
+        mask = causal[None]
+        if lengths is not None:
+            mask = jnp.logical_and(
+                mask, qi[None, None, :] < lengths[:, None, None])
+        for i in range(cfg.n_layer):
+            q, k, v = self._qkv(p, i, h)          # [B, T, H, Dh]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) * sc
+            s = jnp.where(mask[:, None], s, -1e30)
+            w = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", w,
+                             v.astype(jnp.float32)).astype(h.dtype)
+            h = self._post_attn(p, i, h, ctx)
+        return h @ p["out_proj"]
+
+    # -- cache writes ------------------------------------------------------
+    @staticmethod
+    def _scatter_kv(cache, layer, blocks, offsets, rows):
+        """rows [N, H, Dh] into cache[layer] at (block, offset) pairs."""
+        return cache.at[layer, blocks, offsets].set(rows)
+
+    # -- prefill -----------------------------------------------------------
+    def prefill(self, plist, kc, vc, tokens, length, block_table,
+                seed, temperature, top_k):
+        """tokens [1, Tb] (bucket-padded), length [] int32, block_table
+        [MB] int32 → (kc', vc', next_token [] int32, logits [V]).
+
+        One full causal forward over the padded prompt; every real
+        position's K/V lands in the request's blocks, pad positions
+        land in trash block 0 (their attention contribution is masked
+        by ``length`` either way).  The FIRST generated token samples
+        here, so a joining request streams its first token without
+        waiting for a decode step."""
+        cfg = self.config
+        p = self._unpack(plist)
+        Tb = tokens.shape[1]
+        bs = kc.shape[2]
+        sc = float(1.0 / np.sqrt(cfg.head_dim))
+        pos_idx = jnp.arange(Tb, dtype=jnp.int32)
+        valid = pos_idx < length
+        blocks = jnp.where(valid, block_table[pos_idx // bs], 0)
+        offsets = pos_idx % bs
+        qi = jnp.arange(Tb)
+        mask = jnp.logical_and(qi[:, None] >= qi[None, :],
+                               qi[None, :] < length)[None]
+        h = p["emb"][tokens] * (cfg.d_model ** 0.5) + self._pos[:Tb]
+        for i in range(cfg.n_layer):
+            q, k, v = self._qkv(p, i, h)          # [1, Tb, H, Dh]
+            kc = self._scatter_kv(kc, i, blocks, offsets, k[0])
+            vc = self._scatter_kv(vc, i, blocks, offsets, v[0])
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) * sc
+            s = jnp.where(mask[:, None], s, -1e30)
+            w = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", w,
+                             v.astype(jnp.float32)).astype(h.dtype)
+            h = self._post_attn(p, i, h, ctx)
+        last = h[0, jnp.maximum(length - 1, 0)]
+        logits = last @ p["out_proj"]
+        tok = _sample(logits[None], seed[None],
+                      jnp.zeros((1,), jnp.int32), temperature[None],
+                      top_k[None])[0]
+        return kc, vc, tok, logits
+
+    # -- decode step (the continuous-batching hot dispatch) ----------------
+    def decode_step(self, plist, kc, vc, tokens, positions, block_tables,
+                    seeds, steps, temperature, top_k, attn_impl=None):
+        """tokens [S] int32 (each slot's last token), positions [S]
+        int32 (where that token sits), block_tables [S, MB] int32,
+        seeds [S] uint32 + steps [S] int32 (per-request sampling
+        identity — see :func:`_sample`) → (kc', vc', next_tokens [S],
+        logits [S, V]).
+
+        Writes each slot's K/V at (position // bs, position % bs) via
+        its block table, then attends over positions 0..position
+        through the paged kernel.  Inactive slots feed position 0 with
+        an all-zero (trash) block table: they compute masked garbage
+        into block 0 and their sampled token is ignored by the engine —
+        fixed shapes, no branches."""
+        cfg = self.config
+        p = self._unpack(plist)
+        bs = kc.shape[2]
+        cl = positions + 1
+        blocks = block_tables[jnp.arange(tokens.shape[0]),
+                              positions // bs]
+        offsets = positions % bs
+        h = p["emb"][tokens] * (cfg.d_model ** 0.5) + self._pos[positions]
+        for i in range(cfg.n_layer):
+            q, k, v = self._qkv(p, i, h)          # [S, H, Dh]
+            kc = self._scatter_kv(kc, i, blocks, offsets, k)
+            vc = self._scatter_kv(vc, i, blocks, offsets, v)
+            ctx = decode_attention(q, kc[i], vc[i], block_tables, cl,
+                                   impl=attn_impl)
+            h = self._post_attn(p, i, h, ctx.astype(h.dtype))
+        logits = h @ p["out_proj"]
+        toks = _sample(logits, seeds, steps, temperature, top_k)
+        return kc, vc, toks, logits
+
+
+def _hash_uniform(seeds, steps, kk):
+    """Counter-hash uniforms in (0, 1): one murmur-style mix per
+    (request seed, token index, candidate lane) — the attention
+    dropout hash's recipe, keyed PER REQUEST.  A seeded stream is
+    replayable bit-for-bit regardless of which slot it lands on or
+    what else shares the decode batch (an engine-global PRNG key
+    could not promise that)."""
+    S = seeds.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (S, kk), 1)
+    x = (seeds.astype(jnp.uint32)[:, None] * jnp.uint32(0x9E3779B1)
+         ^ steps.astype(jnp.uint32)[:, None] * jnp.uint32(0x85EBCA77)
+         ^ lane * jnp.uint32(0xC2B2AE3D))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    u = (jax.lax.bitcast_convert_type(x >> 8, jnp.int32)
+         .astype(jnp.float32) * jnp.float32(1.0 / (1 << 24)))
+    return jnp.clip(u, 1e-7, 1.0 - 1e-7)
+
+
+def _sample(logits, seeds, steps, temperature, top_k):
+    """On-device sampling epilogue: logits [S, V], seeds [S] uint32
+    (per REQUEST), steps [S] int32 (each request's token index),
+    temperature [S] f32 (<= 0 ⇒ greedy), top_k [S] int32 (0 ⇒ full
+    vocab) → tokens [S] int32.  Per-slot knobs vary at runtime under
+    the static ``TOPK_MAX`` ceiling; sampling is Gumbel-max over the
+    top slice with :func:`_hash_uniform` bits, so a request's sampled
+    stream depends only on (its seed, its token indices) — replayable
+    across slot placements and batch compositions."""
+    S, V = logits.shape
+    kk = min(TOPK_MAX, V)
+    vals, idx = jax.lax.top_k(logits.astype(jnp.float32), kk)  # [S, kk]
+    lane = jnp.arange(kk, dtype=jnp.int32)[None, :]
+    want = jnp.where(top_k > 0, jnp.minimum(top_k, kk), kk)[:, None]
+    vals = jnp.where(lane < want, vals, -jnp.inf)
+    g = -jnp.log(-jnp.log(_hash_uniform(seeds, steps, kk)))
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    choice = jnp.argmax(vals / temp + g, axis=-1)
+    greedy = idx[:, 0]                     # top_k output is sorted
+    sampled = jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# model-dir persistence (tools/serve.py --decode serves these)
+# ---------------------------------------------------------------------------
+
+_CONFIG_FILE = "decode_config.json"
+_PARAMS_FILE = "params.npz"
+
+
+def save_lm(dirname: str, config: LMConfig, params: Dict) -> None:
+    """Write a decode-servable model dir (config JSON + params npz);
+    atomic per file (tmp + replace) like io.py's save discipline."""
+    os.makedirs(dirname, exist_ok=True)
+    cpath = os.path.join(dirname, _CONFIG_FILE)
+    with open(cpath + ".tmp", "w") as f:
+        json.dump(config.to_dict(), f, indent=2)
+    os.replace(cpath + ".tmp", cpath)
+    ppath = os.path.join(dirname, _PARAMS_FILE)
+    np.savez(ppath + ".tmp.npz",
+             **{k: np.asarray(v) for k, v in params.items()})
+    os.replace(ppath + ".tmp.npz", ppath)
+
+
+def load_lm(dirname: str):
+    """(TransformerLM, params dict) from a :func:`save_lm` dir."""
+    with open(os.path.join(dirname, _CONFIG_FILE)) as f:
+        cfg = LMConfig.from_dict(json.load(f))
+    with np.load(os.path.join(dirname, _PARAMS_FILE)) as z:
+        params = {k: z[k].copy() for k in z.files}
+    missing = [n for n in _param_names(cfg) if n not in params]
+    if missing:
+        raise ValueError(f"model dir {dirname!r} is missing params: "
+                         f"{missing[:4]}{'...' if len(missing) > 4 else ''}")
+    return TransformerLM(cfg), params
+
+
+__all__ = ["LMConfig", "TransformerLM", "save_lm", "load_lm",
+           "paged_attention_xla", "TOPK_MAX"]

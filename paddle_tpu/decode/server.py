@@ -1,0 +1,234 @@
+"""Decode server: the generative plane's streaming RPC front door.
+
+One more :class:`~paddle_tpu.distributed.transport.RPCServer` service
+(like the pserver/master/registry/serving endpoints), with one new
+message type:
+
+- ``DECODE`` (msg 23): ``name`` = model name, payload = JSON request
+  ``{"prompt": [ids], "max_new_tokens":, "temperature":, "top_k":,
+  "seed":, "eos_id":, "chunk_tokens":}``.  The reply is a STREAM — the
+  transport sends one frame per chunk as the engine generates (the
+  multi-frame handler contract ``transport.STREAM``), each payload one
+  tag byte + body:
+
+  * ``T`` + ``serde.dumps_batch`` of ``[("tokens", int32[k])]`` — a
+    chunk of ``chunk_tokens`` generated tokens (default 1: true
+    token-by-token streaming), riding the PR-3 zero-copy batched serde;
+  * ``F`` + JSON ``{"n_tokens":, "finish": "eos"|"length"}`` — end of
+    stream;
+  * ``O`` / ``L`` + JSON — typed :class:`Overloaded` /
+    :class:`RequestTooLong` detail (single-frame reply, like the
+    serving plane's INFER tags).
+
+- ``DECODE_ADMIN`` (msg 26): JSON command — ``{"cmd": "status"}``
+  returns the per-engine ``/decodez`` payloads.
+
+Replica groups: ``registry_ep`` set ⇒ one TTL lease per served model
+under ``decode/<model>/<replica_id>`` with role ``DECODE`` and the live
+tokens/s riding the lease data — the PR-8 registry announce path, so
+:class:`~paddle_tpu.decode.client.DecodeClient` discovers replicas and
+health-gates exactly like the one-shot serving client.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .engine import DecodeEngine, SamplingParams
+from ..distributed import registry as _registry
+from ..distributed import serde, transport
+from ..serving.batcher import Overloaded, RequestTooLong
+
+# one msg-type namespace across every service: transport 1-14,
+# master 15-20, serving 21/22, observability 24/25 — decode takes 23/26
+DECODE = 23
+DECODE_ADMIN = 26
+
+transport.MSG_NAMES.update({DECODE: "decode",
+                            DECODE_ADMIN: "decode_admin"})
+
+_TAG_TOKENS = b"T"
+_TAG_FIN = b"F"
+_TAG_OVERLOAD = b"O"
+_TAG_TOO_LONG = b"L"
+
+
+def replica_key(model: str, replica_id: str) -> str:
+    """The registry lease key a decode replica announces under."""
+    return f"decode/{model}/{replica_id}"
+
+
+def parse_replica_key(logical: str):
+    """``(model, replica_id)`` from a decode lease key, else None."""
+    parts = logical.split("/", 2)
+    if len(parts) == 3 and parts[0] == "decode":
+        return parts[1], parts[2]
+    return None
+
+
+class DecodeService:
+    """``handle()`` contract of transport.RPCServer services; DECODE
+    replies stream (``transport.STREAM``)."""
+
+    def __init__(self, engines: Dict[str, DecodeEngine]):
+        self.engines = dict(engines)
+
+    def handle(self, msg_type, trainer_id, name, payload):
+        if msg_type == DECODE:
+            body = json.loads(bytes(payload).decode("utf-8"))
+            eng = self.engines.get(name)
+            if eng is None:
+                return transport.ERR, \
+                    f"decode: unknown model {name!r}".encode()
+            sampling = SamplingParams.from_dict(body)
+            chunk = max(1, int(body.get("chunk_tokens", 1)))
+            try:
+                handle = eng.submit(body.get("prompt") or [], sampling)
+            except Overloaded as e:
+                return transport.OK, [
+                    _TAG_OVERLOAD + json.dumps(e.to_dict()).encode("utf-8")]
+            except RequestTooLong as e:
+                return transport.OK, [
+                    _TAG_TOO_LONG + json.dumps(e.to_dict()).encode("utf-8")]
+            return transport.STREAM, self._stream(handle, chunk)
+        if msg_type == DECODE_ADMIN:
+            body = json.loads(bytes(payload).decode("utf-8"))
+            if body.get("cmd") == "status":
+                return transport.OK, json.dumps(
+                    {m: e.decodez() for m, e in sorted(self.engines.items())},
+                    default=repr).encode("utf-8")
+            return transport.ERR, \
+                f"decode_admin: unknown cmd {body.get('cmd')!r}".encode()
+        return transport.ERR, f"decode: unknown msg {msg_type}".encode()
+
+    @staticmethod
+    def _stream(handle, chunk_tokens: int):
+        """Frame generator: T-chunks as tokens arrive, then FIN.
+
+        Two failure disciplines:
+        - every token wait is BOUNDED by FLAGS_rpc_deadline — a wedged
+          engine surfaces as a transport ERR frame, never a connection
+          thread parked forever (the serving plane's INFER contract);
+        - a client disconnect abandons this generator (the transport's
+          STREAM path closes it), and the ``finally`` cancels the
+          request — the engine frees the slot + cache blocks instead
+          of generating into the void."""
+        from ..core import flags as _flags
+        deadline = float(_flags.get_flags("rpc_deadline"))
+        buf = []
+        try:
+            while True:
+                tok = handle.next_token(timeout=deadline)
+                if tok is None:
+                    break
+                buf.append(tok)
+                if len(buf) >= chunk_tokens:
+                    yield [_TAG_TOKENS] + serde.dumps_batch_vec(
+                        [("tokens", np.asarray(buf, np.int32))])
+                    buf = []
+            if buf:
+                yield [_TAG_TOKENS] + serde.dumps_batch_vec(
+                    [("tokens", np.asarray(buf, np.int32))])
+            final = handle.result(timeout=0.0)
+            yield [_TAG_FIN + json.dumps(
+                {"n_tokens": final["n_tokens"],
+                 "finish": final["finish"]}).encode("utf-8")]
+        finally:
+            handle.cancel()   # no-op when the stream finished normally
+
+
+class DecodeServer:
+    """One decode-serving process: RPC endpoint + engines + announces.
+
+    ``engines``: model name → prebuilt :class:`DecodeEngine` (the
+    server owns them and closes them on :meth:`stop` unless
+    ``own_engines=False``)."""
+
+    def __init__(self, endpoint: str = "127.0.0.1:0",
+                 engines: Optional[Dict[str, DecodeEngine]] = None,
+                 registry_ep: Optional[str] = None,
+                 replica_id: Optional[str] = None,
+                 lease_ttl: float = _registry.DEFAULT_TTL,
+                 own_engines: bool = True):
+        self.engines: Dict[str, DecodeEngine] = dict(engines or {})
+        self._own_engines = own_engines
+        self.service = DecodeService(self.engines)
+        self._server = transport.RPCServer(endpoint, self.service)
+        self.registry_ep = registry_ep
+        self.lease_ttl = lease_ttl
+        self.replica_id = replica_id or f"{self.endpoint}"
+        self._hb_lock = threading.Lock()
+        self._heartbeats: Dict[str, _registry.Heartbeat] = {}
+        self._started = False
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def endpoint(self) -> str:
+        host = self._server.endpoint.rsplit(":", 1)[0]
+        return f"{host}:{self.port}"
+
+    def add_engine(self, name: str, engine: DecodeEngine) -> None:
+        self.engines[name] = engine
+        self.service.engines[name] = engine
+        self._sync_announcements()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._server.start()
+        self._started = True
+        self._sync_announcements()
+
+    def stop(self) -> None:
+        self._started = False
+        with self._hb_lock:
+            hbs, self._heartbeats = dict(self._heartbeats), {}
+        for hb in hbs.values():
+            hb.stop(bye=True)
+        self._server.stop()
+        if self._own_engines:
+            for eng in self.engines.values():
+                eng.close()
+
+    # -- registry announce -------------------------------------------------
+    def _model_health(self, model: str):
+        def probe() -> dict:
+            eng = self.engines.get(model)
+            return {"step": eng.stats.tokens.value if eng else 0}
+        return probe
+
+    def _model_data(self, model: str):
+        def data() -> dict:
+            out = {"model": model, "endpoint": self.endpoint}
+            eng = self.engines.get(model)
+            if eng is not None:
+                z = eng.decodez()
+                out["tokens"] = z["tokens"]
+                out["queue_depth"] = z["queue_depth"]
+                out["slots_active"] = sum(
+                    s is not None for s in z["slots"])
+            return out
+        return data
+
+    def _sync_announcements(self) -> None:
+        """One registry heartbeat per served model (the serving plane's
+        announce discipline with role DECODE)."""
+        if not self.registry_ep or not self._started:
+            return
+        names = set(self.engines)
+        with self._hb_lock:
+            for model in sorted(names - set(self._heartbeats)):
+                hb = _registry.Heartbeat(
+                    self.registry_ep, replica_key(model, self.replica_id),
+                    self.endpoint, ttl=self.lease_ttl, role="DECODE",
+                    health_fn=self._model_health(model),
+                    data_fn=self._model_data(model))
+                hb.start()
+                self._heartbeats[model] = hb
+            for model in sorted(set(self._heartbeats) - names):
+                self._heartbeats.pop(model).stop(bye=True)

@@ -88,6 +88,15 @@ TRACE_PULL = 25
 # message types (response)
 OK = 0
 ERR = 255
+# streaming handler verdict (NOT a wire status — never leaves the
+# server): a service returning ``(STREAM, iterator)`` has _serve_io
+# send one OK frame per yielded chunk on the SAME connection, in
+# order, then resume the request loop.  The receiver owns framing the
+# end of the stream at the application layer (the decode plane's FIN
+# tag) — the transport just moves frames.  This is what the DECODE
+# msg type rides: token chunks stream over the existing zero-copy
+# scatter-gather send path with no new wire format.
+STREAM = 254
 
 MSG_NAMES = {SEND_VAR: "send_var", GET_VAR: "get_var",
              SEND_VARS: "send_vars", GET_VARS: "get_vars",
@@ -469,6 +478,40 @@ def _serve_io(io, service) -> None:
             # lost-response window of a peer dying mid-request (the
             # at-most-once failure-path tests inject through this)
             return
+        if rtype == STREAM:
+            # multi-frame reply: one OK frame per yielded chunk (bytes
+            # or scatter-gather buffer list).  A generator fault mid-
+            # stream becomes a trailing ERR frame — the client sees a
+            # typed error, not a silent truncation; a ConnectionError
+            # means the peer went away, stop serving this conn.
+            try:
+                for chunk in rpayload:
+                    bufs = _pack_body_vec(
+                        OK, tid, name,
+                        chunk if isinstance(chunk, list) else [chunk])
+                    _send_frame_any(io, bufs)
+                    if tel:
+                        _obs_stats.scope("rpc.server").counter(
+                            "stream_frames").inc()
+            except ConnectionError:
+                # peer vanished mid-stream: close the generator NOW so
+                # its finally-cleanup (the decode plane cancels the
+                # abandoned request there) runs deterministically, not
+                # at some future GC
+                close = getattr(rpayload, "close", None)
+                if callable(close):
+                    try:
+                        close()
+                    except Exception:
+                        pass
+                return
+            except Exception as e:
+                try:
+                    _send_frame_any(io, _pack_body_vec(
+                        ERR, tid, name, [repr(e).encode("utf-8")]))
+                except ConnectionError:
+                    return
+            continue
         resp_bufs = _pack_body_vec(rtype, tid, name,
                                    rpayload if isinstance(rpayload, list)
                                    else [rpayload])

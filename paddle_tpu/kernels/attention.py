@@ -934,6 +934,184 @@ def _ring_vjp_bwd(axis_name, causal, sm_scale, dropout_rate, res, g):
 _ring_flash.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Paged decode attention: one query token per request against a paged KV
+# cache (the decode plane's hot op — paddle_tpu/decode)
+# ---------------------------------------------------------------------------
+
+def _decode_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_scr, l_scr, acc_scr, *,
+                        block_tokens: int, sm_scale: float):
+    """Grid (S, max_blocks): slot-major, blocks sequential minor — the
+    online-softmax state for one slot lives in VMEM scratch across its
+    block iterations (the flash discipline applied to the block TABLE
+    axis).  The K/V index maps read the scalar-prefetched block table,
+    so each grid step streams exactly ONE cache block — the gathered
+    block list is never materialized.  Blocks past the slot's context
+    frontier are skipped (index maps clamp to the frontier block, so
+    the pipeline issues no copies for them either).
+
+    Scores run in f32 natural units (a decode step is dispatch-bound,
+    not VPU-bound — the flash kernel's exp2/ones-lane folds buy nothing
+    at one query row per slot and would cost clarity)."""
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    cl = cl_ref[s]
+    last = jnp.maximum((cl - 1) // block_tokens, 0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j <= last)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale        # [H, D]
+        k_blk = k_ref[0].astype(jnp.float32)               # [bs, H, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        # per-head scores over this block's tokens: [H, bs]
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        pos = j * block_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(pos < cl, scores, NEG_INF)
+        m, acc = m_scr[:], acc_scr[:]
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)                         # [H, bs]
+        alpha = jnp.exp(m - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # [H, bs] @ [bs, H, D] batched over H -> [H, D]
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc * alpha + pv
+
+    @pl.when(j == last)
+    def _finish():
+        o_ref[0] = (acc_scr[:]
+                    / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention_xla(q, k_cache, v_cache, block_tables, context_lens,
+                        sm_scale=None):
+    """XLA gather fallback for :func:`decode_attention` (always
+    available; also the parity reference the kernel is pinned to).
+
+    q: [S, H, D]; k_cache/v_cache: [N_blocks, bs, H, D] (one layer);
+    block_tables: [S, MB] int32; context_lens: [S] int32 → [S, H, D].
+    """
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    S, H, D = q.shape
+    bs = k_cache.shape[1]
+    MB = block_tables.shape[1]
+    k = k_cache[block_tables].reshape(S, MB * bs, H, D)
+    v = v_cache[block_tables].reshape(S, MB * bs, H, D)
+    s = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    pos = jnp.arange(MB * bs, dtype=jnp.int32)
+    s = jnp.where(pos[None, None, :] < context_lens[:, None, None],
+                  s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("sht,sthd->shd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _paged_attn_pallas(q, k_cache, v_cache, block_tables, context_lens,
+                       sm_scale, interpret):
+    S, H, D = q.shape
+    bs = k_cache.shape[1]
+    MB = block_tables.shape[1]
+    bt = block_tables.astype(jnp.int32)
+    cl = context_lens.astype(jnp.int32)
+
+    def kv_map(s, j, bt, cl):
+        # clamp skipped past-frontier blocks to the frontier block: the
+        # pipeline re-references the previous block, no copy issued
+        jc = jnp.minimum(j, jnp.maximum((cl[s] - 1) // bs, 0))
+        return (bt[s, jc], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, MB),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, j, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, bs, H, D), kv_map),
+            pl.BlockSpec((1, bs, H, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda s, j, bt, cl: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_attn_kernel, block_tokens=bs,
+                               sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        interpret=interpret,
+    )(bt, cl, q, k_cache, v_cache)
+
+
+def _count_decode(name: str, n: int = 1) -> None:
+    from ..observability import stats as _obs_stats
+    _obs_stats.scope("decode").counter(name).inc(n)
+
+
+# trace-time latch: a build fault disables the kernel for the process
+# (counted ONCE per fault site, like kernels/sparse.py's per-stage
+# fallbacks — a kernel fault can never fail a decode step)
+_decode_attn_broken = False
+
+
+def decode_attention(q, k_cache, v_cache, block_tables, context_lens,
+                     sm_scale=None, interpret=None, impl=None):
+    """Paged decode attention: one query token per request against its
+    gathered block list (scalar-prefetch block tables — module doc,
+    ``_decode_attn_kernel``).
+
+    q: [S, H, D] (S decode slots); k_cache/v_cache: [N_blocks,
+    block_tokens, H, D] for ONE layer; block_tables: [S, MB] int32
+    cache-block ids per slot; context_lens: [S] int32 valid tokens per
+    slot (positions ≥ context_len masked).  Returns [S, H, D].
+
+    ``impl``: None (pallas with counted XLA fallback — the
+    kernels/sparse.py contract), "xla" (force the gather path),
+    "pallas" (no fallback; tests).  Off-TPU the kernel runs in Pallas
+    interpret mode like the flash kernels."""
+    global _decode_attn_broken
+    if sm_scale is None:
+        sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    if impl == "pallas" and not _HAVE_PALLAS:
+        # the no-fallback contract must not pass vacuously on a build
+        # without pallas (a parity test would compare XLA to XLA)
+        raise RuntimeError(
+            "decode_attention(impl='pallas'): pallas is unavailable "
+            "in this build")
+    if impl == "xla" or not _HAVE_PALLAS or \
+            (impl is None and _decode_attn_broken):
+        return paged_attention_xla(q, k_cache, v_cache, block_tables,
+                                   context_lens, sm_scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    try:
+        return _paged_attn_pallas(q, k_cache, v_cache, block_tables,
+                                  context_lens, sm_scale, interpret)
+    except Exception:
+        if impl == "pallas":
+            raise
+        _decode_attn_broken = True
+        _count_decode("attn_fallbacks")
+        return paged_attention_xla(q, k_cache, v_cache, block_tables,
+                                   context_lens, sm_scale)
+
+
 def _ring_xla(q, k, v, kv_mask, axis_name, causal=False, sm_scale=None,
               dropout_rate=0.0, dropout_seed=None):
     """Pure-jnp blockwise ring (no-pallas fallback): K/V rotate via

@@ -58,6 +58,9 @@ _aggregator = None  # duck-typed: anything with .to_prometheus_text()
 # /servingz sources: one per in-process ModelServer (keyed by its
 # endpoint), each fn() returning that server's router + model gauges
 _servingz: Dict[str, Callable[[], object]] = {}
+# /decodez sources: one per in-process DecodeEngine (keyed by model
+# name), each fn() returning that engine's slots/cache/queue gauges
+_decodez: Dict[str, Callable[[], object]] = {}
 
 
 def register_provider(name: str, fn: Callable[[], object]) -> None:
@@ -94,6 +97,32 @@ def _servingz_payload() -> dict:
         try:
             out[name] = fn()
         except Exception as e:  # one broken server must not 500 the page
+            out[name] = {"error": repr(e)[:200]}
+    return out
+
+
+def register_decodez(name: str, fn: Callable[[], object]) -> None:
+    """Add a /decodez source (a DecodeEngine's ``decodez``).
+    Re-registering a name replaces it (latest owner wins)."""
+    with _lock:
+        _decodez[name] = fn
+
+
+def unregister_decodez(name: str) -> None:
+    with _lock:
+        _decodez.pop(name, None)
+
+
+def _decodez_payload() -> dict:
+    with _lock:
+        sources = dict(_decodez)
+    if not sources:
+        return {"decode": "no decode engine registered in this process"}
+    out = {}
+    for name, fn in sorted(sources.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # one broken engine must not 500 the page
             out[name] = {"error": repr(e)[:200]}
     return out
 
@@ -240,6 +269,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, json.dumps(_servingz_payload(), indent=2,
                                             default=repr),
                             "application/json")
+            elif path == "/decodez":
+                # the decode-plane debug page: per-engine slot table,
+                # paged-cache occupancy, queue depth, tokens/s gauges
+                self._reply(200, json.dumps(_decodez_payload(), indent=2,
+                                            default=repr),
+                            "application/json")
             elif path == "/chaosz":
                 # fault-injection control plane (distributed/faults.py):
                 # ?inject=<spec> arms rules, ?clear=1 removes runtime
@@ -272,6 +307,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "recorder)",
                      "/memz  /profilez  (?text=1 human rendering)",
                      "/servingz  (model-server router + batching gauges)",
+                     "/decodez  (decode engines: slots, paged cache, "
+                     "queue)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),
                     "text/plain")
             else:

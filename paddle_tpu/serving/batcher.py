@@ -82,6 +82,36 @@ class Overloaded(RuntimeError):
                    d.get("slo_ms"))
 
 
+class RequestTooLong(ValueError):
+    """Typed over-length rejection: the request was NOT queued.
+
+    Raised at submit when a feed's sequence axis exceeds the model's
+    ``max_seq_len`` (or a decode prompt+budget exceeds the engine's
+    context bound) — BEFORE the request can poison its coalesced batch
+    or force an off-ladder recompile.  Carried over the wire like
+    :class:`Overloaded` so remote callers see the same type; unlike
+    Overloaded it must NOT fail over to another replica — every replica
+    of the model would reject it identically."""
+
+    def __init__(self, model: str, feed: str, length: int, limit: int):
+        self.model = model
+        self.feed = feed
+        self.length = int(length)
+        self.limit = int(limit)
+        super().__init__(
+            f"model {model!r}: feed {feed!r} length {length} exceeds "
+            f"max_seq_len {limit}")
+
+    def to_dict(self) -> dict:
+        return {"model": self.model, "feed": self.feed,
+                "length": self.length, "limit": self.limit}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RequestTooLong":
+        return cls(d.get("model", "?"), d.get("feed", "?"),
+                   int(d.get("length", 0)), int(d.get("limit", 0)))
+
+
 class BucketLadder:
     """Sorted batch-size ladder; ``snap(n)`` is the smallest bucket
     ≥ n.  Requests larger than the top bucket are rejected at submit
@@ -253,9 +283,21 @@ class DynamicBatcher:
                  buckets: Optional[Sequence[int]] = None,
                  max_delay_ms: Optional[float] = None,
                  max_queue_rows: Optional[int] = None,
-                 queue_delay_slo_ms: Optional[float] = None):
+                 queue_delay_slo_ms: Optional[float] = None,
+                 max_seq_len: Optional[int] = None):
         self.predictor = predictor
         self.name = name
+        # per-model sequence-length bound (padded sequence models):
+        # a sequence feed whose axis-1 exceeds it is rejected ALONE at
+        # submit with a typed RequestTooLong — before the first request
+        # could latch an over-length sample shape into the feed
+        # contract and force every later dispatch onto an off-ladder
+        # executable.  An int applies to the feeds whose program
+        # declaration does NOT pin a static sample shape (a statically
+        # declared [B, 256] feature feed is not a sequence and must not
+        # be measured against it); a dict names feed→limit explicitly.
+        self.max_seq_len = max_seq_len if isinstance(max_seq_len, dict) \
+            else (int(max_seq_len) if max_seq_len else None)
         self.ladder = (buckets if isinstance(buckets, BucketLadder)
                        else BucketLadder(buckets))
         self.max_delay_ms = (
@@ -287,6 +329,15 @@ class DynamicBatcher:
                                           if var.dtype is not None else None]
             else:
                 self._feed_contract[n] = [None, None]
+        if isinstance(self.max_seq_len, dict):
+            self._seq_limits = {n: int(v)
+                                for n, v in self.max_seq_len.items()}
+        elif self.max_seq_len:
+            self._seq_limits = {n: int(self.max_seq_len)
+                                for n, c in self._feed_contract.items()
+                                if c[0] is None}
+        else:
+            self._seq_limits = {}
 
         self._cv = threading.Condition()
         self._q: deque = deque()
@@ -328,6 +379,10 @@ class DynamicBatcher:
                 raise ValueError(
                     f"feeds disagree on the batch dim: {n!r} has "
                     f"{a.shape[0]} rows, expected {rows}")
+            lim = self._seq_limits.get(n)
+            if lim is not None and a.ndim >= 2 and a.shape[1] > lim:
+                self.stats.note_shed()
+                raise RequestTooLong(self.name, n, a.shape[1], lim)
             contract = self._feed_contract[n]
             if contract[0] is not None and a.shape[1:] != contract[0]:
                 raise ValueError(

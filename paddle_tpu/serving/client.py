@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .batcher import Overloaded
+from .batcher import Overloaded, RequestTooLong
 from . import server as _server
 from ..distributed import registry as _dist_registry
 from ..distributed import serde, transport
@@ -135,6 +135,11 @@ class ServingClient:
                 last_exc = Overloaded.from_dict(
                     json.loads(bytes(rest).decode("utf-8")))
                 continue  # another replica may have headroom
+            if tag == _server._TAG_TOO_LONG:
+                # terminal: every replica enforces the same max_seq_len,
+                # so failing over would just repeat the rejection
+                raise RequestTooLong.from_dict(
+                    json.loads(bytes(rest).decode("utf-8")))
             return serde.loads_batch(rest, copy=True)
         raise last_exc if last_exc is not None else RuntimeError(
             f"no replica answered for model {model!r}")

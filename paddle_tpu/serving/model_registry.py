@@ -57,7 +57,8 @@ class ServedModel:
                "buckets": list(self.batcher.ladder.sizes),
                "max_delay_ms": self.batcher.max_delay_ms,
                "max_queue_rows": self.batcher.max_queue_rows,
-               "queue_delay_slo_ms": self.batcher.queue_delay_slo_ms}
+               "queue_delay_slo_ms": self.batcher.queue_delay_slo_ms,
+               "max_seq_len": self.batcher.max_seq_len}
         if self.warm_info is not None:
             out["warm"] = self.warm_info
         out.update(self.batcher.stats.snapshot())

@@ -36,7 +36,7 @@ import json
 import threading
 from typing import Dict, Optional
 
-from .batcher import Overloaded
+from .batcher import Overloaded, RequestTooLong
 from .model_registry import ModelManager
 from ..distributed import registry as _registry
 from ..distributed import serde, transport
@@ -53,6 +53,7 @@ transport.MSG_NAMES.update({INFER: "infer",
 # INFER reply tag bytes (first payload byte)
 _TAG_RESULT = b"R"
 _TAG_OVERLOAD = b"O"
+_TAG_TOO_LONG = b"L"
 
 
 def replica_key(model: str, replica_id: str) -> str:
@@ -84,6 +85,11 @@ class ServingService:
             except Overloaded as e:
                 return transport.OK, [
                     _TAG_OVERLOAD + json.dumps(e.to_dict()).encode("utf-8")]
+            except RequestTooLong as e:
+                # typed like Overloaded, but terminal: no replica would
+                # accept this request, so the client must NOT fail over
+                return transport.OK, [
+                    _TAG_TOO_LONG + json.dumps(e.to_dict()).encode("utf-8")]
             # bounded wait: a wedged batcher must surface as an ERR frame
             # to this client, not a connection thread parked forever
             from ..core import flags as _flags
@@ -106,7 +112,8 @@ class ServingService:
         if cmd in ("load", "swap"):
             kw = {k: body[k] for k in
                   ("model_dir", "buckets", "sample_shapes", "max_delay_ms",
-                   "max_queue_rows", "queue_delay_slo_ms") if k in body}
+                   "max_queue_rows", "queue_delay_slo_ms", "max_seq_len")
+                  if k in body}
             if cmd == "load":
                 sm = m.load(body["model"], body["version"],
                             activate=bool(body.get("activate", True)), **kw)

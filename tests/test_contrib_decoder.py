@@ -7,7 +7,8 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
-from paddle_tpu.contrib.decoder import (BeamSearchDecoder, InitState,
+from paddle_tpu.contrib.decoder import (BeamSearchDecoder,
+                                        IncrementalBeamDecoder, InitState,
                                         StateCell, TrainingDecoder)
 from paddle_tpu.core import unique_name
 from paddle_tpu.core.executor import Executor, Scope, scope_guard
@@ -155,6 +156,128 @@ def test_training_decoder_converges_and_beam_decodes():
     tok = int(src1[0, 0])
     top = ids_v[0][: int(len_v[0])]
     assert tok in top, (tok, ids_v, len_v)
+
+
+def _mt_beam_programs(beam):
+    """The machine-translation decoder pattern both ways: the
+    whole-sequence BeamSearchDecoder program, plus the h0 bootstrap and
+    one-step cell programs the incremental path drives.  All parameters
+    share names, so one startup run serves every program."""
+    infer, istart = Program(), Program()
+    with program_guard(infer, istart), unique_name.guard():
+        src = L.data("src", [1], dtype="int64", lod_level=1)
+        src_emb = L.embedding(
+            src, [V, EMB], param_attr=fluid.ParamAttr(name="dec.src_emb"))
+        enc = L.sequence_pool(src_emb, "first")
+        h0 = L.fc(enc, HID, act="tanh",
+                  param_attr=fluid.ParamAttr(name="dec.h0.w"),
+                  bias_attr=fluid.ParamAttr(name="dec.h0.b"))
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=h0)}, out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            h = c.get_state("h")
+            x = c.get_input("x")
+            c.set_state(
+                "h", L.fc(L.concat([x, h], axis=1), HID, act="tanh",
+                          param_attr=fluid.ParamAttr(name="dec.cell.w"),
+                          bias_attr=fluid.ParamAttr(name="dec.cell.b")))
+
+        init_ids = L.data("init_ids", [1], dtype="int64")
+        init_scores = L.data("init_scores", [1])
+        decoder = BeamSearchDecoder(
+            state_cell=cell, init_ids=init_ids, init_scores=init_scores,
+            target_dict_dim=V, word_dim=EMB, topk_size=V,
+            sparse_emb=False, max_len=T, beam_size=beam, end_id=END_ID,
+            emb_param_attr=fluid.ParamAttr(name="dec.tgt_emb"),
+            score_param_attr=fluid.ParamAttr(name="dec.out.w"),
+            score_bias_attr=fluid.ParamAttr(name="dec.out.b"))
+        decoder.decode()
+        ids, _scores = decoder()
+
+    h0p, _ = Program(), Program()
+    with program_guard(h0p, Program()), unique_name.guard():
+        src = L.data("src", [1], dtype="int64", lod_level=1)
+        semb = L.embedding(
+            src, [V, EMB], param_attr=fluid.ParamAttr(name="dec.src_emb"))
+        enc = L.sequence_pool(semb, "first")
+        h0v = L.fc(enc, HID, act="tanh",
+                   param_attr=fluid.ParamAttr(name="dec.h0.w"),
+                   bias_attr=fluid.ParamAttr(name="dec.h0.b"))
+
+    stepp = Program()
+    with program_guard(stepp, Program()), unique_name.guard():
+        pre = L.data("pre_ids", [1], dtype="int64")
+        hin = L.data("h_in", [HID])
+        emb = L.embedding(
+            pre, [V, EMB], param_attr=fluid.ParamAttr(name="dec.tgt_emb"))
+        hout = L.fc(L.concat([emb, hin], axis=1), HID, act="tanh",
+                    param_attr=fluid.ParamAttr(name="dec.cell.w"),
+                    bias_attr=fluid.ParamAttr(name="dec.cell.b"))
+        probs = L.fc(hout, V, act="softmax",
+                     param_attr=fluid.ParamAttr(name="dec.out.w"),
+                     bias_attr=fluid.ParamAttr(name="dec.out.b"))
+        tk_s, tk_i = L.topk(probs, k=V)
+        step_fetches = [hout.name, tk_i.name, tk_s.name]
+    return (infer, istart, ids, decoder), (h0p, h0v), (stepp, step_fetches)
+
+
+def test_incremental_beam_matches_whole_sequence_exactly():
+    """Satellite pin: beam state carried across decode steps through
+    IncrementalBeamDecoder reproduces the whole-sequence
+    beam_search_decode output EXACTLY (ids, per-step scores, candidate
+    lengths) on the machine-translation decoder pattern.  This
+    comparison is also what caught the whole-sequence decoder's
+    frozen-carried-state bug (states created inside the While body
+    re-initialized every iteration)."""
+    beam = 3
+    rng = np.random.RandomState(0)
+    scope, exe = Scope(), Executor()
+    from paddle_tpu.core.executor import scope_guard
+    with scope_guard(scope):
+        (infer, istart, ids, decoder), (h0p, h0v), (stepp, fetches) = \
+            _mt_beam_programs(beam)
+        exe.run(istart)
+        src1 = rng.randint(2, V, (1, T)).astype("int64")
+        feed = {"src": src1[..., None],
+                "src@LEN": np.full((1,), T, "int64"),
+                "init_ids": np.zeros((beam, 1), "int64"),
+                "init_scores": np.array([[0.0]] + [[-1e9]] * (beam - 1),
+                                        "float32")}
+        ids_w, sc_w, cl_w = exe.run(
+            infer, feed=feed,
+            fetch_list=[ids.name, decoder.result.scores.name,
+                        decoder.result.cand_len.name], sync=True)
+
+        h0_val, = exe.run(
+            h0p, feed={"src": src1[..., None],
+                       "src@LEN": np.full((1,), T, "int64")},
+            fetch_list=[h0v.name], sync=True)
+        h = np.tile(np.asarray(h0_val), (beam, 1))   # beam fan-out
+        ibd = IncrementalBeamDecoder(beam_size=beam, end_id=END_ID,
+                                     topk_size=V, executor=exe)
+        ibd.start()
+        for _ in range(T):
+            h_new, cand_ids, cand_probs = exe.run(
+                stepp, feed={"pre_ids": ibd.pre_ids, "h_in": h},
+                fetch_list=fetches, sync=True)
+            _sel, parent = ibd.step(cand_ids, cand_probs)
+            # carried model state follows its parent (the While loop's
+            # in-body gather, done at the host boundary)
+            h = np.asarray(h_new)[np.asarray(parent)]
+        assert ibd.steps == T
+        res = ibd.finalize()
+    assert np.array_equal(np.asarray(ids_w), res.ids)
+    assert np.array_equal(np.asarray(cl_w), res.cand_len)
+    assert np.array_equal(np.asarray(sc_w).astype("float32"),
+                          res.scores.astype("float32"))
+
+
+def test_incremental_beam_contract_errors():
+    ibd = IncrementalBeamDecoder(beam_size=2, end_id=END_ID, topk_size=4)
+    with pytest.raises(ValueError, match="finalize"):
+        ibd.finalize()
 
 
 def test_state_cell_contract_errors():

@@ -50,6 +50,7 @@ METRIC_KEYS = (
     "batched_storm_vars_per_sec",
     "batched_dense_mb_per_sec",
     "batched_qps",
+    "decode_tokens_per_sec",
     "pipeline_samples_per_sec",
     "cold_vs_warm_speedup",
     "eff_flops",

@@ -14,6 +14,7 @@ Operator companion to ``paddle_tpu/observability/debug_server.py``
     python tools/dump_metrics.py 8085 --memz          # device memory
     python tools/dump_metrics.py 8085 --profilez      # cost/roofline
     python tools/dump_metrics.py 8085 --memz --text   # human rendering
+    python tools/dump_metrics.py 8085 --decodez       # decode engines
 
 JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
 through (optionally filtered with ``--grep``) so the output pastes
@@ -79,6 +80,10 @@ def main(argv=None) -> int:
     ap.add_argument("--profilez", action="store_true",
                     help="fetch the perf-attribution records + "
                          "rooflines (/profilez)")
+    ap.add_argument("--decodez", action="store_true",
+                    help="fetch the decode-plane page (/decodez: "
+                         "per-engine slots, paged-cache occupancy, "
+                         "queue depth)")
     ap.add_argument("--text", action="store_true",
                     help="with --memz/--profilez: the human text "
                          "rendering (?text=1) instead of JSON")
@@ -90,7 +95,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     rc = 0
-    if args.tracez or args.flight or args.memz or args.profilez:
+    if args.tracez or args.flight or args.memz or args.profilez or \
+            args.decodez:
         pages = []
         if args.tracez:
             pages.append("tracez?raw=1" if args.raw else "tracez")
@@ -101,6 +107,8 @@ def main(argv=None) -> int:
             pages.append("memz" + suffix)
         if args.profilez:
             pages.append("profilez" + suffix)
+        if args.decodez:
+            pages.append("decodez")
         for page in pages:
             try:
                 body = fetch(args.host, args.port, page,

@@ -57,6 +57,15 @@ MODULES = [
     "paddle_tpu.pipeline.runner",
     "paddle_tpu.pipeline.permute",
     "paddle_tpu.pipeline.rpc",
+    # autoregressive decode plane (paged KV cache, continuous decode
+    # batching, streaming server/client): frozen so the generative
+    # serving API + wire tags drift loudly
+    "paddle_tpu.decode",
+    "paddle_tpu.decode.cache",
+    "paddle_tpu.decode.model",
+    "paddle_tpu.decode.engine",
+    "paddle_tpu.decode.server",
+    "paddle_tpu.decode.client",
     "paddle_tpu.lod_tensor",
     "paddle_tpu.transpiler",
     "paddle_tpu.data_feeder",

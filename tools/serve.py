@@ -10,6 +10,15 @@ membership:
         --endpoint 0.0.0.0:9000 --buckets 1,2,4,8,16,32 \\
         --max-delay-ms 5 --registry 10.0.0.2:8800 --debug-port 8080
 
+    # serve a saved GENERATIVE model (decode.save_lm dir) with the
+    # autoregressive decode plane: paged KV cache, token-level
+    # continuous batching, streaming DECODE replies:
+    python tools/serve.py /models/lm/v1 --model lm --decode \\
+        --endpoint 0.0.0.0:9100 --decode-slots 8 --debug-port 8080
+
+    # slots/cache/queue gauges of a running decode server:
+    python tools/serve.py --decode --admin 10.0.0.7:9100 --status
+
     # hot-swap a new version into a RUNNING server (zero downtime):
     python tools/serve.py /models/mnist/v2 --model mnist --version 2 \\
         --admin 10.0.0.7:9000 --swap
@@ -66,6 +75,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="admission-control queue bound in rows")
     p.add_argument("--slo-ms", type=float, default=None,
                    help="queue-delay SLO: shed when it is unmeetable")
+    p.add_argument("--max-seq-len", type=int, default=None,
+                   help="per-model sequence-length bound: an over-length "
+                        "request is rejected at submit with a typed "
+                        "RequestTooLong instead of poisoning its batch")
+    # decode mode ----------------------------------------------------------
+    p.add_argument("--decode", action="store_true",
+                   help="serve model_dir as a GENERATIVE model "
+                        "(decode.save_lm layout) on the streaming decode "
+                        "plane instead of one-shot inference")
+    p.add_argument("--decode-slots", type=int, default=None,
+                   help="decode-batch width (default: "
+                        "FLAGS_decode_max_slots)")
+    p.add_argument("--decode-block-tokens", type=int, default=None,
+                   help="paged KV cache block size in tokens (default: "
+                        "FLAGS_decode_block_tokens)")
+    p.add_argument("--decode-prefill-buckets", default=None,
+                   help="prompt-length ladder, e.g. 16,32,64,128 "
+                        "(default: FLAGS_decode_prefill_buckets)")
     p.add_argument("--no-warm", action="store_true",
                    help="skip the bucket-ladder warm pool (first requests "
                         "pay the compiles)")
@@ -99,11 +126,67 @@ def _batcher_kw(args) -> dict:
         kw["max_queue_rows"] = args.max_queue_rows
     if args.slo_ms is not None:
         kw["queue_delay_slo_ms"] = args.slo_ms
+    if args.max_seq_len is not None:
+        kw["max_seq_len"] = args.max_seq_len
     return kw
+
+
+def _serve_decode(args) -> int:
+    """Stand up a streaming decode server for a saved LM dir."""
+    import paddle_tpu as fluid  # noqa: F401 (registers lowerings)
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.decode import DecodeEngine, DecodeServer, load_lm
+    from paddle_tpu.serving import BucketLadder
+
+    if args.debug_port:
+        _flags.set_flags({"debug_server_port": args.debug_port})
+    lm, params = load_lm(args.model_dir)
+    kw = {}
+    if args.decode_slots is not None:
+        kw["max_slots"] = args.decode_slots
+    if args.decode_block_tokens is not None:
+        kw["block_tokens"] = args.decode_block_tokens
+    if args.decode_prefill_buckets is not None:
+        kw["prefill_buckets"] = BucketLadder.parse(
+            args.decode_prefill_buckets)
+    eng = DecodeEngine(lm, params, name=args.model, **kw)
+    srv = DecodeServer(args.endpoint, engines={args.model: eng},
+                       registry_ep=args.registry,
+                       replica_id=args.replica_id)
+    srv.start()
+    print(json.dumps({
+        "decoding": f"{args.model}@{args.version}",
+        "endpoint": srv.endpoint,
+        "model": lm.config.to_dict(),
+        "max_slots": eng.max_slots,
+        "block_tokens": eng.cache.block_tokens,
+        "prefill_buckets": list(eng.prefill_ladder.sizes),
+        "registry": args.registry,
+        "debug_port": args.debug_port or None}, default=repr), flush=True)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        srv.stop()
+        print("decode server stopped", flush=True)
+    return 0
 
 
 def _admin(args) -> int:
     from paddle_tpu.serving import ServingClient
+
+    if args.decode:
+        from paddle_tpu.decode import DecodeClient
+        out = DecodeClient(endpoints=[args.admin]).status(args.admin)
+        print(json.dumps(out, indent=2, default=repr))
+        return 0
 
     cli = ServingClient(endpoints=[args.admin])
     if args.swap:
@@ -130,6 +213,8 @@ def main(argv=None) -> int:
     if not args.model_dir:
         print("model_dir is required (or use --admin)", file=sys.stderr)
         return 2
+    if args.decode:
+        return _serve_decode(args)
 
     import paddle_tpu as fluid  # noqa: F401 (registers lowerings)
     from paddle_tpu.core import flags as _flags

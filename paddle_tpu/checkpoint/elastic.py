@@ -152,16 +152,29 @@ class ElasticController:
     fleet-health plane and the master's dead-requeue consume) and
     reports, per role, who is ALIVE — the input to a grow/shrink
     decision against a target size.  Deciding is cheap and read-only;
-    *acting* is the caller's move (start workers pointed at the
-    checkpoint root / retire leases), with the checkpoint plane making
-    the action safe."""
+    *acting* is the caller's move (the ``distributed.supervisor``
+    actuator, or an operator starting workers pointed at the checkpoint
+    root / retiring leases), with the checkpoint plane making the
+    action safe.
 
-    def __init__(self, registry_ep: str, poll_ttl: float = 2.0):
+    ``hysteresis``: flap damping — a non-hold decision requires that
+    many CONSECUTIVE same-direction observations before it fires.  A
+    worker blinking SUSPECT→DEAD→HEALTHY across one missed lease term
+    must not trigger a grow (and then a shrink when it reappears): one
+    divergent observation resets the streak, so only a condition that
+    persists across the window acts.  The default of 1 keeps the old
+    immediate behavior."""
+
+    def __init__(self, registry_ep: str, poll_ttl: float = 2.0,
+                 hysteresis: int = 1):
         from ..distributed import transport as _transport
         self.registry_ep = registry_ep
         self.poll_ttl = poll_ttl
+        self.hysteresis = max(1, int(hysteresis))
         self._client = _transport.RPCClient(0)
         self._cache = {"t": float("-inf"), "table": {}}
+        # per-role [direction, consecutive observations] streak
+        self._streak: Dict[str, list] = {}
 
     def fleet_view(self, refresh: bool = False) -> Dict[str, dict]:
         """{worker: {state, role, ...}} from the registry health table,
@@ -184,10 +197,31 @@ class ElasticController:
     def decide(self, role: str, target: int) -> dict:
         """Grow/shrink recommendation for ``role`` against ``target``
         live workers: {"action": "grow"|"shrink"|"hold", "delta": n,
-        "alive": [...]}."""
+        "alive": [...], "raw": the undamped direction, "streak": how
+        many consecutive observations agreed, "needed": hysteresis}.
+        Each call is one observation; ``action`` stays "hold" until
+        ``hysteresis`` consecutive calls agree on a direction."""
         alive = self.alive(role)
+        obs_t = self._cache["t"]
         n = len(alive)
-        action = "hold" if n == target else ("grow" if n < target
-                                             else "shrink")
-        return {"action": action, "delta": abs(target - n),
+        raw = "hold" if n == target else ("grow" if n < target
+                                          else "shrink")
+        if raw == "hold":
+            self._streak.pop(role, None)
+            streak = 0
+        else:
+            st = self._streak.get(role)
+            if st is not None and st[0] == raw:
+                # a repeated decide against the SAME cached table is the
+                # same observation — only a fresh poll extends the streak
+                if obs_t != st[2]:
+                    st[1] += 1
+                    st[2] = obs_t
+            else:
+                st = [raw, 1, obs_t]
+                self._streak[role] = st
+            streak = st[1]
+        action = raw if streak >= self.hysteresis else "hold"
+        return {"action": action, "raw": raw, "streak": streak,
+                "needed": self.hysteresis, "delta": abs(target - n),
                 "alive": alive, "target": target}

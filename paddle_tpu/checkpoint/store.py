@@ -78,7 +78,16 @@ def atomic_file_write(path: str, write_fn) -> None:
     name, and the tmp is reaped on a failed write (an orphan here would
     ride a commit rename into a final step directory forever).
     ``write_fn(f)`` writes to the open binary file.  Shared with io.py's
-    save paths so the crash-safety invariant has one implementation."""
+    save paths so the crash-safety invariant has one implementation.
+
+    Carries the chaos suite's ``ckpt_write`` fault-injection site: a
+    ``diskfull``/``io_err`` rule raises the corresponding ``OSError``
+    here — exactly where a real ENOSPC/EIO would surface — so the
+    write-path error handling (snapshotter fault accounting, the
+    previous COMPLETE step staying authoritative) is exercised against
+    the real failure path."""
+    from ..distributed import faults as _faults
+    _faults.io_fault("ckpt_write")
     tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
     try:
         with open(tmp, "wb") as f:

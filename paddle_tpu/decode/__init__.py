@@ -52,12 +52,14 @@ from .engine import (DecodeEngine, DecodeHandle,  # noqa: F401
 from .server import DecodeServer, DecodeService  # noqa: F401
 from .client import DecodeClient  # noqa: F401
 from ..contrib.decoder import IncrementalBeamDecoder  # noqa: F401
-from ..serving.batcher import Overloaded, RequestTooLong  # noqa: F401
+from ..serving.batcher import (Draining, Overloaded,  # noqa: F401
+                               RequestTooLong)
 
 __all__ = [
     "BlockAllocator", "PagedKVCache",
     "LMConfig", "TransformerLM", "save_lm", "load_lm",
     "DecodeEngine", "DecodeHandle", "DecodeRequest", "SamplingParams",
     "DecodeServer", "DecodeService", "DecodeClient",
-    "IncrementalBeamDecoder", "Overloaded", "RequestTooLong",
+    "IncrementalBeamDecoder", "Draining", "Overloaded",
+    "RequestTooLong",
 ]

@@ -27,7 +27,7 @@ import numpy as np
 from . import server as _server
 from ..distributed import registry as _dist_registry
 from ..distributed import serde, transport
-from ..serving.batcher import Overloaded, RequestTooLong
+from ..serving.batcher import Draining, Overloaded, RequestTooLong
 
 
 class DecodeClient:
@@ -107,6 +107,9 @@ class DecodeClient:
             except Overloaded as e:
                 last_exc = e   # another replica may have slot headroom
                 continue
+            except Draining as e:
+                last_exc = e   # graceful shutdown straggler: rotate
+                continue
             return self._relay(first, stream)
         raise last_exc if last_exc is not None else RuntimeError(
             f"no decode replica answered for {model!r}")
@@ -181,6 +184,9 @@ class DecodeClient:
                             json.loads(bytes(rest).decode("utf-8")))
                     elif tag == _server._TAG_TOO_LONG:
                         raise RequestTooLong.from_dict(
+                            json.loads(bytes(rest).decode("utf-8")))
+                    elif tag == _server._TAG_DRAINING:
+                        raise Draining.from_dict(
                             json.loads(bytes(rest).decode("utf-8")))
                     else:
                         raise RuntimeError(

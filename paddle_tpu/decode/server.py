@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -40,7 +41,8 @@ import numpy as np
 from .engine import DecodeEngine, SamplingParams
 from ..distributed import registry as _registry
 from ..distributed import serde, transport
-from ..serving.batcher import Overloaded, RequestTooLong
+from ..observability import flight as _flight
+from ..serving.batcher import Draining, Overloaded, RequestTooLong
 
 # one msg-type namespace across every service: transport 1-14,
 # master 15-20, serving 21/22, observability 24/25 — decode takes 23/26
@@ -54,6 +56,7 @@ _TAG_TOKENS = b"T"
 _TAG_FIN = b"F"
 _TAG_OVERLOAD = b"O"
 _TAG_TOO_LONG = b"L"
+_TAG_DRAINING = b"D"
 
 
 def replica_key(model: str, replica_id: str) -> str:
@@ -75,9 +78,18 @@ class DecodeService:
 
     def __init__(self, engines: Dict[str, DecodeEngine]):
         self.engines = dict(engines)
+        # graceful drain: once set, new DECODE submits get a typed
+        # Draining reply (the leases are already deregistered); the
+        # streams already running keep generating to their FIN
+        self.draining = False
+        self.endpoint = ""
 
     def handle(self, msg_type, trainer_id, name, payload):
         if msg_type == DECODE:
+            if self.draining:
+                e = Draining(name, self.endpoint)
+                return transport.OK, [
+                    _TAG_DRAINING + json.dumps(e.to_dict()).encode("utf-8")]
             body = json.loads(bytes(payload).decode("utf-8"))
             eng = self.engines.get(name)
             if eng is None:
@@ -182,18 +194,70 @@ class DecodeServer:
     def start(self) -> None:
         self._server.start()
         self._started = True
+        self.service.endpoint = self.endpoint
         self._sync_announcements()
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False, drain_timeout: float = 60.0
+             ) -> None:
+        """Shut the replica down.  ``drain=True`` is the graceful
+        sequence (the serving plane's discipline, stream-shaped):
+        deregister the leases FIRST so clients discover away from this
+        replica before the socket dies, answer straggler submits with a
+        typed :class:`Draining` reply, let every in-flight stream
+        generate to its FIN within ``drain_timeout``, then close."""
         self._started = False
         with self._hb_lock:
             hbs, self._heartbeats = dict(self._heartbeats), {}
         for hb in hbs.values():
             hb.stop(bye=True)
+        if drain:
+            self.service.draining = True
+            deadline = time.monotonic() + drain_timeout
+            for name, eng in sorted(self.engines.items()):
+                left = max(0.1, deadline - time.monotonic())
+                if not eng.drain(timeout=left):
+                    _flight.note("decode_drain_timeout", model=name,
+                                 endpoint=self.endpoint)
         self._server.stop()
         if self._own_engines:
             for eng in self.engines.values():
                 eng.close()
+
+    def install_sigterm_drain(self, drain_timeout: float = 60.0) -> None:
+        """Arm SIGTERM as the graceful-drain trigger (what a supervisor
+        shrink or an orchestrator rolling restart sends).  The handler
+        runs :meth:`stop(drain=True)` on a daemon thread — signal
+        handlers must return fast — and only AFTER the drain completes
+        re-delivers SIGTERM under the PREVIOUS disposition, so the
+        flight recorder's dump-then-die handler (or plain default
+        termination) still runs, but post-drain instead of cutting the
+        streams it was about to dump.  The previous disposition is
+        restored immediately in the handler, so a SECOND SIGTERM during
+        the drain escalates to the old immediate behavior.  Main
+        thread only (signal module contract)."""
+        import os as _os
+        import signal as _signal
+
+        prev = _signal.getsignal(_signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            _flight.note("decode_sigterm_drain", endpoint=self.endpoint)
+            # restore FIRST (handlers may only be set from the main
+            # thread — the drain thread can't do it later)
+            _signal.signal(_signal.SIGTERM, prev)
+
+            def _drain_then_exit():
+                try:
+                    self.stop(drain=True, drain_timeout=drain_timeout)
+                finally:
+                    # hand the signal to its original disposition:
+                    # flight dump + death, or default termination
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+
+            threading.Thread(target=_drain_then_exit, daemon=True,
+                             name="decode-drain").start()
+
+        _signal.signal(_signal.SIGTERM, _on_term)
 
     # -- registry announce -------------------------------------------------
     def _model_health(self, model: str):

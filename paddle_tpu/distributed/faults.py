@@ -22,11 +22,19 @@ kinds
                       "kill primary pserver after N batches" scenario.
     ``refuse_accept`` server: close every new connection immediately
                       (accept-then-slam), bounded by ``for_s``/``times``.
+    ``diskfull``      file-write hook (``io_fault``): raise
+                      ``OSError(ENOSPC)`` at a matching write — the
+                      disk filling up mid-snapshot (the checkpoint
+                      store's two-phase commit must leave the previous
+                      COMPLETE step authoritative).
+    ``io_err``        file-write hook: raise ``OSError(EIO)`` — a dying
+                      disk / dead mount at a matching write.
 
 target
     an RPC message name (``send_vars``, ``batch_barrier``, ``get_task``,
     ...), a loop event (``apply_round``, ``apply_async``,
-    ``lease_grant``), or ``*`` / empty for any.
+    ``lease_grant``), a file-write site (``ckpt_write`` — every
+    checkpoint-store / io.py atomic write), or ``*`` / empty for any.
 
 params
     ``n=N``      trigger from the Nth matching hit (default 1)
@@ -64,7 +72,12 @@ DROP_CONN = "drop_conn"
 DELAY = "delay"
 KILL_AFTER = "kill_after"
 REFUSE_ACCEPT = "refuse_accept"
-_KINDS = (DROP_CONN, DELAY, KILL_AFTER, REFUSE_ACCEPT)
+DISKFULL = "diskfull"
+IO_ERR = "io_err"
+_KINDS = (DROP_CONN, DELAY, KILL_AFTER, REFUSE_ACCEPT, DISKFULL, IO_ERR)
+# kinds the file-write hook honors (a wildcard drop_conn rule must not
+# be consumed — or fired — by a write site it can't apply to)
+_IO_KINDS = (DISKFULL, IO_ERR, DELAY, KILL_AFTER)
 
 _lock = threading.Lock()
 _runtime_rules: List["Rule"] = []
@@ -208,6 +221,10 @@ def _match(target: str, side: str) -> Optional[Rule]:
     with _lock:
         rules = list(_runtime_rules)
     for r in rules + _flag_rules():
+        # write-site-only kinds never fire (or burn their budget) on
+        # wire/event hooks — io_fault is their only dispatcher
+        if r.kind in (DISKFULL, IO_ERR):
+            continue
         if r.matches(target, side, now) and r.fire():
             return r
     return None
@@ -278,6 +295,38 @@ def _apply(rule: Rule, target: str) -> Optional[str]:
     if rule.kind in (DROP_CONN, REFUSE_ACCEPT):
         return DROP_CONN
     return None  # pragma: no cover - all kinds handled
+
+
+def io_fault(target: str) -> None:
+    """Hook at a file-write site (the checkpoint store's atomic-write
+    discipline, shared with io.py saves).  A matching ``diskfull`` /
+    ``io_err`` rule RAISES the corresponding ``OSError`` (errno ENOSPC
+    / EIO) exactly where a real write error would surface, so the
+    caller's fault handling — counted fault, flight note, previous
+    COMPLETE step stays authoritative — is exercised against the real
+    error path, not a mock.  ``delay``/``kill_after`` rules also honor
+    write targets (a slow disk, a crash mid-write)."""
+    if not active():
+        return
+    import errno
+    now = time.monotonic()
+    with _lock:
+        rules = list(_runtime_rules)
+    for r in rules + _flag_rules():
+        if r.kind in _IO_KINDS and r.matches(target, "server", now) \
+                and r.fire():
+            if r.kind == DISKFULL:
+                _fired(r, target)
+                raise OSError(errno.ENOSPC,
+                              "No space left on device (injected fault)",
+                              target)
+            if r.kind == IO_ERR:
+                _fired(r, target)
+                raise OSError(errno.EIO,
+                              "Input/output error (injected fault)",
+                              target)
+            _apply(r, target)   # delay sleeps in place; kill never returns
+            return
 
 
 def accept_fault() -> bool:

@@ -28,6 +28,10 @@ Endpoints (all GET):
 - ``/servingz`` the model-serving plane (``paddle_tpu/serving``): per
   in-process ModelServer, the version router plus per-model QPS,
   queue-depth, batch-occupancy, shed and latency-percentile gauges.
+- ``/fleetz``  the fleet-supervisor plane (``distributed/supervisor``):
+  per-worker lifecycle state machine + restart budgets, with query
+  params as the admin surface (resize/drain/resume/cut —
+  ``tools/fleet.py`` is the CLI).
 
 Built on stdlib ``http.server`` (ThreadingHTTPServer, daemon threads):
 no new dependencies, safe to leave running in tests and serving
@@ -61,6 +65,11 @@ _servingz: Dict[str, Callable[[], object]] = {}
 # /decodez sources: one per in-process DecodeEngine (keyed by model
 # name), each fn() returning that engine's slots/cache/queue gauges
 _decodez: Dict[str, Callable[[], object]] = {}
+# /fleetz sources: one per in-process fleet Supervisor (keyed by fleet
+# name): (status_fn, admin_fn) — status_fn() returns the per-worker
+# state-machine card, admin_fn(cmd_dict) applies resize/drain/resume/
+# cut mutations (the tools/fleet.py surface)
+_fleetz: Dict[str, tuple] = {}
 
 
 def register_provider(name: str, fn: Callable[[], object]) -> None:
@@ -125,6 +134,67 @@ def _decodez_payload() -> dict:
         except Exception as e:  # one broken engine must not 500 the page
             out[name] = {"error": repr(e)[:200]}
     return out
+
+
+def register_fleetz(name: str, status_fn: Callable[[], object],
+                    admin_fn: Optional[Callable[[dict], object]] = None
+                    ) -> None:
+    """Add a /fleetz source (a Supervisor's ``status``/``_admin``).
+    Re-registering a name replaces it (latest owner wins)."""
+    with _lock:
+        _fleetz[name] = (status_fn, admin_fn)
+
+
+def unregister_fleetz(name: str) -> None:
+    with _lock:
+        _fleetz.pop(name, None)
+
+
+def _fleetz_payload(query: str = "") -> tuple:
+    """(status_code, payload) for /fleetz.  A bare GET lists every
+    fleet's worker state machine; query params mutate — ``?resize=
+    role:count``, ``?drain=worker``, ``?resume=[role]``, ``?cut=1
+    [&wait=s]`` (``&fleet=name`` picks one when several run)."""
+    from urllib.parse import parse_qs
+    # keep_blank_values: the documented bare "?resume=" form must act,
+    # not silently fall through to the status listing
+    q = {k: v[0] for k, v in parse_qs(query,
+                                      keep_blank_values=True).items()}
+    with _lock:
+        sources = dict(_fleetz)
+    if not sources:
+        return 200, {"fleet": "no supervisor registered in this process"}
+    target = q.pop("fleet", None)
+    cmd = {k: v for k, v in q.items()
+           if k in ("resize", "drain", "resume", "cut", "wait")}
+    # "wait" only modifies "cut" — alone it must not select the admin
+    # path (a bare ?wait=30 falls through to the status listing)
+    if any(k in cmd for k in ("resize", "drain", "resume", "cut")):
+        if target is None and len(sources) > 1:
+            return 400, {"error": "several fleets registered; pass "
+                                  "&fleet=<name>",
+                         "fleets": sorted(sources)}
+        name = target if target is not None else next(iter(sources))
+        ent = sources.get(name)
+        if ent is None:
+            return 404, {"error": f"no fleet {name!r}",
+                         "fleets": sorted(sources)}
+        _, admin_fn = ent
+        if admin_fn is None:
+            return 400, {"error": f"fleet {name!r} is read-only"}
+        try:
+            return 200, {name: admin_fn(cmd)}
+        except Exception as e:
+            return 400, {"error": repr(e)[:400]}
+    out = {}
+    for name, (status_fn, _) in sorted(sources.items()):
+        if target is not None and name != target:
+            continue
+        try:
+            out[name] = status_fn()
+        except Exception as e:  # one broken fleet must not 500 the page
+            out[name] = {"error": repr(e)[:200]}
+    return 200, out
 
 
 def set_role(role: Optional[str]) -> None:
@@ -275,6 +345,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, json.dumps(_decodez_payload(), indent=2,
                                             default=repr),
                             "application/json")
+            elif path == "/fleetz":
+                # the fleet-supervisor debug page: per-worker lifecycle
+                # state machine (STARTING→LIVE→DRAINING→DEAD→REPLACING)
+                # + restart budgets; query params resize/drain/resume/
+                # cut a running fleet (tools/fleet.py is the CLI)
+                code, payload = _fleetz_payload(query)
+                self._reply(code, json.dumps(payload, indent=2,
+                                             default=repr),
+                            "application/json")
             elif path == "/chaosz":
                 # fault-injection control plane (distributed/faults.py):
                 # ?inject=<spec> arms rules, ?clear=1 removes runtime
@@ -309,6 +388,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "/servingz  (model-server router + batching gauges)",
                      "/decodez  (decode engines: slots, paged cache, "
                      "queue)",
+                     "/fleetz  (supervised fleet state machine; "
+                     "?resize=role:n ?drain=w ?resume= ?cut=1)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),
                     "text/plain")
             else:

@@ -36,12 +36,12 @@ instantiates a server/batcher gets no new sockets, threads, or behavior.
 """
 from __future__ import annotations
 
-from .batcher import (BucketLadder, DynamicBatcher,  # noqa: F401
-                      Overloaded, RequestTooLong)
+from .batcher import (BucketLadder, Draining,  # noqa: F401
+                      DynamicBatcher, Overloaded, RequestTooLong)
 from .model_registry import ModelManager, ServedModel  # noqa: F401
 from .server import ModelServer, ServingService  # noqa: F401
 from .client import ServingClient  # noqa: F401
 
-__all__ = ["BucketLadder", "DynamicBatcher", "Overloaded",
+__all__ = ["BucketLadder", "Draining", "DynamicBatcher", "Overloaded",
            "RequestTooLong", "ModelManager", "ServedModel",
            "ModelServer", "ServingService", "ServingClient"]

@@ -82,6 +82,34 @@ class Overloaded(RuntimeError):
                    d.get("slo_ms"))
 
 
+class Draining(RuntimeError):
+    """Typed graceful-shutdown rejection: the request was NOT queued.
+
+    A replica that is draining (``ModelServer.stop(drain=True)`` /
+    ``DecodeServer`` SIGTERM) has already DEREGISTERED its registry
+    lease — discovery-based clients fail over before the socket ever
+    dies — and answers any straggler submit with this instead of
+    accepting work it would have to abandon.  In-flight requests still
+    finish inside the drain bound.  Carried over the wire like
+    :class:`Overloaded`; clients rotate to another replica (unlike
+    :class:`RequestTooLong`, some other replica WILL take it)."""
+
+    def __init__(self, model: str, endpoint: str = ""):
+        self.model = model
+        self.endpoint = endpoint
+        where = f" at {endpoint}" if endpoint else ""
+        super().__init__(
+            f"model {model!r} replica{where} is draining (graceful "
+            "shutdown); retry another replica")
+
+    def to_dict(self) -> dict:
+        return {"model": self.model, "endpoint": self.endpoint}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Draining":
+        return cls(d.get("model", "?"), d.get("endpoint", ""))
+
+
 class RequestTooLong(ValueError):
     """Typed over-length rejection: the request was NOT queued.
 

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .batcher import Overloaded, RequestTooLong
+from .batcher import Draining, Overloaded, RequestTooLong
 from . import server as _server
 from ..distributed import registry as _dist_registry
 from ..distributed import serde, transport
@@ -135,6 +135,14 @@ class ServingClient:
                 last_exc = Overloaded.from_dict(
                     json.loads(bytes(rest).decode("utf-8")))
                 continue  # another replica may have headroom
+            if tag == _server._TAG_DRAINING:
+                # graceful shutdown straggler: the replica already
+                # deregistered — bench it so the next refresh window
+                # doesn't re-route here, and rotate NOW
+                self._bench(ep)
+                last_exc = Draining.from_dict(
+                    json.loads(bytes(rest).decode("utf-8")))
+                continue
             if tag == _server._TAG_TOO_LONG:
                 # terminal: every replica enforces the same max_seq_len,
                 # so failing over would just repeat the rejection

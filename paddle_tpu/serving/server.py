@@ -34,9 +34,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, Optional
 
-from .batcher import Overloaded, RequestTooLong
+from ..observability import flight as _flight
+
+from .batcher import Draining, Overloaded, RequestTooLong
 from .model_registry import ModelManager
 from ..distributed import registry as _registry
 from ..distributed import serde, transport
@@ -54,6 +57,7 @@ transport.MSG_NAMES.update({INFER: "infer",
 _TAG_RESULT = b"R"
 _TAG_OVERLOAD = b"O"
 _TAG_TOO_LONG = b"L"
+_TAG_DRAINING = b"D"
 
 
 def replica_key(model: str, replica_id: str) -> str:
@@ -72,13 +76,24 @@ def parse_replica_key(logical: str):
 class ServingService:
     """``handle()`` contract of transport.RPCServer services."""
 
-    def __init__(self, manager: ModelManager, on_change=None):
+    def __init__(self, manager: ModelManager, on_change=None,
+                 endpoint: str = ""):
         self.manager = manager
         # server hook: re-announce registry leases after admin changes
         self._on_change = on_change
+        self.endpoint = endpoint
+        # graceful drain: once set, new INFERs get a typed Draining
+        # reply (the lease is already deregistered — only stragglers
+        # racing the deregistration land here) while accepted requests
+        # keep flowing to completion
+        self.draining = False
 
     def handle(self, msg_type, trainer_id, name, payload):
         if msg_type == INFER:
+            if self.draining:
+                e = Draining(name, self.endpoint)
+                return transport.OK, [
+                    _TAG_DRAINING + json.dumps(e.to_dict()).encode("utf-8")]
             feed = dict(serde.loads_batch(payload, copy=False))
             try:
                 fut, sm = self.manager.serve_request(name, feed)
@@ -172,11 +187,27 @@ class ModelServer:
     def start(self) -> None:
         self._server.start()
         self._started = True
+        self.service.endpoint = self.endpoint
         _debug_server.register_servingz(self.endpoint,
                                         self.manager.servingz)
         self._sync_announcements()
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False, drain_timeout: float = 30.0
+             ) -> None:
+        """Shut the replica down.  ``drain=True`` is the graceful
+        sequence — ordered so a discovery-based client NEVER loses a
+        request to the shutdown:
+
+        1. deregister the registry leases FIRST (``bye=True``): clients
+           fail over to the remaining replicas before this socket dies;
+        2. flip the service to draining: a straggler INFER that raced
+           the deregistration gets a typed :class:`Draining` reply (it
+           rotates, like Overloaded) instead of being accepted into a
+           batcher about to close;
+        3. finish every in-flight batch within ``drain_timeout`` (the
+           batcher drain gate), THEN close the socket and the manager.
+
+        ``drain=False`` keeps the old immediate-stop behavior."""
         # before draining the heartbeats: an admin-swap handler thread
         # finishing after stop() calls _sync_announcements, which must
         # not re-announce leases for a dead server
@@ -185,6 +216,15 @@ class ModelServer:
             hbs, self._heartbeats = dict(self._heartbeats), {}
         for hb in hbs.values():
             hb.stop(bye=True)
+        if drain:
+            self.service.draining = True
+            deadline = time.monotonic() + drain_timeout
+            for sm in self.manager.models():
+                left = max(0.1, deadline - time.monotonic())
+                if not sm.batcher.drain(timeout=left):
+                    _flight.note("serving_drain_timeout",
+                                 model=f"{sm.name}@{sm.version}",
+                                 endpoint=self.endpoint)
         _debug_server.unregister_servingz(self.endpoint)
         self._server.stop()
         if self._own_manager:

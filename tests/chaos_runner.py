@@ -101,6 +101,15 @@ def main():
         exe.run(t.get_startup_program(ep), scope=scope)
         ps_prog = (t.get_backup_program(ep) if role == "BACKUP"
                    else t.get_pserver_program(ep))
+        # supervised fleets: PADDLE_BIND_ENDPOINT (e.g. "127.0.0.1:0")
+        # binds an EPHEMERAL port while keeping the logical identity —
+        # the heartbeat announces logical -> real port through the
+        # registry, so replacements never race for a released port
+        bind = os.environ.get("PADDLE_BIND_ENDPOINT")
+        if bind:
+            for op in ps_prog.global_block.ops:
+                if op.type == "listen_and_serv":
+                    op.attrs["bind_endpoint"] = bind
         try:
             exe.run(ps_prog, scope=scope)
         finally:
@@ -109,11 +118,19 @@ def main():
 
     # TRAINER
     tp = t.get_trainer_program()
-    n_steps = int(os.environ.get("DIST_STEPS", "20"))
     # elastic-resume phase window: steps [start, start + n_steps) of a
     # DIST_TOTAL_STEPS-long deterministic batch stream (a resized
-    # trainer resumes from the checkpoint's cut over the same data)
+    # trainer resumes from the checkpoint's cut over the same data).
+    # DIST_STEPS unset with DIST_TOTAL_STEPS set = "run to the end"
+    # (the supervisor's restart path only knows the resume step)
     start = int(os.environ.get("DIST_START_STEP", "0"))
+    steps_env = os.environ.get("DIST_STEPS")
+    if steps_env:
+        n_steps = int(steps_env)
+    elif os.environ.get("DIST_TOTAL_STEPS"):
+        n_steps = int(os.environ["DIST_TOTAL_STEPS"]) - start
+    else:
+        n_steps = 20
     if start > 0:
         # resuming mid-run: pull the LIVE (checkpoint-restored) params
         # from the pservers instead of fresh local init — the joining-

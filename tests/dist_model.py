@@ -61,18 +61,12 @@ def run_local(n_steps, optimizer="sgd", decay=False, build_fn=None):
 
 
 def free_ports(n):
-    """Allocate n distinct free localhost ports (bind-to-0 then release)."""
-    import socket
-
-    socks = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+    """Allocate n distinct free localhost ports — delegates to THE
+    shared ephemeral-port helper (paddle_tpu.distributed.supervisor
+    .free_ports) so every runner/test uses one implementation instead
+    of rolling its own colliding allocator."""
+    from paddle_tpu.distributed.supervisor import free_ports as _fp
+    return _fp(n)
 
 
 def retry_flaky(times=2):

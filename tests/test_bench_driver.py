@@ -357,3 +357,37 @@ def test_deepfm_fused_headline_wired_into_compare_gate():
     assert "fused_samples_per_sec" in bench_compare.METRIC_KEYS
     names = [n for n, _, _, _ in bench.CONFIG_TABLE]
     assert "deepfm_fused" in names
+
+
+def test_recovery_headline_wired_into_compare_gate():
+    """ISSUE 14 satellite: the recovery config's MTTR headline is a
+    bench_compare METRIC_KEY with lower-is-better RELATIVE semantics
+    (seconds, not a fraction: 3 s -> 4 s must classify as a regression,
+    3.0 -> 2.9 as within-noise), and the config is registered with the
+    orchestrator."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import bench_compare
+
+    import bench
+
+    assert "recovery_mttr_s" in bench_compare.METRIC_KEYS
+    assert "recovery_mttr_s" in bench_compare.LOWER_BETTER_KEYS
+    names = [n for n, _, _, _ in bench.CONFIG_TABLE]
+    assert "recovery" in names
+
+    def rnd(v):
+        return {"configs": {"recovery": {"recovery_mttr_s": v}}}
+
+    worse = bench_compare.compare(rnd(3.0), rnd(4.0))
+    assert worse["configs"]["recovery"]["status"] == "regression"
+    better = bench_compare.compare(rnd(3.0), rnd(2.0))
+    assert better["configs"]["recovery"]["status"] == "improvement"
+    noise = bench_compare.compare(rnd(3.0), rnd(2.9))
+    assert noise["configs"]["recovery"]["status"] == "within_noise"
+    # the fraction key keeps its absolute-delta discipline (a 0.0
+    # baseline stays legitimate and comparable)
+    frac = bench_compare.compare(
+        {"configs": {"checkpoint": {"ckpt_overhead_frac": 0.0}}},
+        {"configs": {"checkpoint": {"ckpt_overhead_frac": 0.02}}})
+    assert frac["configs"]["checkpoint"]["status"] == "within_noise"

@@ -462,3 +462,87 @@ def test_batcher_max_seq_len_dict_scopes_to_named_feeds():
                       "features": np.zeros((1, 256), np.float32)})
     finally:
         b.close()
+
+
+def test_decode_drain_finishes_streams_and_rejects_stragglers():
+    """ISSUE 14 satellite: DecodeServer graceful drain — the lease
+    deregisters FIRST, an in-flight stream generates all the way to
+    its FIN inside the drain bound (zero dropped tokens), a straggler
+    submit racing the drain gets a typed Draining reply, and SIGTERM
+    is wired as the drain trigger."""
+    import os
+    import signal as _signal
+    import threading
+    import time
+    from paddle_tpu.decode import Draining
+    from paddle_tpu.distributed import registry as reg_mod
+    from paddle_tpu.distributed import transport
+    from paddle_tpu.distributed.registry import RegistryServer
+
+    reg = RegistryServer("127.0.0.1:0")
+    reg.start()
+    reg_ep = f"127.0.0.1:{reg.port}"
+    lm, params, eng = _engine("drainy")
+    srv = DecodeServer(engines={"drainy": eng}, registry_ep=reg_ep,
+                       replica_id="r0", lease_ttl=1.0)
+    srv.start()
+    done = {}
+    try:
+        cli = DecodeClient(endpoints=[srv.endpoint])
+        # reference decode of the same prompt on an undisturbed run
+        want = cli.generate("drainy", [1, 2, 3], max_new_tokens=12)
+
+        def long_stream():
+            done["fin"] = cli.generate("drainy", [1, 2, 3],
+                                       max_new_tokens=12)
+        t = threading.Thread(target=long_stream)
+        t.start()
+        time.sleep(0.05)                 # stream admitted + running
+        # SIGTERM = the drain trigger (supervisor shrink / rolling
+        # restart); handler chains and runs stop(drain=True) async
+        prev = _signal.getsignal(_signal.SIGTERM)
+        chained = []
+        _signal.signal(_signal.SIGTERM,
+                       lambda s, f: chained.append(s))
+        srv.install_sigterm_drain(drain_timeout=30.0)
+        os.kill(os.getpid(), _signal.SIGTERM)
+        try:
+            deadline = time.monotonic() + 10
+            while not srv.service.draining \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert srv.service.draining
+            # (the previous disposition only fires AFTER the drain —
+            # asserted below once the stream is known complete; the
+            # tiny model can finish its whole stream inside the poll
+            # granularity, so no mid-drain emptiness check here)
+            # lease deregistered FIRST: discovery routes away while the
+            # stream still generates
+            snap = reg_mod.fetch_snapshot(transport.RPCClient(0), reg_ep)
+            assert "decode/drainy/r0" not in snap["leases"]
+            # straggler racing the drain: typed rejection, not a hang
+            if eng.drain(timeout=0.0):
+                pass   # stream already finished: nothing to race
+            else:
+                with pytest.raises(Draining) as ei:
+                    DecodeClient(endpoints=[srv.endpoint]).generate(
+                        "drainy", [4, 5], max_new_tokens=2)
+                assert ei.value.model == "drainy"
+            t.join(timeout=30)
+            assert done["fin"]["tokens"] == want["tokens"]
+            assert done["fin"]["finish"] == "length"
+            # AFTER the drain completes, SIGTERM is re-delivered under
+            # the previous disposition (here: the benign test handler —
+            # in production: the flight recorder's dump-then-die)
+            deadline = time.monotonic() + 15
+            while not chained and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert chained == [_signal.SIGTERM]
+        finally:
+            _signal.signal(_signal.SIGTERM, prev)
+        # the drain thread closes the server; wait for it
+        deadline = time.monotonic() + 15
+        while srv._started and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        reg.stop()

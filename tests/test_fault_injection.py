@@ -408,3 +408,98 @@ def test_demoted_master_steps_down_and_rejoins_as_standby():
                 except Exception:
                     pass
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14 satellite: write-path fault rules (diskfull / io_err)
+# ---------------------------------------------------------------------------
+
+def test_io_fault_grammar_and_errno():
+    """diskfull/io_err parse like any rule and raise the REAL OSError
+    (errno ENOSPC / EIO) at the write hook — and only there: the wire/
+    event hooks never fire (or consume) a write-site-only rule."""
+    import errno
+
+    (r,) = faults.parse("diskfull:ckpt_write:n=2")
+    assert r.kind == "diskfull" and r.n == 2
+    faults.inject("diskfull:ckpt_write")
+    try:
+        with pytest.raises(OSError) as ei:
+            faults.io_fault("ckpt_write")
+        assert ei.value.errno == errno.ENOSPC
+        # other targets untouched
+        faults.io_fault("other_write")
+    finally:
+        faults.clear()
+    faults.inject("io_err:ckpt_write")
+    try:
+        with pytest.raises(OSError) as ei:
+            faults.io_fault("ckpt_write")
+        assert ei.value.errno == errno.EIO
+        # the wire hook must NOT consume a write-site rule...
+        assert faults.server_fault("ckpt_write") is None
+        # ...so it still fires at the write hook afterwards
+        with pytest.raises(OSError):
+            faults.io_fault("ckpt_write")
+    finally:
+        faults.clear()
+
+
+def test_enospc_mid_snapshot_is_counted_and_previous_step_survives(
+        tmp_path):
+    """The chaos pin ISSUE 14 names: an ENOSPC raised MID-SNAPSHOT
+    (second atomic write = the manifest piece, so the shard file
+    already landed) is a counted checkpoint fault + flight note, the
+    step never commits, and the PREVIOUS COMPLETE step stays fully
+    restorable — the first real write-path exercise of the two-phase
+    commit (kills only, before this)."""
+    import numpy as np
+    import paddle_tpu.checkpoint as pckpt
+    from paddle_tpu.observability import flight
+
+    root = str(tmp_path / "ck")
+    arrays = {"w": np.arange(6, dtype="float32").reshape(3, 2)}
+    snap = pckpt.AsyncSnapshotter(root, "w0", lambda step: dict(arrays),
+                                  expected_writers=["w0"])
+    assert snap.snapshot(1, wait=True)
+    assert pckpt.complete_steps(root) == [1]
+
+    flight.clear_events()
+    faults.inject("diskfull:ckpt_write:n=2")   # the manifest write dies
+    try:
+        assert snap.snapshot(2, wait=True)     # accepted; write faults
+    finally:
+        faults.clear()
+    st = snap.status()
+    assert st["faults"] == 1, st
+    assert "No space" in str(st["fault"]), st
+    notes = [e for e in flight.events() if e["msg"] == "ckpt_fault"]
+    assert notes and notes[0]["phase"] == "write" and notes[0]["step"] == 2
+    # the torn step is invisible; the previous COMPLETE step restores
+    assert pckpt.complete_steps(root) == [1]
+    assert pckpt.verify_step(root, 1)["ok"]
+    got = pckpt.load_vars(root, 1, {"w": (None, None)})
+    np.testing.assert_array_equal(got["w"], arrays["w"])
+    # disk pressure relieved: the NEXT snapshot commits normally
+    assert snap.snapshot(3, wait=True)
+    assert pckpt.complete_steps(root) == [1, 3]
+    snap.close()
+
+
+def test_io_err_on_legacy_io_save_leaves_previous_file(tmp_path):
+    """io.py save paths share the checkpoint store's atomic-write
+    discipline, so io_err rules cover them too: a failed save raises
+    AND the previously-saved file is untouched."""
+    from paddle_tpu.checkpoint.store import atomic_file_write
+
+    path = str(tmp_path / "params.bin")
+    atomic_file_write(path, lambda f: f.write(b"generation-1"))
+    faults.inject("io_err:ckpt_write")
+    try:
+        with pytest.raises(OSError):
+            atomic_file_write(path, lambda f: f.write(b"generation-2"))
+    finally:
+        faults.clear()
+    assert open(path, "rb").read() == b"generation-1"
+    # and no orphaned tmp survived to ride a later commit rename
+    assert [p for p in tmp_path.iterdir()] == [tmp_path / "params.bin"]

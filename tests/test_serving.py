@@ -747,3 +747,111 @@ def test_serving_bench_load_matrix():
     assert swap["dropped"] == 0
     assert swap["drained"]
     assert all(v == 0 for v in swap["recompiles_delta"].values()), swap
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (ISSUE 14 satellite): zero dropped requests
+# ---------------------------------------------------------------------------
+
+def test_drained_replica_drops_zero_requests_under_load():
+    """ModelServer.stop(drain=True) mid-load: the lease deregisters
+    FIRST (discovery clients fail over before the socket dies),
+    straggler submits get a typed Draining (rotate, like Overloaded),
+    in-flight batches finish — and across the whole window not one
+    client request errors or drops."""
+    from paddle_tpu.distributed.registry import RegistryServer
+    from paddle_tpu.serving import Draining
+
+    reg = RegistryServer("127.0.0.1:0")
+    reg.start()
+    reg_ep = f"127.0.0.1:{reg.port}"
+    stubs = [_StubPredictor(delay_s=0.02), _StubPredictor(delay_s=0.02)]
+    srvs = []
+    for i, stub in enumerate(stubs):
+        s = ModelServer("127.0.0.1:0", registry_ep=reg_ep,
+                        replica_id=f"r{i}", lease_ttl=1.0)
+        s.load("mlp", "1", predictor=stub, warm=False, buckets=(1, 2, 4),
+               activate=True, max_delay_ms=1.0)
+        s.start()
+        srvs.append(s)
+    stop = threading.Event()
+    errs, n_ok = [], [0]
+    lock = threading.Lock()
+
+    def client_loop():
+        c = ServingClient(registry_ep=reg_ep, refresh_s=0.1,
+                          cooldown_s=0.2)
+        x = np.ones((1, 8), "float32")
+        while not stop.is_set():
+            try:
+                out = c.infer("mlp", {"x": x})
+                np.testing.assert_array_equal(np.asarray(out[0]), x * 2.0)
+            except Exception as e:  # noqa: BLE001 — ANY error = a drop
+                errs.append(repr(e))
+                return
+            with lock:
+                n_ok[0] += 1
+    threads = [threading.Thread(target=client_loop) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10
+        while n_ok[0] < 30 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert n_ok[0] >= 30, (n_ok, errs)
+        before_drain = n_ok[0]
+        srvs[0].stop(drain=True)          # drain r0 under live load
+        # r0's lease is gone (deregistered first, not aged out)
+        from paddle_tpu.distributed import registry as reg_mod
+        from paddle_tpu.distributed import transport
+        snap = reg_mod.fetch_snapshot(transport.RPCClient(0), reg_ep)
+        assert "serving/mlp/r0" not in snap["leases"], snap["leases"]
+        # traffic keeps flowing on the survivor, still zero errors
+        deadline = time.monotonic() + 10
+        while n_ok[0] < before_drain + 30 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert n_ok[0] >= before_drain + 30, (n_ok, errs)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        for s in srvs[1:]:
+            s.stop()
+        reg.stop()
+    assert errs == [], errs
+    # r0 really served its share before the drain (the drain happened
+    # under load, not after traffic had already moved away)
+    assert stubs[0].calls, "r0 never served — the drain wasn't under load"
+
+
+def test_draining_reply_is_typed_and_inflight_finishes():
+    """The wire pin: a straggler INFER racing the drain gets the typed
+    Draining reply (tag 'D', fields round-tripped), while the request
+    accepted BEFORE the drain completes successfully inside it."""
+    from paddle_tpu.serving import Draining
+
+    stub = _StubPredictor(delay_s=0.6)    # wide drain window
+    srv = ModelServer("127.0.0.1:0")
+    srv.load("mlp", "1", predictor=stub, warm=False, buckets=(1,),
+             activate=True, max_delay_ms=1.0)
+    srv.start()
+    c = ServingClient(endpoints=[srv.endpoint])
+    x = np.ones((1, 8), "float32")
+    inflight = {}
+
+    def one_request():
+        inflight["out"] = np.asarray(c.infer("mlp", {"x": x})[0])
+    t = threading.Thread(target=one_request)
+    t.start()
+    time.sleep(0.2)                       # accepted, now executing
+    drainer = threading.Thread(target=srv.stop,
+                               kwargs={"drain": True})
+    drainer.start()
+    time.sleep(0.1)                       # draining flag is up
+    with pytest.raises(Draining) as ei:
+        ServingClient(endpoints=[srv.endpoint]).infer("mlp", {"x": x})
+    assert ei.value.model == "mlp" and ei.value.endpoint == srv.endpoint
+    t.join(timeout=10)
+    drainer.join(timeout=10)
+    np.testing.assert_array_equal(inflight["out"], x * 2.0)

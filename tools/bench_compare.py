@@ -56,12 +56,19 @@ METRIC_KEYS = (
     "eff_flops",
     "pipeline_vs_link",
     "ckpt_overhead_frac",
+    "recovery_mttr_s",
 )
 
 # cost-style headlines where SMALLER is the good direction (e.g. the
 # async-snapshot step-loop overhead fraction): the delta sign flips for
 # classification, the reported delta stays raw
-LOWER_BETTER_KEYS = frozenset({"ckpt_overhead_frac"})
+LOWER_BETTER_KEYS = frozenset({"ckpt_overhead_frac", "recovery_mttr_s"})
+
+# lower-better keys in ABSOLUTE units (seconds, not a fraction): their
+# delta is relative when the baseline is positive — a 3 s -> 3.5 s MTTR
+# drift is a 17% regression, while fraction keys (legitimately-0.0
+# baselines) keep absolute-delta comparison
+LOWER_BETTER_RELATIVE_KEYS = frozenset({"recovery_mttr_s"})
 
 DEFAULT_THRESHOLD = 0.10
 
@@ -196,9 +203,14 @@ def compare(old: dict, new: dict,
             out["configs"][name] = ent
             continue
         if key in LOWER_BETTER_KEYS:
-            # cost fraction: absolute delta, sign flipped so "delta
-            # below -threshold" still reads regression downstream
-            delta = -(nv - ov)
+            # cost headline: sign flipped so "delta below -threshold"
+            # still reads regression downstream; fractions compare by
+            # absolute delta (0.0 baselines are legitimate), absolute-
+            # unit keys (seconds) relatively when the baseline allows
+            if key in LOWER_BETTER_RELATIVE_KEYS and ov > 0:
+                delta = -(nv - ov) / ov
+            else:
+                delta = -(nv - ov)
         else:
             delta = (nv - ov) / ov
         ent.update({"metric": key, "old": ov, "new": nv,

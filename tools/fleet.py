@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Operate a self-healing fleet from the CLI (the supervisor's surface).
+
+Launch a fleet from a declarative spec file and let the supervisor own
+worker lifecycle — replace dead workers from the newest COMPLETE
+checkpoint, hold on crash loops, drain on shrink::
+
+    python tools/fleet.py launch fleet.json
+    python tools/fleet.py launch fleet.json --debug-port 8080
+
+Administer a RUNNING fleet through its debug server's ``/fleetz`` page
+(the launch above with ``--debug-port``)::
+
+    python tools/fleet.py status  127.0.0.1:8080
+    python tools/fleet.py resize  127.0.0.1:8080 pserver 3
+    python tools/fleet.py drain   127.0.0.1:8080 serving-2
+    python tools/fleet.py resume  127.0.0.1:8080 [role]
+    python tools/fleet.py cut     127.0.0.1:8080 [--wait 30]
+
+Spec file format (JSON; see ``FleetSpec.from_dict``)::
+
+    {
+      "name": "train",
+      "registry": "auto",
+      "checkpoint_root": "/ckpt/run1",
+      "rollback_roles": ["pserver", "trainer"],
+      "hysteresis": 2,
+      "roles": {
+        "pserver": {"count": 2, "logical": "auto",
+                    "health_role": "PSERVER",
+                    "argv": ["python", "worker.py"],
+                    "env": {"PADDLE_CURRENT_ENDPOINT": "{logical}",
+                            "PADDLE_BIND_ENDPOINT": "127.0.0.1:0",
+                            "FLAGS_pserver_registry": "{registry}"},
+                    "restart_budget": 3},
+        "trainer": {"count": 1, "after": ["pserver"], "done_ok": true,
+                    "argv": ["python", "trainer.py"],
+                    "env": {"DIST_START_STEP": "{resume_step}"}}
+      }
+    }
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+__all__ = ["build_parser", "fleetz_request", "main"]
+
+# runnable as `python tools/fleet.py` from anywhere
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fleet.py",
+        description="launch / administer a supervised self-healing fleet")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    launch = sub.add_parser("launch", help="launch a fleet from a spec "
+                                           "file and supervise it")
+    launch.add_argument("spec", help="FleetSpec JSON file")
+    launch.add_argument("--debug-port", type=int, default=0,
+                        help="serve /fleetz (and the rest of the debug "
+                             "plane) on this HTTP port")
+    launch.add_argument("--poll-s", type=float, default=0.2,
+                        help="control-loop tick (default %(default)s)")
+    launch.add_argument("--timeout", type=float, default=0.0,
+                        help="give up after this many seconds "
+                             "(0 = run until done/HOLD/signal)")
+
+    for name, args, help_str in (
+            ("status", (), "print a running fleet's /fleetz card"),
+            ("resize", ("role", "count"),
+             "retarget a role's worker count (stateless grow/drain, or "
+             "cut-then-rollback for rollback roles)"),
+            ("drain", ("worker",), "gracefully drain one worker"),
+            ("resume", (), "lift a crash-loop HOLD"),
+            ("cut", (), "trigger a fleet checkpoint cut")):
+        sp = sub.add_parser(name, help=help_str)
+        sp.add_argument("endpoint", help="debug server host:port of the "
+                                         "supervising process")
+        for a in args:
+            sp.add_argument(a)
+        if name == "resume":
+            sp.add_argument("role", nargs="?", default="all")
+        if name == "cut":
+            sp.add_argument("--wait", type=float, default=0.0,
+                            help="poll the two-phase commit this long")
+        sp.add_argument("--fleet", default=None,
+                        help="fleet name when several run in one process")
+    return p
+
+
+def fleetz_request(endpoint: str, params: dict, timeout: float = 30.0):
+    """One GET against ``http://endpoint/fleetz`` (the admin surface)."""
+    query = urllib.parse.urlencode(
+        {k: v for k, v in params.items() if v is not None})
+    url = f"http://{endpoint}/fleetz" + (f"?{query}" if query else "")
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:  # error payloads are JSON too
+        return json.loads(e.read().decode("utf-8"))
+
+
+def _launch(args) -> int:
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.distributed.supervisor import FleetSpec, Supervisor
+    from paddle_tpu.observability import debug_server
+
+    spec = FleetSpec.from_file(args.spec)
+    if args.debug_port:
+        _flags.set_flags({"debug_server_port": args.debug_port})
+        debug_server.start(port=args.debug_port)
+    sup = Supervisor(spec, poll_s=args.poll_s).start()
+    print(f"[fleet] {spec.name!r} up: registry {sup.registry_ep}, roles "
+          + ", ".join(f"{r}x{s.count}" for r, s in spec.roles.items()),
+          flush=True)
+
+    stop = {"sig": None}
+
+    def _on_signal(signum, frame):
+        stop["sig"] = signum
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+    deadline = time.monotonic() + args.timeout if args.timeout else None
+    verdict = None
+    try:
+        while stop["sig"] is None:
+            verdict = sup.wait(timeout=1.0)
+            if verdict in ("done", "hold"):
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                verdict = "timeout"
+                break
+    finally:
+        status = sup.status()
+        sup.stop()
+    print(json.dumps(status, indent=2, default=repr))
+    if stop["sig"] is not None:
+        print(f"[fleet] stopped on signal {stop['sig']}", flush=True)
+        return 0
+    print(f"[fleet] verdict: {verdict}", flush=True)
+    return {"done": 0, "hold": 3, "timeout": 4}.get(verdict, 1)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "launch":
+        return _launch(args)
+    params = {"fleet": args.fleet}
+    if args.cmd == "resize":
+        params["resize"] = f"{args.role}:{args.count}"
+    elif args.cmd == "drain":
+        params["drain"] = args.worker
+    elif args.cmd == "resume":
+        params["resume"] = args.role
+    elif args.cmd == "cut":
+        params["cut"] = "1"
+        if args.wait:
+            params["wait"] = str(args.wait)
+    out = fleetz_request(args.endpoint, params)
+    print(json.dumps(out, indent=2, default=repr))
+    return 2 if isinstance(out, dict) and "error" in out else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

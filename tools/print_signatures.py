@@ -89,6 +89,12 @@ MODULES = [
     "paddle_tpu.distributed.registry",
     "paddle_tpu.distributed.master",
     "paddle_tpu.distributed.faults",
+    # the self-healing fleet supervisor (FleetSpec grammar, worker
+    # lifecycle state machine, rollback/resize actions) + its operator
+    # CLI: frozen so the spec-file format and admin surface drift
+    # loudly
+    "paddle_tpu.distributed.supervisor",
+    "fleet",        # tools/fleet.py (tools/ is on sys.path here)
     "chaos",        # tools/chaos.py (tools/ is on sys.path here)
     "paddle_tpu.parallel",
     "paddle_tpu.inference",

@@ -165,8 +165,14 @@ def _serve_decode(args) -> int:
         "debug_port": args.debug_port or None}, default=repr), flush=True)
 
     stop = threading.Event()
+    drain = {"requested": False}
 
     def on_signal(signum, frame):
+        # SIGTERM = graceful drain: deregister the lease first, let
+        # in-flight streams generate to their FIN, reject stragglers
+        # with a typed Draining — zero dropped streams on a rolling
+        # restart.  SIGINT stays immediate.
+        drain["requested"] = signum == signal.SIGTERM
         stop.set()
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
@@ -174,8 +180,9 @@ def _serve_decode(args) -> int:
         while not stop.wait(1.0):
             pass
     finally:
-        srv.stop()
-        print("decode server stopped", flush=True)
+        srv.stop(drain=drain["requested"])
+        print("decode server stopped"
+              + (" (drained)" if drain["requested"] else ""), flush=True)
     return 0
 
 
@@ -243,8 +250,13 @@ def main(argv=None) -> int:
         "debug_port": args.debug_port or None}, default=repr), flush=True)
 
     stop = threading.Event()
+    drain = {"requested": False}
 
     def on_signal(signum, frame):
+        # SIGTERM = graceful drain (the supervisor/orchestrator
+        # shutdown path): deregister first, finish in-flight, then
+        # close — zero dropped requests.  SIGINT stays immediate.
+        drain["requested"] = signum == signal.SIGTERM
         stop.set()
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
@@ -252,8 +264,9 @@ def main(argv=None) -> int:
         while not stop.wait(1.0):
             pass
     finally:
-        srv.stop()
-        print("server stopped", flush=True)
+        srv.stop(drain=drain["requested"])
+        print("server stopped"
+              + (" (drained)" if drain["requested"] else ""), flush=True)
     return 0
 
 

@@ -1098,6 +1098,21 @@ def bench_serving():
     with zero dropped requests and zero executor recompiles/misses in
     the post-warm serving window, and the cold vs warm-pool first-reply
     latency shows what the warm ladder buys."""
+    from paddle_tpu.core import flags as _flags
+
+    # latency anatomy rides the measured window: phase attribution is
+    # host-side monotonic stamps (no device syncs), and its per-phase
+    # p99s land in the artifact so a tail regression names its phase
+    # (finally-restored: a mid-bench error must not leave the flag on
+    # to skew every later config in this process)
+    _flags.set_flags({"phase_attribution": True})
+    try:
+        return _bench_serving_inner()
+    finally:
+        _flags.set_flags({"phase_attribution": False})
+
+
+def _bench_serving_inner():
     import threading
 
     from paddle_tpu.serving import ModelManager
@@ -1162,6 +1177,15 @@ def bench_serving():
             "warm_pool": sm.warm_info,
             "dropped": len(seq_err) + len(bat_err),
         }
+        rec = sm.batcher.stats.phases()
+        if rec is not None:
+            # where the batched p99 went: per-phase p99 + the slowest-
+            # phase attribution (queue/assemble/dispatch/device/reply),
+            # from ONE consistent snapshot of the live recorder
+            psnap = rec.snapshot()
+            res["phase_p99_ms"] = {name: ent["p99_ms"]
+                                   for name, ent in psnap["phases"].items()}
+            res["slowest_phase"] = psnap["slowest_phase"]
 
         if kind == "mnist":
             # hot-swap acceptance under full load: v2 warms, router
@@ -1214,6 +1238,7 @@ def bench_serving():
     # mnist predictor (the ≥4×-vs-sequential acceptance metric)
     out["batched_qps"] = out["mnist"]["batched_qps"]
     out["speedup_vs_sequential"] = out["mnist"]["speedup"]
+    out["serving_phase_p99_ms"] = out["mnist"].get("phase_p99_ms")
     return out
 
 
@@ -1245,6 +1270,19 @@ def bench_decode():
     is CPU-measured policy evidence and labels itself ``analysis:
     true`` (the deepfm_fused precedent); the on-chip capture is ROADMAP
     item 1's ``decode`` row."""
+    from paddle_tpu.core import flags as _flags
+
+    # token-level tail anatomy (TTFT/TBT histograms, goodput, phases)
+    # rides the saturation window — host-side stamps, no device syncs
+    # (finally-restored like bench_serving)
+    _flags.set_flags({"phase_attribution": True})
+    try:
+        return _bench_decode_inner()
+    finally:
+        _flags.set_flags({"phase_attribution": False})
+
+
+def _bench_decode_inner():
     import jax
 
     from paddle_tpu.core.executor import Executor
@@ -1334,6 +1372,12 @@ def bench_decode():
     cont_tps = total_tokens / cont_wall
     token_p99 = eng.stats.token_ms.percentile(0.99)
     token_p50 = eng.stats.token_ms.percentile(0.50)
+    lat = eng.stats.lat
+    ttft_p99 = lat.ttft_ms.percentile(0.99) if lat else None
+    ttft_p50 = lat.ttft_ms.percentile(0.50) if lat else None
+    tbt_p99 = lat.tbt_ms.percentile(0.99) if lat else None
+    goodput = lat.goodput() if lat else None
+    phase_p99 = lat.phases.phase_p99_ms() if lat else None
 
     # greedy parity: continuous tokens == re-prefill argmax tokens
     mismatches = sum(1 for i, r in enumerate(results)
@@ -1357,6 +1401,13 @@ def bench_decode():
         "decode_tokens_per_sec": round(cont_tps, 1),
         "decode_token_p50_ms": token_p50,
         "decode_token_p99_ms": token_p99,
+        # token-level tail SLOs (gated like throughput by
+        # tools/bench_compare.py: decode_ttft_ms_p99 is lower-better)
+        "decode_ttft_ms_p50": ttft_p50,
+        "decode_ttft_ms_p99": ttft_p99,
+        "decode_tbt_ms_p99": tbt_p99,
+        "goodput": goodput,
+        "phase_p99_ms": phase_p99,
         "speedup_vs_reprefill": round(cont_tps / max(base_tps, 1e-9), 2),
         "parity": {"greedy_mismatched_requests": mismatches,
                    "requests_compared": len(reqs)},

@@ -194,6 +194,20 @@ class ElasticController:
                       if info.get("role") == role
                       and info.get("state") != _health.DEAD)
 
+    def slo_breaches(self, role: Optional[str] = None) -> Dict[str, list]:
+        """Workers whose heartbeat ``slo`` dimension reports breach
+        (observability/slo.py rides the health payload): {worker:
+        [breached rule names]}.  A breach is a decision INPUT, never a
+        resize by itself — :meth:`decide` reports it alongside the
+        liveness-driven action so the supervisor/operator can see a
+        fleet that is alive but missing its SLOs, damped by the same
+        hysteresis discipline (the supervisor requires consecutive
+        observations before flagging)."""
+        return {w: list(info.get("slo_rules") or [])
+                for w, info in self.fleet_view().items()
+                if (role is None or info.get("role") == role)
+                and info.get("slo") == "breach"}
+
     def decide(self, role: str, target: int) -> dict:
         """Grow/shrink recommendation for ``role`` against ``target``
         live workers: {"action": "grow"|"shrink"|"hold", "delta": n,
@@ -222,6 +236,14 @@ class ElasticController:
                 self._streak[role] = st
             streak = st[1]
         action = raw if streak >= self.hysteresis else "hold"
-        return {"action": action, "raw": raw, "streak": streak,
-                "needed": self.hysteresis, "delta": abs(target - n),
-                "alive": alive, "target": target}
+        out = {"action": action, "raw": raw, "streak": streak,
+               "needed": self.hysteresis, "delta": abs(target - n),
+               "alive": alive, "target": target}
+        # SLO breach state rides the same (cached) fleet view as an
+        # INFORMATIONAL dimension: it never changes `action` here —
+        # liveness decides counts; usefulness is the supervisor's /
+        # operator's damped signal (decisions stay HOLD-safe)
+        breaches = self.slo_breaches(role)
+        if breaches:
+            out["slo_breaches"] = breaches
+        return out

@@ -310,6 +310,49 @@ define_flag("decode_max_queue", 64,
             "request queue: past it, new generation requests are shed "
             "with the serving plane's typed Overloaded reply (counted "
             "in decode.shed) instead of queueing into timeout")
+define_flag("phase_attribution", False,
+            "per-request latency-phase attribution for the serving and "
+            "decode planes (observability/phase.py): each request "
+            "stamps monotonic phase timestamps through its lifecycle "
+            "(queue -> assemble -> dispatch -> device -> reply; decode "
+            "adds queue -> prefill/TTFT -> per-token), recorded into "
+            "per-phase histograms plus a bounded per-request sample "
+            "ring with slowest-request exemplars linked to their trace "
+            "ids — so a p99 regression NAMES its phase on /servingz / "
+            "/decodez.  Also arms the decode TTFT/TBT histograms and "
+            "goodput counters.  Host-side time.monotonic() stamps only "
+            "— zero extra device syncs.  Off (default): no stamps, no "
+            "new metric series")
+define_flag("metrics_history_interval_s", 0.0,
+            "sampling period for the in-process metric history rings "
+            "(observability/history.py): every counter/gauge in the "
+            "default registry retains a bounded, resolution-doubling "
+            "downsampled time series, queryable as /varz?window=<s> "
+            "and carried through the STATS_PULL fleet merge (aligned "
+            "by sample AGE, so skewed worker wall clocks cannot "
+            "misalign the fleet view).  0 (default) disables the "
+            "sampler thread and the rings entirely")
+define_flag("metrics_history_points", 512,
+            "capacity of one metric's history ring in POINTS: past it "
+            "the ring halves its resolution (adjacent samples merge "
+            "into their mean) instead of growing — memory stays "
+            "bounded while the window keeps extending")
+define_flag("slo_rules", "",
+            "declarative SLO watchdog rules (observability/slo.py), "
+            "semicolon-separated "
+            "'name=metric:stat(op)threshold:for=sustain_s' — e.g. "
+            "'ttft=decode.lm.ttft_ms:p99>250:for=5'.  stat is p50/p90/"
+            "p99/p999 (histograms), rate (counter per-second), or "
+            "value (gauges).  Rules are evaluated in-process; a "
+            "condition sustained for its window BREACHES (slo.* "
+            "counters, flight-recorder note, /sloz, and an 'slo' "
+            "health dimension in the registry heartbeat payload that "
+            "ElasticController/supervisor consume as a damped, "
+            "HOLD-safe decision input).  Empty (default): no watchdog "
+            "thread, no heartbeat bytes added")
+define_flag("slo_eval_interval_s", 1.0,
+            "SLO watchdog evaluation period in seconds (only read when "
+            "FLAGS_slo_rules is non-empty)")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

@@ -48,9 +48,17 @@ from .cache import PagedKVCache, blocks_for
 from .model import TransformerLM
 from ..core import flags as _flags
 from ..core.executor import Executor
+from ..distributed import faults as _faults
 from ..observability import debug_server as _debug_server
+from ..observability import phase as _phase
 from ..observability import stats as _obs_stats
 from ..serving.batcher import BucketLadder, Overloaded, RequestTooLong
+
+# decode request phases (FLAGS_phase_attribution): queue = submit ->
+# slot claimed, prefill = slot -> first token emitted (the TTFT tail
+# minus queue wait), decode = first token -> stream finished.  The
+# three sum to the request's end-to-end wall by construction
+DECODE_PHASES = ("queue", "prefill", "decode")
 
 
 class SamplingParams:
@@ -83,7 +91,7 @@ class SamplingParams:
 
 
 class DecodeRequest:
-    __slots__ = ("rid", "prompt", "sampling", "t_enq", "handle")
+    __slots__ = ("rid", "prompt", "sampling", "t_enq", "handle", "tl")
 
     def __init__(self, rid: int, prompt: np.ndarray,
                  sampling: SamplingParams):
@@ -92,6 +100,10 @@ class DecodeRequest:
         self.sampling = sampling
         self.t_enq = time.monotonic()
         self.handle = DecodeHandle(rid)
+        # phase timeline sharing the enqueue stamp (flag-gated; None
+        # keeps the flag-off path allocation-free)
+        self.tl = (_phase.PhaseTimeline(t0=self.t_enq)
+                   if _phase.enabled() else None)
 
 
 class DecodeHandle:
@@ -198,8 +210,73 @@ class _Slot:
         self.t_last = time.monotonic()
 
 
+class _LatencyStats:
+    """The flag-gated token-level latency + goodput bundle
+    (``FLAGS_phase_attribution``): created on first use so a flag-off
+    process never registers these series.
+
+    - ``ttft_ms``: submit -> first token emitted (queue + prefill; what
+      a streaming client perceives as time-to-first-token);
+    - ``tbt_ms``: per-stream inter-token interval (time between
+      tokens), the token-level tail SLO metric — an SLO rule on
+      ``decode.<name>.ttft_ms:p99`` / ``tbt_ms:p99`` reads these;
+    - goodput accounting: every decode-step lane is either useful
+      (live stream) or padding (inactive slot riding into the trash
+      block), every prefill token either real prompt or bucket pad,
+      and cancelled streams generated into the void — the counters
+      say how much of the device time bought tokens a client kept.
+      (Re-prefill accounting joins when preemption lands; today a
+      admitted request is never evicted, so there is nothing to count.)
+    """
+
+    def __init__(self, name: str):
+        sc = _obs_stats.scope(f"decode.{name}")
+        self.ttft_ms = sc.histogram(
+            "ttft_ms", help_str="time to first token: submit -> first "
+            "token emitted (queue wait + prefill dispatch)")
+        self.tbt_ms = sc.histogram(
+            "tbt_ms", help_str="time between tokens, per stream (the "
+            "client-perceived per-token latency)")
+        self.live_slot_steps = sc.counter(
+            "goodput_live_slot_steps", "decode-step lanes that advanced "
+            "a live stream (useful device work)")
+        self.pad_slot_steps = sc.counter(
+            "goodput_pad_slot_steps", "decode-step lanes dispatched for "
+            "INACTIVE slots (padding riding into the trash block)")
+        self.prefill_tokens = sc.counter(
+            "goodput_prefill_tokens", "real prompt tokens prefilled")
+        self.pad_prefill_tokens = sc.counter(
+            "goodput_pad_prefill_tokens", "pad tokens added snapping "
+            "prompts onto the prefill bucket ladder")
+        self.cancelled = sc.counter(
+            "cancelled", "streams abandoned by their client (engine "
+            "retired the slot / dropped the queued request)")
+        self.cancelled_tokens = sc.counter(
+            "cancelled_tokens", "tokens generated for streams later "
+            "cancelled (device work no client kept)")
+        self.phases = _phase.PhaseRecorder(f"decode.{name}",
+                                           DECODE_PHASES)
+
+    def goodput(self) -> dict:
+        live = self.live_slot_steps.value
+        pad = self.pad_slot_steps.value
+        pre = self.prefill_tokens.value
+        pre_pad = self.pad_prefill_tokens.value
+        return {
+            "live_slot_steps": live, "pad_slot_steps": pad,
+            "slot_utilization": round(live / max(live + pad, 1), 4),
+            "prefill_tokens": pre, "pad_prefill_tokens": pre_pad,
+            "prefill_efficiency": round(pre / max(pre + pre_pad, 1), 4),
+            "cancelled": self.cancelled.value,
+            "cancelled_tokens": self.cancelled_tokens.value,
+        }
+
+
 class _EngineStats:
     def __init__(self, name: str):
+        self._name = name
+        self._lat_lock = threading.Lock()
+        self._lat: Optional[_LatencyStats] = None
         sc = _obs_stats.scope(f"decode.{name}")
         self.tokens = sc.counter("tokens", "generated tokens (all streams)")
         self.prefills = sc.counter("prefills")
@@ -220,6 +297,17 @@ class _EngineStats:
             "token_ms",
             help_str="per-stream inter-token interval (what a client "
                      "perceives as per-token latency)")
+
+    def latency(self) -> _LatencyStats:
+        """The flag-gated bundle (lazy: see :class:`_LatencyStats`)."""
+        with self._lat_lock:
+            if self._lat is None:
+                self._lat = _LatencyStats(self._name)
+            return self._lat
+
+    @property
+    def lat(self) -> Optional[_LatencyStats]:
+        return self._lat
 
 
 class DecodeEngine:
@@ -371,7 +459,10 @@ class DecodeEngine:
         # (a vanished client must not hold a queue slot); they never
         # joined, so they count neither join nor leave
         while self._pending and self._pending[0].handle.cancelled:
-            self._pending.pop(0).handle._finish("cancelled")
+            dropped = self._pending.pop(0)
+            if dropped.tl is not None:
+                self.stats.latency().cancelled.inc()
+            dropped.handle._finish("cancelled")
         for i, slot in enumerate(self._slots):
             if slot is not None or not self._pending:
                 continue
@@ -390,6 +481,8 @@ class DecodeEngine:
             row[:len(blocks)] = blocks
             self._slots[i] = _Slot(req, blocks, req.prompt.size,
                                    first_token=-1)   # token set by prefill
+            if req.tl is not None:
+                req.tl.stamp("queue")   # queue wait ends at slot claim
             self.stats.joins.inc()   # every join has a matching leave
             out.append(req)          # through _retire
         self.stats.queue.set(len(self._pending))
@@ -429,6 +522,10 @@ class DecodeEngine:
                 np.uint32(req.sampling.seed & 0xFFFFFFFF),
                 np.float32(req.sampling.temperature),
                 np.int32(req.sampling.top_k)]
+        _debug_server.note_activity("decode")
+        # chaos hook: `delay:decode_prefill` sleeps here, inside the
+        # prefill phase / TTFT window (the SLO-watchdog test's lever)
+        _faults.event("decode_prefill")
         (tok, logits), new_state = self._exe.run_callable(
             f"decode/{self.name}/prefill/{bucket}", build, feed,
             state=self.cache.state(), const=self._plist)
@@ -439,6 +536,12 @@ class DecodeEngine:
         self.stats.prefills.inc()
         self.stats.tokens.inc()
         self.stats.prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        if req.tl is not None:
+            req.tl.stamp("prefill", t=slot.t_last)
+            lat = self.stats.latency()
+            lat.ttft_ms.observe((slot.t_last - req.t_enq) * 1e3)
+            lat.prefill_tokens.inc(P)
+            lat.pad_prefill_tokens.inc(bucket - P)
         req.handle._emit(
             first, np.asarray(logits) if self.capture_logits else None)
         self._maybe_finish(i, slot, first)
@@ -481,6 +584,10 @@ class DecodeEngine:
                 return [toks, logits], [kc, vc]
             return fn
 
+        _debug_server.note_activity("decode")
+        # chaos hook: `delay:decode_step` sleeps inside the decode
+        # phase (per-token latency); cheap active() guard when off
+        _faults.event("decode_step")
         (toks, logits), new_state = self._exe.run_callable(
             f"decode/{self.name}/step", build,
             [tokens, positions, tables, seeds, steps, temps, topks],
@@ -491,6 +598,10 @@ class DecodeEngine:
         now = time.monotonic()
         self.stats.steps.inc()
         self.stats.step_ms.observe((time.perf_counter() - t0) * 1e3)
+        lat = self.stats.latency() if _phase.enabled() else None
+        if lat is not None:
+            lat.live_slot_steps.inc(len(live))
+            lat.pad_slot_steps.inc(self.max_slots - len(live))
         for i in live:
             slot = self._slots[i]
             tok = int(toks_np[i])
@@ -499,6 +610,8 @@ class DecodeEngine:
             slot.last_token = tok
             self.stats.tokens.inc()
             self.stats.token_ms.observe((now - slot.t_last) * 1e3)
+            if lat is not None:
+                lat.tbt_ms.observe((now - slot.t_last) * 1e3)
             slot.t_last = now
             slot.req.handle._emit(
                 tok, logits_np[i] if logits_np is not None else None)
@@ -523,7 +636,19 @@ class DecodeEngine:
             self.stats.active.set(sum(x is not None for x in self._slots))
             self.stats.blocks_free.set(self.cache.allocator.free_blocks)
             self._lock.notify_all()   # blocks freed: admit the queue head
-        slot.req.handle._finish(reason)
+        req = slot.req
+        if req.tl is not None:
+            lat = self.stats.latency()
+            if reason == "cancelled":
+                lat.cancelled.inc()
+                lat.cancelled_tokens.inc(slot.n_generated)
+            # close the decode phase (zero-width for a stream finished
+            # at its first token) and fold the timeline in: the three
+            # phases sum to this request's end-to-end wall
+            req.tl.stamp("decode")
+            lat.phases.observe(req.tl, rid=req.rid, finish=reason,
+                               tokens=slot.n_generated)
+        req.handle._finish(reason)
 
     def _release(self, req: DecodeRequest, slot_idx, error) -> None:
         with self._lock:
@@ -586,6 +711,18 @@ class DecodeEngine:
         if tsnap.get("count"):
             out["token_p50_ms"] = self.stats.token_ms.percentile(0.50)
             out["token_p99_ms"] = self.stats.token_ms.percentile(0.99)
+        lat = self.stats.lat
+        if lat is not None:
+            # the FLAGS_phase_attribution plane: TTFT/TBT tails,
+            # goodput accounting, per-phase attribution
+            if lat.ttft_ms.count:
+                out["ttft_p50_ms"] = lat.ttft_ms.percentile(0.50)
+                out["ttft_p99_ms"] = lat.ttft_ms.percentile(0.99)
+            if lat.tbt_ms.count:
+                out["tbt_p50_ms"] = lat.tbt_ms.percentile(0.50)
+                out["tbt_p99_ms"] = lat.tbt_ms.percentile(0.99)
+            out["goodput"] = lat.goodput()
+            out["phases"] = lat.phases.snapshot()
         return out
 
     # -- lifecycle ---------------------------------------------------------

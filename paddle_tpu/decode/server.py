@@ -218,7 +218,9 @@ class DecodeServer:
                 if not eng.drain(timeout=left):
                     _flight.note("decode_drain_timeout", model=name,
                                  endpoint=self.endpoint)
-        self._server.stop()
+        # drain: mid-reply connections (a stream's trailing FIN frame)
+        # get a bounded grace before the transport severs them
+        self._server.stop(graceful_s=2.0 if drain else 0.0)
         if self._own_engines:
             for eng in self.engines.values():
                 eng.close()
@@ -276,6 +278,12 @@ class DecodeServer:
                 out["queue_depth"] = z["queue_depth"]
                 out["slots_active"] = sum(
                     s is not None for s in z["slots"])
+                # token-level tail SLOs ride the lease payload so the
+                # fleet sees each replica's TTFT/TBT p99 without
+                # scraping it (present iff FLAGS_phase_attribution)
+                for k in ("ttft_p99_ms", "tbt_p99_ms"):
+                    if k in z:
+                        out[k] = z[k]
             return out
         return data
 

@@ -54,6 +54,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from . import transport
 from ..observability import flight as _flight
+from ..observability import slo as _slo
 from ..observability import stats as _obs_stats
 from ..observability.health import HealthTable
 from ..observability.trace import flags_on as _telemetry_on
@@ -235,7 +236,8 @@ class RegistryService:
                         role=hb.get("role", ""), step=hb.get("step"),
                         last_error=hb.get("last_error"),
                         trainer_id=hb.get("trainer_id"),
-                        standby=hb.get("standby"))
+                        standby=hb.get("standby"), slo=hb.get("slo"),
+                        slo_rules=hb.get("slo_rules"))
                 return transport.OK, b"{}"
             ttl = float(body["ttl"])
             now = time.monotonic()
@@ -308,7 +310,8 @@ class RegistryService:
                     name, ttl=ttl, role=hb.get("role", ""),
                     step=hb.get("step"), last_error=hb.get("last_error"),
                     trainer_id=hb.get("trainer_id"),
-                    standby=hb.get("standby"))
+                    standby=hb.get("standby"), slo=hb.get("slo"),
+                    slo_rules=hb.get("slo_rules"))
             # plain primary registrations keep the PR-5 empty response
             # byte-identical; only HA registrations carry an answer
             return (transport.OK,
@@ -520,6 +523,14 @@ class Heartbeat:
         if self.standby is not None and not self.promoted:
             # fleet health view shows who is warm-sparing this key
             hb["standby"] = self.standby
+        # SLO watchdog dimension (observability/slo.py): when this
+        # process runs a watchdog, its breach state rides every
+        # heartbeat — the fleet health table / ElasticController /
+        # supervisor consume it with zero new RPCs.  No watchdog (the
+        # default): nothing added, the payload stays byte-identical
+        slo_dim = _slo.health_dimension()
+        if slo_dim:
+            hb.update(slo_dim)
         if self.health_fn is not None:
             try:
                 hb.update(self.health_fn() or {})

@@ -338,6 +338,14 @@ class _SupMetrics:
             "restart budget (the anti-restart-storm fence)")
         self.holds = sc.gauge("holds", "roles currently in HOLD")
         self.live = sc.gauge("workers_live", "workers currently LIVE")
+        self.slo_breaches = sc.counter(
+            "slo_breaches", "sustained worker SLO-breach transitions "
+            "the supervisor observed via the heartbeat slo dimension "
+            "(observability/slo.py); observed and flight-noted, never "
+            "an automatic resize — decisions stay HOLD-safe")
+        self.slo_breach_workers = sc.gauge(
+            "slo_breach_workers", "workers currently in confirmed "
+            "(hysteresis-damped) SLO breach")
 
 
 class Supervisor:
@@ -370,6 +378,11 @@ class Supervisor:
         self._next_cut = 0.0
         self._health: Dict[str, dict] = {}
         self._leases: Dict[str, str] = {}
+        # SLO-breach observation (heartbeat slo dimension): per-worker
+        # consecutive-poll streaks, and the confirmed-breach set after
+        # spec.hysteresis agreeing observations
+        self._slo_streak: Dict[str, int] = {}
+        self._slo_confirmed: Dict[str, list] = {}
         self._started = False
         self._client = None
 
@@ -573,11 +586,14 @@ class Supervisor:
                             "restart_budget": rs.restart_budget,
                             "deaths_in_window": len(window),
                             "hold": holds.get(r)}
+        with self.lock:
+            slo = {w: list(r) for w, r in self._slo_confirmed.items()}
         out = {"fleet": self.spec.name,
                "state": "HOLD" if holds else "RUNNING",
                "registry": self.registry_ep,
                "rollback_roles": list(self.spec.rollback_roles),
-               "roles": roles, "workers": workers}
+               "roles": roles, "workers": workers,
+               "slo_breaches": slo}
         root = self.spec.checkpoint_root
         if root:
             out["checkpoint"] = {
@@ -723,9 +739,44 @@ class Supervisor:
         with self.lock:
             self._leases = leases
             self._health = health
+            self._observe_slo_locked(health)
             for w in self.workers.values():
                 if w.logical and w.logical in leases:
                     w.physical = leases[w.logical]
+
+    def _observe_slo_locked(self, health: Dict[str, dict]) -> None:
+        """Fold one FRESH health view's slo dimensions into the damped
+        breach observation (call with the lock held).  A worker whose
+        heartbeat reports ``slo: breach`` for ``spec.hysteresis``
+        consecutive polls becomes a CONFIRMED breach: counted, flight-
+        noted, gauged and shown on /fleetz — but never an automatic
+        resize (a breached-yet-alive fleet is an operator decision;
+        killing the only replica that IS serving would make the SLO
+        worse).  One non-breach poll resets the streak (the watchdog's
+        own sustain window already filtered transients)."""
+        need = self.spec.hysteresis
+        for worker, info in health.items():
+            slo = info.get("slo")
+            if slo == "breach":
+                streak = self._slo_streak.get(worker, 0) + 1
+                self._slo_streak[worker] = streak
+                if streak >= need and worker not in self._slo_confirmed:
+                    rules = list(info.get("slo_rules") or [])
+                    self._slo_confirmed[worker] = rules
+                    self.metrics.slo_breaches.inc()
+                    _flight.note("supervisor_slo_breach", worker=worker,
+                                 rules=rules, streak=streak)
+            else:
+                self._slo_streak.pop(worker, None)
+                if worker in self._slo_confirmed:
+                    self._slo_confirmed.pop(worker)
+                    _flight.note("supervisor_slo_clear", worker=worker)
+        # workers that vanished from the view (deregistered/reaped)
+        for worker in list(self._slo_confirmed):
+            if worker not in health:
+                self._slo_confirmed.pop(worker)
+                self._slo_streak.pop(worker, None)
+        self.metrics.slo_breach_workers.set(len(self._slo_confirmed))
 
     def _winding_down(self) -> bool:
         """True when every done_ok worker has finished (state COMPLETED

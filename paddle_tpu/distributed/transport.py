@@ -449,6 +449,11 @@ def _serve_io(io, service) -> None:
         body = io.recv_frame()
         if body is None:
             return
+        # busy marker for graceful stops: from request received to
+        # reply written this connection must not be severed by
+        # stop(graceful_s=...) — the serving plane's drain promises the
+        # accepted request's REPLY, not just its handler return
+        io.busy = True
         tel = _telemetry_on()
         t0 = time.perf_counter() if tel else None
         msg_type, tid, name, payload, wctx = _unpack_body_ext(body)
@@ -511,6 +516,7 @@ def _serve_io(io, service) -> None:
                         ERR, tid, name, [repr(e).encode("utf-8")]))
                 except ConnectionError:
                     return
+            io.busy = False
             continue
         resp_bufs = _pack_body_vec(rtype, tid, name,
                                    rpayload if isinstance(rpayload, list)
@@ -539,6 +545,7 @@ def _serve_io(io, service) -> None:
                     "vectored_bytes").inc(nbytes)
         except ConnectionError:
             return
+        io.busy = False
 
 
 class RPCServer:
@@ -583,8 +590,12 @@ class RPCServer:
         _flight.arm_from_flags()
         self._impl.start()
 
-    def stop(self) -> None:
-        self._impl.stop()
+    def stop(self, graceful_s: float = 0.0) -> None:
+        """``graceful_s > 0``: bounded wait for connections that are
+        mid-reply (request received, reply not yet written) before
+        severing — the serving drain's reply guarantee.  Default 0
+        keeps the immediate-stop behavior everywhere else."""
+        self._impl.stop(graceful_s)
 
 
 _HOST_NORM_CACHE: Dict[str, str] = {}
@@ -787,7 +798,10 @@ class _PyServer:
     def start(self) -> None:
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, graceful_s: float = 0.0) -> None:
+        # socketserver's shutdown never severs ACCEPTED connections
+        # (daemon handler threads finish their writes naturally), so
+        # graceful_s needs no extra wait on this backend
         self._server.shutdown()
         self._server.server_close()
 
@@ -845,7 +859,7 @@ class _NativeServer:
                 self._threads.append(t)
             t.start()
 
-    def stop(self) -> None:
+    def stop(self, graceful_s: float = 0.0) -> None:
         lstn = self._l
         self._closing = True
         if lstn:
@@ -863,6 +877,17 @@ class _NativeServer:
         with self._lock:
             conns = list(self._conns)
             threads = list(self._threads)
+        if graceful_s > 0:
+            # graceful stop (the serving drain): a connection between
+            # "request received" and "reply written" (_serve_io busy
+            # marker) gets its reply OUT before we sever — shutdown()
+            # on a mid-reply connection loses a reply the drain already
+            # promised.  Idle connections (blocked readers) don't wait
+            deadline = time.monotonic() + graceful_s
+            for io in conns:
+                while getattr(io, "busy", False) \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.005)
         for io in conns:
             io.shutdown()  # wake readers; serving threads free handles
         # JOIN the woken threads (bounded): a daemon thread still inside

@@ -31,6 +31,25 @@ Three cooperating pieces (see each module's docstring):
   step_ms, samples/s, with atomic rotation and a ``watch()`` tail;
   ``tools/runlog_report.py`` renders/compares.
 
+The latency-anatomy / SLO plane (all strictly flag-gated):
+
+- :mod:`phase` — per-request phase attribution
+  (``FLAGS_phase_attribution``): monotonic phase timelines through the
+  serving batcher / decode engine lifecycles, per-phase histograms, a
+  bounded per-request sample ring with slowest-request exemplars
+  linked to trace ids; phases sum to the end-to-end wall by
+  construction, so a p99 regression names its phase.
+- :mod:`history` — bounded, resolution-doubling metric history rings
+  (``FLAGS_metrics_history_interval_s``): every counter/gauge retains
+  a downsampled time series, served on ``/varz?window=...`` and
+  carried (age-aligned, clock-skew-proof) through the STATS_PULL
+  fleet merge.
+- :mod:`slo` — the declarative SLO watchdog (``FLAGS_slo_rules``):
+  metric × percentile/rate × threshold × sustain-window rules
+  evaluated in-process; breaches count, leave flight notes, render on
+  ``/sloz`` and ride the registry heartbeat as an ``slo`` health
+  dimension the ElasticController/supervisor consume.
+
 The export/aggregation half (this package's fleet plane):
 
 - :mod:`debug_server` — opt-in (``FLAGS_debug_server_port``) HTTP
@@ -52,8 +71,11 @@ from . import (  # noqa: F401
     debug_server,
     flight,
     health,
+    history,
     perf,
+    phase,
     runlog,
+    slo,
     stats,
     step_stats,
     trace,

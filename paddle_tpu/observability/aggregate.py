@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 from typing import Dict, Mapping, Optional
 
+from . import history as _history
 from . import stats as _stats
 from . import trace as _trace
 
@@ -35,9 +36,17 @@ _WIRE_VERSION = 1
 
 
 def local_snapshot_payload() -> bytes:
-    """The STATS_PULL response body: this process's export_state()."""
+    """The STATS_PULL response body: this process's export_state(),
+    plus the metric-history rings when that plane is armed
+    (``FLAGS_metrics_history_interval_s`` — series carried as
+    ``[[age_s, value], ...]``, ages not wall clocks, so skewed worker
+    clocks cannot misalign the fleet merge).  Flag off: the payload is
+    byte-identical to the pre-history wire."""
     state = _stats.export_state()
     state["version"] = _WIRE_VERSION
+    hist = _history.export_history()
+    if hist is not None:
+        state["history"] = hist
     return json.dumps(state).encode("utf-8")
 
 
@@ -76,8 +85,14 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
     # distinguishable even if two workers were given the same name
     worker_labels = {w: dict(per_worker[w].get("labels") or {})
                      for w in per_worker}
+    # metric-history series stay PER WORKER (ages are relative to each
+    # worker's own pull — summing or zipping across workers would
+    # invent alignment the clocks never had)
+    history: Dict[str, dict] = {}
     for worker in sorted(per_worker):
         state = per_worker[worker]
+        if isinstance(state.get("history"), dict):
+            history[worker] = state["history"]
         for name, m in state.get("metrics", {}).items():
             kind = m.get("kind")
             if kind == "counter":
@@ -97,8 +112,11 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
                 ent["sum"] += m["sum"]
                 ent["count"] += m["count"]
                 ent["per_worker_count"][worker] = m["count"]
-    return {"workers": sorted(per_worker), "worker_labels": worker_labels,
-            "counters": counters, "gauges": gauges, "histograms": hists}
+    out = {"workers": sorted(per_worker), "worker_labels": worker_labels,
+           "counters": counters, "gauges": gauges, "histograms": hists}
+    if history:
+        out["history"] = history
+    return out
 
 
 def _le_sort_key(le: str) -> float:

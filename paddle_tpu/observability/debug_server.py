@@ -32,6 +32,12 @@ Endpoints (all GET):
   per-worker lifecycle state machine + restart budgets, with query
   params as the admin surface (resize/drain/resume/cut —
   ``tools/fleet.py`` is the CLI).
+- ``/varz``    the metric history rings (:mod:`history`,
+  ``FLAGS_metrics_history_interval_s``): ``?window=<s>`` bounds the
+  returned series, ``?grep=<substr>`` filters metric names — "what
+  changed in the last 10 minutes" without an external scraper.
+- ``/sloz``    the SLO watchdog (:mod:`slo`, ``FLAGS_slo_rules``):
+  rule table with live values, thresholds, breach state.
 
 Built on stdlib ``http.server`` (ThreadingHTTPServer, daemon threads):
 no new dependencies, safe to leave running in tests and serving
@@ -48,11 +54,26 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from . import history as _history
+from . import slo as _slo
 from . import stats as _stats
 from . import step_stats as _step_stats
 from . import trace as _trace
 
 _START_TIME = time.time()
+
+# liveness activity marks beyond the training StepStats ring: the
+# serving batcher and decode engine note each dispatch here so a pure
+# inference process (which never appends StepStats) still reports a
+# bounded last-activity age on /healthz instead of an ever-growing
+# last-step age (it looked permanently stuck to any prober)
+_activity: Dict[str, float] = {}
+
+
+def note_activity(plane: str) -> None:
+    """Record a liveness mark for ``plane`` ('serving', 'decode', ...).
+    One clock read + dict store — safe on hot paths, no flag needed."""
+    _activity[plane] = time.time()
 
 _lock = threading.Lock()
 _server: Optional["DebugServer"] = None
@@ -219,14 +240,25 @@ def _current_role() -> str:
 def _healthz() -> dict:
     rec = _step_stats.recorder()
     last = rec.last_n(1)
+    now = time.time()
+    # liveness = the freshest of ANY dispatch plane: the training
+    # StepStats ring, plus the serving/decode activity marks.  A pure
+    # inference server's liveness must not age out on the training ring
+    ages = {}
+    if last:
+        ages["train"] = round(now - last[0].ts, 3)
+    # copy first: hot-path threads insert NEW plane keys concurrently,
+    # and iterating the live dict could 500 a healthy process's probe
+    for plane, ts in sorted(dict(_activity).items()):
+        ages[plane] = round(now - ts, 3)
     return {
         "status": "ok",
         "role": _current_role(),
-        "uptime_s": round(time.time() - _START_TIME, 3),
+        "uptime_s": round(now - _START_TIME, 3),
         "runtime_stats": _trace.flags_on(),
         "steps_recorded": rec.total_recorded,
-        "last_step_age_s": (round(time.time() - last[0].ts, 3)
-                            if last else None),
+        "last_step_age_s": (min(ages.values()) if ages else None),
+        "activity_age_s": ages,
     }
 
 
@@ -354,6 +386,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(code, json.dumps(payload, indent=2,
                                              default=repr),
                             "application/json")
+            elif path == "/varz":
+                # the metric-history plane (observability/history.py):
+                # bounded downsampled time series per counter/gauge,
+                # ?window=<s> bounds the ages, ?grep filters names
+                from urllib.parse import parse_qs
+                q = parse_qs(query)
+                window = q.get("window", [None])[0]
+                window_s = float(window) if window else None
+                pattern = q.get("grep", [""])[0]
+                self._reply(200, json.dumps(
+                    _history.varz(window_s, pattern), indent=2),
+                    "application/json")
+            elif path == "/sloz":
+                # the SLO watchdog (observability/slo.py): rule table
+                # with live values / thresholds / breach state
+                self._reply(200, json.dumps(_slo.sloz(), indent=2,
+                                            default=repr),
+                            "application/json")
             elif path == "/chaosz":
                 # fault-injection control plane (distributed/faults.py):
                 # ?inject=<spec> arms rules, ?clear=1 removes runtime
@@ -390,6 +440,9 @@ class _Handler(BaseHTTPRequestHandler):
                      "queue)",
                      "/fleetz  (supervised fleet state machine; "
                      "?resize=role:n ?drain=w ?resume= ?cut=1)",
+                     "/varz  (metric history rings; ?window=<s> "
+                     "?grep=<substr>)",
+                     "/sloz  (SLO watchdog rule table)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),
                     "text/plain")
             else:
@@ -466,8 +519,13 @@ def stop() -> None:
 def maybe_start_from_flags() -> Optional[DebugServer]:
     """The wiring hook (Executor init, RPCServer start): starts the
     singleton iff ``FLAGS_debug_server_port`` > 0.  With the flag at its
-    default 0 this is a dict lookup — no socket, no thread."""
+    default 0 this is a dict lookup — no socket, no thread.  The
+    metric-history sampler and SLO watchdog ride the same hook (each
+    behind its OWN flag — they work without the HTTP server; flags at
+    defaults, each check is one dict lookup)."""
     from ..core import flags as _flags
+    _history.maybe_start_from_flags()
+    _slo.maybe_start_from_flags()
     try:
         port = int(_flags.get_flags("debug_server_port"))
     except KeyError:  # pragma: no cover

@@ -44,7 +44,8 @@ def _flag(name: str, default: float) -> float:
 
 class _WorkerEntry:
     __slots__ = ("name", "role", "step", "last_error", "trainer_id",
-                 "ttl", "last_seen", "heartbeats", "standby")
+                 "ttl", "last_seen", "heartbeats", "standby", "slo",
+                 "slo_rules")
 
     def __init__(self, name: str):
         self.name = name
@@ -59,6 +60,13 @@ class _WorkerEntry:
         # its logical key (None = primary / not replicated); cleared on
         # promotion, so the fleet view shows who is warm-sparing whom
         self.standby = None
+        # SLO watchdog dimension (observability/slo.py): "ok"/"breach"
+        # as reported by the worker's own in-process watchdog, riding
+        # the same heartbeat payload — liveness says the worker is
+        # alive, this says whether it is USEFUL.  None = worker runs no
+        # watchdog (the pre-slo wire)
+        self.slo = None
+        self.slo_rules = None
 
 
 class HealthTable:
@@ -105,7 +113,7 @@ class HealthTable:
                 step: Optional[int] = None,
                 last_error: Optional[str] = None,
                 trainer_id: Optional[int] = None,
-                standby=None) -> None:
+                standby=None, slo=None, slo_rules=None) -> None:
         """File one heartbeat (idempotent re-registration included)."""
         with self._lock:
             e = self._workers.get(name)
@@ -120,8 +128,11 @@ class HealthTable:
             if trainer_id is not None:
                 e.trainer_id = int(trainer_id)
             # always assigned (not only when present): a promoted
-            # backup's next heartbeat clears its standby marker
+            # backup's next heartbeat clears its standby marker, and a
+            # cleared SLO breach clears the slo dimension
             e.standby = standby
+            e.slo = slo
+            e.slo_rules = slo_rules
             e.last_seen = time.monotonic()
             e.heartbeats += 1
 
@@ -165,7 +176,7 @@ class HealthTable:
         for e in entries:
             state = self._state(e, now)
             tallies[state] += 1
-            out[e.name] = {
+            ent = {
                 "state": state,
                 "role": e.role,
                 "step": e.step,
@@ -176,10 +187,17 @@ class HealthTable:
                 "heartbeats": e.heartbeats,
                 "standby": e.standby,
             }
+            if e.slo is not None:
+                ent["slo"] = e.slo
+                if e.slo_rules:
+                    ent["slo_rules"] = e.slo_rules
+            out[e.name] = ent
         sc = _stats.scope("health")
         sc.gauge("workers_healthy").set(tallies[HEALTHY])
         sc.gauge("workers_suspect").set(tallies[SUSPECT])
         sc.gauge("workers_dead").set(tallies[DEAD])
+        sc.gauge("workers_slo_breach").set(
+            sum(1 for e in entries if e.slo == "breach"))
         return out
 
     def dead_trainers(self) -> set:

@@ -61,6 +61,70 @@ def prom_labels(labels: Dict[str, object], extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def percentile_sorted(sorted_vals: Sequence[float], q: float) -> float:
+    """THE percentile over raw samples, shared by every surface.
+
+    Linear interpolation between closest ranks (numpy's default /
+    Hyndman-Fan type 7): ``pos = q * (n - 1)``, value interpolated
+    between ``sorted_vals[floor(pos)]`` and ``sorted_vals[ceil(pos)]``.
+    ``/servingz``'s recent-window gauges, the StepStats summaries and
+    the decode plane all route through here so a 5-sample window
+    reports the SAME p99 everywhere (they used to disagree: the serving
+    gauge truncated to a nearest rank while StepStats interpolated)."""
+    n = len(sorted_vals)
+    if n == 0:
+        return 0.0
+    if n == 1:
+        return float(sorted_vals[0])
+    pos = q * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return float(sorted_vals[lo]) * (1 - frac) + float(sorted_vals[hi]) * frac
+
+
+def histogram_percentile(snap: dict, q: float,
+                         finite_max: Optional[float] = None) -> float:
+    """Quantile estimate from a fixed-bucket snapshot (``{"buckets":
+    {le: cumulative}, "count": n}`` — :meth:`Histogram.snapshot`).
+
+    Prometheus ``histogram_quantile`` semantics: find the first bucket
+    whose cumulative count reaches ``q * count``, then linearly
+    interpolate INSIDE that bucket assuming observations are uniform
+    over ``(lower_edge, upper_edge]`` (the first bucket interpolates
+    from 0).  Returning the raw upper edge (the old behavior) made
+    every small-window quantile snap to a bucket boundary and disagree
+    with the raw-sample surfaces; interpolation keeps the estimate
+    inside the same bucket but boundary-continuous.  The +Inf bucket
+    has no finite width, so a quantile landing there reports the
+    largest finite edge (``finite_max`` override) — the honest lower
+    bound."""
+    total = snap.get("count", 0)
+    if not total:
+        return 0.0
+    target = q * total
+    prev_le, prev_cum = 0.0, 0
+    last_finite = 0.0
+    edges = []
+    for le, cum in snap["buckets"].items():
+        le_f = float(le) if not isinstance(le, str) else (
+            float("inf") if le == "+Inf" else float(le))
+        edges.append((le_f, cum))
+    for le_f, cum in sorted(edges):
+        if le_f != float("inf"):
+            last_finite = le_f
+        if cum >= target:
+            if le_f == float("inf"):
+                return finite_max if finite_max is not None else prev_le
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return le_f
+            frac = (target - prev_cum) / in_bucket
+            return prev_le + (le_f - prev_le) * min(max(frac, 0.0), 1.0)
+        prev_le, prev_cum = (le_f if le_f != float("inf") else prev_le), cum
+    return finite_max if finite_max is not None else last_finite
+
+
 def _jsonable(v):
     if isinstance(v, dict):
         # histogram bucket keys are floats incl. +Inf: stringify every
@@ -205,18 +269,12 @@ class Histogram:
                 "sum": s, "count": total}
 
     def percentile(self, q: float) -> float:
-        """Bucket-resolution quantile: the smallest upper edge whose
-        cumulative count covers q of the observations (the +Inf bucket
-        reports the largest finite edge — the honest lower bound)."""
-        snap = self.snapshot()
-        total = snap["count"]
-        if total == 0:
-            return 0.0
-        target = q * total
-        for le, cum in snap["buckets"].items():
-            if cum >= target:
-                return le if le != float("inf") else self.buckets[-1]
-        return self.buckets[-1]
+        """Bucket quantile via the shared :func:`histogram_percentile`
+        (linear interpolation inside the covering bucket; the +Inf
+        bucket reports the largest finite edge — the honest lower
+        bound)."""
+        return histogram_percentile(self.snapshot(), q,
+                                    finite_max=self.buckets[-1])
 
 
 class _Scope:

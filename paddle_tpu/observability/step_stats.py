@@ -14,12 +14,13 @@ percentile aggregates and ``last_n()`` the raw tail.
 """
 from __future__ import annotations
 
-import math
 import threading
 import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
+
+from .stats import percentile_sorted
 
 
 def approx_nbytes(v) -> int:
@@ -72,17 +73,9 @@ class StepStats:
         return asdict(self)
 
 
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank-with-interpolation percentile over a sorted list."""
-    if not sorted_vals:
-        return 0.0
-    if len(sorted_vals) == 1:
-        return sorted_vals[0]
-    pos = q * (len(sorted_vals) - 1)
-    lo = int(math.floor(pos))
-    hi = min(lo + 1, len(sorted_vals) - 1)
-    frac = pos - lo
-    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+# the shared raw-sample percentile (observability/stats.py): /servingz,
+# /decodez and these summaries must agree on small windows
+_percentile = percentile_sorted
 
 
 class StepStatsRecorder:

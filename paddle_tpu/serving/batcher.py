@@ -44,8 +44,24 @@ import numpy as np
 
 from ..core import flags as _flags
 from ..core.types import np_dtype
+from ..distributed import faults as _faults
+from ..observability import debug_server as _debug_server
+from ..observability import phase as _phase
 from ..observability import stats as _obs_stats
 from ..observability import trace as _obs_trace
+
+# request lifecycle phases (FLAGS_phase_attribution; observability/
+# phase.py): consecutive monotonic stamps, so the five sum EXACTLY to
+# the request's end-to-end wall — a p99 regression names its phase
+#   queue     submit accepted -> its batch starts assembling
+#   assemble  coalesce + pad + feed build
+#   dispatch  Predictor.run (async executor dispatch; lowering on miss;
+#             injected dispatch faults — the PR-6 `delay:
+#             serving_dispatch` rule — land here)
+#   device    dispatch return -> batch materialized (device execution +
+#             the one batched readback, incl. completion-queue wait)
+#   reply     materialized -> this request's future completed
+SERVING_PHASES = ("queue", "assemble", "dispatch", "device", "reply")
 
 
 class Overloaded(RuntimeError):
@@ -181,13 +197,17 @@ class BucketLadder:
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "future", "t_enq")
+    __slots__ = ("feed", "rows", "future", "t_enq", "tl")
 
     def __init__(self, feed: Dict[str, np.ndarray], rows: int):
         self.feed = feed
         self.rows = rows
         self.future: "Future" = Future()
         self.t_enq = time.monotonic()
+        # phase timeline, sharing the enqueue stamp (flag-gated; None
+        # keeps the flag-off path allocation-free)
+        self.tl = (_phase.PhaseTimeline(t0=self.t_enq)
+                   if _phase.enabled() else None)
 
 
 class BatcherStats:
@@ -199,9 +219,14 @@ class BatcherStats:
     _WINDOW = 512
 
     def __init__(self, model: str):
+        self.model = model
         self._lock = threading.Lock()
         # (t_done_monotonic, latency_ms) of recent completed requests
         self._recent: deque = deque(maxlen=self._WINDOW)
+        # per-request phase attribution (FLAGS_phase_attribution):
+        # created on first observe so a flag-off process never
+        # registers serving.<model>.phase.* series
+        self._phases: Optional[_phase.PhaseRecorder] = None
         self.requests = 0
         self.rows = 0
         self.shed = 0
@@ -263,10 +288,25 @@ class BatcherStats:
     def set_depth(self, rows: int) -> None:
         self._g_depth.set(rows)
 
+    def note_phases(self, tl, trace_id=None) -> None:
+        """Fold one finished request timeline into the per-phase
+        histograms + sample ring (completion thread)."""
+        with self._lock:
+            rec = self._phases
+            if rec is None:
+                rec = self._phases = _phase.PhaseRecorder(
+                    f"serving.{self.model}", SERVING_PHASES)
+        rec.observe(tl, trace_id=trace_id)
+
+    def phases(self) -> Optional[_phase.PhaseRecorder]:
+        with self._lock:
+            return self._phases
+
     def snapshot(self) -> dict:
         now = time.monotonic()
         with self._lock:
             recent = list(self._recent)
+            phases = self._phases
             out = {
                 "requests": self.requests, "rows": self.rows,
                 "shed": self.shed, "batches": self.batches,
@@ -279,13 +319,15 @@ class BatcherStats:
         if recent:
             span = max(now - recent[0][0], 1e-3)
             lats = sorted(lat for _, lat in recent)
-
-            def pct(p):
-                return round(lats[min(int(p * len(lats)), len(lats) - 1)], 3)
             out.update({
                 "qps": round(len(recent) / span, 1),
-                "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+                # the SHARED raw-sample percentile (stats.py): small
+                # windows now agree with the StepStats summaries
+                "p50_ms": round(_obs_stats.percentile_sorted(lats, 0.50), 3),
+                "p99_ms": round(_obs_stats.percentile_sorted(lats, 0.99), 3),
             })
+        if phases is not None:
+            out["phases"] = phases.snapshot()
         return out
 
 
@@ -514,24 +556,49 @@ class DynamicBatcher:
     def _dispatch(self, take: List[_Request], total: int) -> None:
         bucket = self.ladder.snap(total)
         t0 = time.monotonic()
+        _debug_server.note_activity("serving")
+        stamped = any(r.tl is not None for r in take)
+        if stamped:
+            # one clock read stamps the whole batch: queue ends when
+            # its batch starts assembling
+            for r in take:
+                if r.tl is not None:
+                    r.tl.stamp("queue", t=t0)
+        trace_id = None
         try:
             feed = {}
             for n in self.predictor.feed_names:
                 a = (take[0].feed[n] if len(take) == 1
                      else np.concatenate([r.feed[n] for r in take], axis=0))
                 feed[n] = _pad_rows(a, bucket - total)
+            if stamped:
+                t_asm = time.monotonic()
+                for r in take:
+                    if r.tl is not None:
+                        r.tl.stamp("assemble", t=t_asm)
+            # chaos hook: a `delay:serving_dispatch` rule sleeps HERE,
+            # inside the dispatch phase — the latency-anatomy test
+            # injects a known-slow phase and asserts attribution names
+            # it.  Flag-free path: one cheap active() guard
+            _faults.event("serving_dispatch")
             with _obs_trace.start_span("serving::dispatch", cat="serving",
                                        root=False,
                                        tags={"model": self.name,
                                              "bucket": bucket,
-                                             "rows": total}):
+                                             "rows": total}) as sp:
                 outs = self.predictor.run(feed)
+                trace_id = getattr(sp, "trace_id", None)
+            if stamped:
+                t_disp = time.monotonic()
+                for r in take:
+                    if r.tl is not None:
+                        r.tl.stamp("dispatch", t=t_disp)
             err = None
         except Exception as e:
             outs, err = None, e
         self.stats.note_batch(total, bucket)
         with self._done_cv:
-            self._done_q.append((take, outs, err, t0))
+            self._done_q.append((take, outs, err, t0, trace_id))
             self._done_cv.notify()
 
     # -- completion --------------------------------------------------------
@@ -545,7 +612,7 @@ class DynamicBatcher:
                     if self._closed and not self._sched.is_alive():
                         return
                     self._done_cv.wait(timeout=0.2)
-                take, outs, err, t0 = self._done_q.popleft()
+                take, outs, err, t0, trace_id = self._done_q.popleft()
             now = time.monotonic()
             if err is not None:
                 for r in take:
@@ -557,11 +624,20 @@ class DynamicBatcher:
                 # materializing the first array flushes the whole
                 # batch's pending LazyFetch set in ONE device readback
                 outs = [np.asarray(o) for o in outs]
+                t_mat = time.monotonic()
                 off = 0
                 for r in take:
+                    if r.tl is not None:
+                        r.tl.stamp("device", t=t_mat)
                     r.future.set_result(
                         [o[off:off + r.rows] for o in outs])
+                    if r.tl is not None:
+                        # per-request reply stamp: slicing + future
+                        # completion, the final leg of the wall
+                        r.tl.stamp("reply")
+                        self.stats.note_phases(r.tl, trace_id=trace_id)
                     off += r.rows
+                now = time.monotonic()
                 self.stats.note_done(
                     len(take), [(now - r.t_enq) * 1e3 for r in take])
             batch_ms = (now - t0) * 1e3

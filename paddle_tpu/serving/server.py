@@ -87,8 +87,36 @@ class ServingService:
         # racing the deregistration land here) while accepted requests
         # keep flowing to completion
         self.draining = False
+        # in-flight handler count: stop(drain=True) waits for it to
+        # reach zero AFTER the batcher drains — a handler still between
+        # its future resolving and returning the reply must not have
+        # its connection severed by the socket close (the reply would
+        # be lost AFTER the batcher swore the request was answered)
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
 
     def handle(self, msg_type, trainer_id, name, payload):
+        with self._inflight_cv:
+            self._inflight += 1
+        try:
+            return self._handle(msg_type, trainer_id, name, payload)
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def wait_idle(self, timeout: float) -> bool:
+        """Block until no handler is inside :meth:`handle` (bounded)."""
+        deadline = time.monotonic() + timeout
+        with self._inflight_cv:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._inflight_cv.wait(timeout=min(left, 0.2))
+        return True
+
+    def _handle(self, msg_type, trainer_id, name, payload):
         if msg_type == INFER:
             if self.draining:
                 e = Draining(name, self.endpoint)
@@ -225,8 +253,20 @@ class ModelServer:
                     _flight.note("serving_drain_timeout",
                                  model=f"{sm.name}@{sm.version}",
                                  endpoint=self.endpoint)
+            # the batcher resolving every future is necessary but not
+            # sufficient: a handler thread can still be BETWEEN its
+            # future resolving and writing the reply when the socket
+            # close below severs its connection (seen as a flaky
+            # ConnectionError on the very request drain promised to
+            # answer).  Wait for the handlers themselves
+            if not self.service.wait_idle(
+                    max(0.1, deadline - time.monotonic())):
+                _flight.note("serving_drain_handler_timeout",
+                             endpoint=self.endpoint)
         _debug_server.unregister_servingz(self.endpoint)
-        self._server.stop()
+        # drain: the transport grants mid-reply connections a bounded
+        # grace so the last replies reach the wire before severing
+        self._server.stop(graceful_s=2.0 if drain else 0.0)
         if self._own_manager:
             self.manager.close()
 
@@ -265,6 +305,15 @@ class ModelServer:
                 snap = sm.batcher.stats.snapshot()
                 out["qps"] = snap.get("qps", 0.0)
                 out["queue_rows"] = sm.batcher.queue_rows()
+                if "p99_ms" in snap:
+                    out["p99_ms"] = snap["p99_ms"]
+                # latency anatomy rides the lease payload (present iff
+                # FLAGS_phase_attribution): the fleet sees WHERE each
+                # replica's tail goes, not just that it grew
+                ph = snap.get("phases")
+                if ph and ph.get("slowest_phase"):
+                    out["slowest_phase"] = ph["slowest_phase"]
+                    out["phase_total_p99_ms"] = ph.get("total_p99_ms")
             except KeyError:
                 pass
             return out

@@ -57,18 +57,27 @@ METRIC_KEYS = (
     "pipeline_vs_link",
     "ckpt_overhead_frac",
     "recovery_mttr_s",
+    "decode_ttft_ms_p99",
 )
 
 # cost-style headlines where SMALLER is the good direction (e.g. the
 # async-snapshot step-loop overhead fraction): the delta sign flips for
 # classification, the reported delta stays raw
-LOWER_BETTER_KEYS = frozenset({"ckpt_overhead_frac", "recovery_mttr_s"})
+LOWER_BETTER_KEYS = frozenset({"ckpt_overhead_frac", "recovery_mttr_s",
+                               "decode_ttft_ms_p99"})
 
 # lower-better keys in ABSOLUTE units (seconds, not a fraction): their
 # delta is relative when the baseline is positive — a 3 s -> 3.5 s MTTR
 # drift is a 17% regression, while fraction keys (legitimately-0.0
 # baselines) keep absolute-delta comparison
-LOWER_BETTER_RELATIVE_KEYS = frozenset({"recovery_mttr_s"})
+LOWER_BETTER_RELATIVE_KEYS = frozenset({"recovery_mttr_s",
+                                        "decode_ttft_ms_p99"})
+
+# tail-latency keys gated IN ADDITION to a config's headline: a round
+# whose decode throughput held but whose TTFT p99 doubled must still
+# read regression.  Each secondary present in BOTH rounds gets its own
+# "<config>:<key>" entry with the same classification machinery
+SECONDARY_GATE_KEYS = ("decode_ttft_ms_p99",)
 
 DEFAULT_THRESHOLD = 0.10
 
@@ -202,44 +211,63 @@ def compare(old: dict, new: dict,
             out["incomparable"].append(name)
             out["configs"][name] = ent
             continue
-        if key in LOWER_BETTER_KEYS:
-            # cost headline: sign flipped so "delta below -threshold"
-            # still reads regression downstream; fractions compare by
-            # absolute delta (0.0 baselines are legitimate), absolute-
-            # unit keys (seconds) relatively when the baseline allows
-            if key in LOWER_BETTER_RELATIVE_KEYS and ov > 0:
-                delta = -(nv - ov) / ov
-            else:
-                delta = -(nv - ov)
-        else:
-            delta = (nv - ov) / ov
-        ent.update({"metric": key, "old": ov, "new": nv,
-                    "delta": round(delta, 4)})
-        if key in LOWER_BETTER_KEYS:
-            ent["lower_better"] = True
-            ent["delta_abs"] = round(nv - ov, 4)
         analysis = _is_analysis(name, oc) or _is_analysis(name, nc)
-        if analysis:
-            ent["analysis"] = True
-        if delta < -threshold:
-            ent["status"] = "regression"
-        elif delta > threshold:
-            ent["status"] = "improvement"
-        else:
-            ent["status"] = "within_noise"
-        # analysis entries inform, never gate
-        if analysis and ent["status"] == "regression":
-            ent["status"] = "regression_analysis_only"
-            out["within_noise"].append(name)
-        else:
-            out[{"regression": "regressions",
-                 "improvement": "improvements",
-                 "within_noise": "within_noise"}[ent["status"]]
-                ].append(name)
-        out["configs"][name] = ent
+        _classify(out, name, ent, key, ov, nv, threshold, analysis)
+        # tail-latency secondaries gate NEXT TO the headline: a config
+        # whose throughput held but whose TTFT p99 blew out must still
+        # read regression (entries keyed "<config>:<metric>")
+        for skey in SECONDARY_GATE_KEYS:
+            if skey == key:
+                continue
+            sov, snv = oc.get(skey), nc.get(skey)
+            if isinstance(sov, (int, float)) and \
+                    isinstance(snv, (int, float)):
+                _classify(out, f"{name}:{skey}", {}, skey,
+                          float(sov), float(snv), threshold, analysis)
     out["verdict"] = "regression" if out["regressions"] else (
         "ok" if out["within_noise"] or out["improvements"] else "empty")
     return out
+
+
+def _classify(out: dict, name: str, ent: dict, key: str,
+              ov: float, nv: float, threshold: float,
+              analysis: bool) -> None:
+    """Delta + status for one (config, metric) pair, filed into the
+    comparison dict (shared by headline and secondary-gate entries)."""
+    if key in LOWER_BETTER_KEYS:
+        # cost headline: sign flipped so "delta below -threshold"
+        # still reads regression downstream; fractions compare by
+        # absolute delta (0.0 baselines are legitimate), absolute-
+        # unit keys (seconds/ms) relatively when the baseline allows
+        if key in LOWER_BETTER_RELATIVE_KEYS and ov > 0:
+            delta = -(nv - ov) / ov
+        else:
+            delta = -(nv - ov)
+    else:
+        delta = (nv - ov) / ov
+    ent.update({"metric": key, "old": ov, "new": nv,
+                "delta": round(delta, 4)})
+    if key in LOWER_BETTER_KEYS:
+        ent["lower_better"] = True
+        ent["delta_abs"] = round(nv - ov, 4)
+    if analysis:
+        ent["analysis"] = True
+    if delta < -threshold:
+        ent["status"] = "regression"
+    elif delta > threshold:
+        ent["status"] = "improvement"
+    else:
+        ent["status"] = "within_noise"
+    # analysis entries inform, never gate
+    if analysis and ent["status"] == "regression":
+        ent["status"] = "regression_analysis_only"
+        out["within_noise"].append(name)
+    else:
+        out[{"regression": "regressions",
+             "improvement": "improvements",
+             "within_noise": "within_noise"}[ent["status"]]
+            ].append(name)
+    out["configs"][name] = ent
 
 
 def render_text(cmp: dict) -> str:

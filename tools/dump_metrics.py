@@ -15,6 +15,8 @@ Operator companion to ``paddle_tpu/observability/debug_server.py``
     python tools/dump_metrics.py 8085 --profilez      # cost/roofline
     python tools/dump_metrics.py 8085 --memz --text   # human rendering
     python tools/dump_metrics.py 8085 --decodez       # decode engines
+    python tools/dump_metrics.py 8085 --sloz          # SLO watchdog
+    python tools/dump_metrics.py 8085 --varz --window 600   # history
 
 JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
 through (optionally filtered with ``--grep``) so the output pastes
@@ -83,7 +85,17 @@ def main(argv=None) -> int:
     ap.add_argument("--decodez", action="store_true",
                     help="fetch the decode-plane page (/decodez: "
                          "per-engine slots, paged-cache occupancy, "
-                         "queue depth)")
+                         "queue depth, TTFT/TBT tails, goodput, "
+                         "phase attribution)")
+    ap.add_argument("--sloz", action="store_true",
+                    help="fetch the SLO watchdog page (/sloz: rule "
+                         "table with live values and breach state)")
+    ap.add_argument("--varz", action="store_true",
+                    help="fetch the metric-history page (/varz: "
+                         "bounded downsampled counter/gauge series)")
+    ap.add_argument("--window", type=float, default=None,
+                    help="with --varz: only samples younger than this "
+                         "many seconds (?window=)")
     ap.add_argument("--text", action="store_true",
                     help="with --memz/--profilez: the human text "
                          "rendering (?text=1) instead of JSON")
@@ -96,7 +108,7 @@ def main(argv=None) -> int:
 
     rc = 0
     if args.tracez or args.flight or args.memz or args.profilez or \
-            args.decodez:
+            args.decodez or args.sloz or args.varz:
         pages = []
         if args.tracez:
             pages.append("tracez?raw=1" if args.raw else "tracez")
@@ -109,6 +121,11 @@ def main(argv=None) -> int:
             pages.append("profilez" + suffix)
         if args.decodez:
             pages.append("decodez")
+        if args.sloz:
+            pages.append("sloz")
+        if args.varz:
+            pages.append("varz" + (f"?window={args.window:g}"
+                                   if args.window else ""))
         for page in pages:
             try:
                 body = fetch(args.host, args.port, page,

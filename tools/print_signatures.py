@@ -46,6 +46,12 @@ MODULES = [
     # CLIs: frozen so record/log-format drift is loud
     "paddle_tpu.observability.perf",
     "paddle_tpu.observability.runlog",
+    # the latency-anatomy / SLO plane (phase timelines, metric history
+    # rings, SLO watchdog): frozen so the rule grammar, ring wire form
+    # and phase-record shape drift loudly
+    "paddle_tpu.observability.phase",
+    "paddle_tpu.observability.history",
+    "paddle_tpu.observability.slo",
     "bench_compare",   # tools/bench_compare.py (tools/ on sys.path here)
     "runlog_report",   # tools/runlog_report.py
     # pipeline parallelism plane (stage transpiler, schedules, drivers,

@@ -1100,16 +1100,21 @@ def bench_serving():
     latency shows what the warm ladder buys."""
     from paddle_tpu.core import flags as _flags
 
-    # latency anatomy rides the measured window: phase attribution is
-    # host-side monotonic stamps (no device syncs), and its per-phase
-    # p99s land in the artifact so a tail regression names its phase
-    # (finally-restored: a mid-bench error must not leave the flag on
-    # to skew every later config in this process)
-    _flags.set_flags({"phase_attribution": True})
+    # latency anatomy + saturation anatomy ride the measured window:
+    # both are host-side monotonic stamps (no device syncs); per-phase
+    # p99s AND utilization/headroom land in the artifact so a tail
+    # regression names its phase and a capacity shift is visible
+    # round-over-round (finally-restored: a mid-bench error must not
+    # leave the flags on to skew every later config in this process)
+    _flags.set_flags({"phase_attribution": True,
+                      "capacity_attribution": True})
     try:
         return _bench_serving_inner()
     finally:
-        _flags.set_flags({"phase_attribution": False})
+        _flags.set_flags({"phase_attribution": False,
+                          "capacity_attribution": False})
+        from paddle_tpu.observability import capacity as _capacity
+        _capacity.reset()
 
 
 def _bench_serving_inner():
@@ -1186,6 +1191,16 @@ def _bench_serving_inner():
             res["phase_p99_ms"] = {name: ent["p99_ms"]
                                    for name, ent in psnap["phases"].items()}
             res["slowest_phase"] = psnap["slowest_phase"]
+        cap = sm.batcher.stats.capacity()
+        if cap is not None:
+            # saturation anatomy over the measured window: which phase
+            # binds, how utilized it ran, and the operational-law
+            # ceiling the run implies (informational in bench_compare)
+            csnap = cap.snapshot()
+            res["utilization"] = csnap.get("utilization")
+            res["headroom_frac"] = csnap.get("headroom_frac")
+            res["binding_phase"] = csnap.get("binding_phase")
+            res["predicted_max_qps"] = csnap.get("predicted_max_qps")
 
         if kind == "mnist":
             # hot-swap acceptance under full load: v2 warms, router
@@ -1239,6 +1254,12 @@ def _bench_serving_inner():
     out["batched_qps"] = out["mnist"]["batched_qps"]
     out["speedup_vs_sequential"] = out["mnist"]["speedup"]
     out["serving_phase_p99_ms"] = out["mnist"].get("phase_p99_ms")
+    # informational capacity keys (bench_compare carries headroom_frac
+    # without gating on it)
+    for k in ("utilization", "headroom_frac", "binding_phase",
+              "predicted_max_qps"):
+        if out["mnist"].get(k) is not None:
+            out[k] = out["mnist"][k]
     return out
 
 
@@ -1273,13 +1294,17 @@ def bench_decode():
     from paddle_tpu.core import flags as _flags
 
     # token-level tail anatomy (TTFT/TBT histograms, goodput, phases)
-    # rides the saturation window — host-side stamps, no device syncs
-    # (finally-restored like bench_serving)
-    _flags.set_flags({"phase_attribution": True})
+    # plus capacity attribution ride the saturation window — host-side
+    # stamps, no device syncs (finally-restored like bench_serving)
+    _flags.set_flags({"phase_attribution": True,
+                      "capacity_attribution": True})
     try:
         return _bench_decode_inner()
     finally:
-        _flags.set_flags({"phase_attribution": False})
+        _flags.set_flags({"phase_attribution": False,
+                          "capacity_attribution": False})
+        from paddle_tpu.observability import capacity as _capacity
+        _capacity.reset()
 
 
 def _bench_decode_inner():
@@ -1378,6 +1403,9 @@ def _bench_decode_inner():
     tbt_p99 = lat.tbt_ms.percentile(0.99) if lat else None
     goodput = lat.goodput() if lat else None
     phase_p99 = lat.phases.phase_p99_ms() if lat else None
+    # capacity snapshot BEFORE close() (close unregisters the tracker)
+    cap = eng.stats.capacity()
+    cap_snap = cap.snapshot() if cap is not None else {}
 
     # greedy parity: continuous tokens == re-prefill argmax tokens
     mismatches = sum(1 for i, r in enumerate(results)
@@ -1408,6 +1436,12 @@ def _bench_decode_inner():
         "decode_tbt_ms_p99": tbt_p99,
         "goodput": goodput,
         "phase_p99_ms": phase_p99,
+        # saturation anatomy over the continuous window (informational
+        # in bench_compare: headroom_frac never gates)
+        "utilization": cap_snap.get("utilization"),
+        "headroom_frac": cap_snap.get("headroom_frac"),
+        "binding_phase": cap_snap.get("binding_phase"),
+        "predicted_max_qps": cap_snap.get("predicted_max_qps"),
         "speedup_vs_reprefill": round(cont_tps / max(base_tps, 1e-9), 2),
         "parity": {"greedy_mismatched_requests": mismatches,
                    "requests_compared": len(reqs)},

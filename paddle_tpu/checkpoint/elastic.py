@@ -173,6 +173,9 @@ class ElasticController:
         self.hysteresis = max(1, int(hysteresis))
         self._client = _transport.RPCClient(0)
         self._cache = {"t": float("-inf"), "table": {}}
+        # lease-snapshot cache for the capacity dimension (headroom
+        # rides lease DATA, not the health table)
+        self._snap_cache = {"t": float("-inf"), "data": {}}
         # per-role [direction, consecutive observations] streak
         self._streak: Dict[str, list] = {}
 
@@ -207,6 +210,44 @@ class ElasticController:
                 for w, info in self.fleet_view().items()
                 if (role is None or info.get("role") == role)
                 and info.get("slo") == "breach"}
+
+    def headroom(self, role: Optional[str] = None) -> Dict[str, dict]:
+        """Capacity headroom per lease, read from the registry's lease
+        DATA payloads (serving/decode servers publish ``headroom_frac``
+        / ``binding_phase`` / ``predicted_max_qps`` there iff
+        FLAGS_capacity_attribution is on at the replica): {lease key:
+        {headroom_frac, binding_phase, ...}}.  ``role`` filters by the
+        announce key prefix (``SERVING`` → ``serving/``, ``DECODE`` →
+        ``decode/``).  Like :meth:`slo_breaches`, this is an
+        INFORMATIONAL decision input — empty when no replica publishes
+        capacity (flags off fleet-wide)."""
+        from ..distributed import registry as _registry_mod
+        now = time.monotonic()
+        # lazy init: controllers built without __init__ (test doubles
+        # stubbing fleet_view) still get a working cache
+        cache = getattr(self, "_snap_cache", None)
+        if cache is None:
+            cache = self._snap_cache = {"t": float("-inf"), "data": {}}
+        if now - self._snap_cache["t"] >= self.poll_ttl:
+            self._snap_cache["t"] = now
+            try:
+                snap = _registry_mod.fetch_snapshot(
+                    self._client, self.registry_ep,
+                    connect_timeout=min(2.0, max(0.5, self.poll_ttl)))
+                self._snap_cache["data"] = dict(snap.get("data") or {})
+            except Exception:
+                pass    # registry blip: keep the last view
+        prefix = {"SERVING": "serving/", "DECODE": "decode/"}.get(
+            (role or "").upper())
+        out = {}
+        for key, data in self._snap_cache["data"].items():
+            if prefix is not None and not key.startswith(prefix):
+                continue
+            if isinstance(data, dict) and "headroom_frac" in data:
+                out[key] = {k: data[k] for k in
+                            ("headroom_frac", "binding_phase",
+                             "predicted_max_qps") if k in data}
+        return out
 
     def decide(self, role: str, target: int) -> dict:
         """Grow/shrink recommendation for ``role`` against ``target``
@@ -246,4 +287,12 @@ class ElasticController:
         breaches = self.slo_breaches(role)
         if breaches:
             out["slo_breaches"] = breaches
+        # capacity headroom is the same HOLD-safe discipline: it rides
+        # the decision as `capacity`, never changes `action` (the
+        # direct input for a future saturation-driven grow — item 4(a)
+        # — without automating it here), and is absent when no replica
+        # publishes it (flags off ⇒ byte-identical decisions)
+        cap = self.headroom(role)
+        if cap:
+            out["capacity"] = cap
         return out

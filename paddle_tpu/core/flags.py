@@ -323,6 +323,40 @@ define_flag("phase_attribution", False,
             "goodput counters.  Host-side time.monotonic() stamps only "
             "— zero extra device syncs.  Off (default): no stamps, no "
             "new metric series")
+define_flag("capacity_attribution", False,
+            "phase-level utilization and capacity modeling for the "
+            "serving and decode planes (observability/capacity.py): "
+            "each pipeline component (batcher assemble/dispatch, "
+            "device materialization, reply slicing; decode prefill and "
+            "step) accounts its busy time into a bounded sliding "
+            "window, turned into *.util.* gauges, operational-law "
+            "service-time fits per shape bucket (U = X*S) and a "
+            "predicted_max_qps + headroom_frac estimate naming the "
+            "binding phase — rendered on /capacityz, merged over "
+            "STATS_PULL, and riding the serving/decode lease-data "
+            "payloads into the elastic controller as an informational "
+            "capacity input.  Host-side clock reads only — no extra "
+            "device syncs.  Off (default): no accounting, no new "
+            "metric series, heartbeats byte-identical")
+define_flag("tenant_accounting", False,
+            "per-tenant usage metering for the serving and decode "
+            "planes (observability/tenant.py): requests carrying an "
+            "optional wire-level tenant id are accounted per tenant "
+            "(requests/rows/prefill-tokens/decode-tokens/cancellations "
+            "plus device-ms attributed proportionally from the shared "
+            "batch's device wall) into a space-saving top-K heavy-"
+            "hitter sketch with an 'other' rollup, rendered on "
+            "/tenantz and merged over STATS_PULL.  Tenant ids are "
+            "CLIENT-SUPPLIED and unauthenticated — attribution, not "
+            "isolation.  Off (default): ids are ignored, no sketch, "
+            "no new metric series")
+define_flag("tenant_top_k", 20,
+            "cardinality bound of the per-tenant accounting sketch "
+            "(observability/tenant.py): at most this many tenants are "
+            "tracked exactly; past it the space-saving sketch evicts "
+            "the smallest tenant into the 'other' rollup, so an "
+            "adversarial id stream cannot grow memory or the /tenantz "
+            "payload")
 define_flag("metrics_history_interval_s", 0.0,
             "sampling period for the in-process metric history rings "
             "(observability/history.py): every counter/gauge in the "

@@ -77,15 +77,23 @@ class DecodeClient:
     def generate_stream(self, model: str, prompt, max_new_tokens: int = 32,
                         temperature: float = 0.0, top_k: int = 0,
                         seed: int = 0, eos_id: Optional[int] = None,
-                        chunk_tokens: int = 1):
+                        chunk_tokens: int = 1,
+                        tenant: Optional[str] = None):
         """Yield generated token ids as they stream; the generator's
-        return value (``StopIteration.value``) is the FIN dict."""
-        req = json.dumps({
+        return value (``StopIteration.value``) is the FIN dict.
+        ``tenant`` adds a wire-optional id for per-tenant metering —
+        the key is included ONLY when set, so requests without one are
+        byte-identical to tenant-unaware builds (old servers ignore
+        the unknown key)."""
+        body = {
             "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature), "top_k": int(top_k),
             "seed": int(seed), "eos_id": eos_id,
-            "chunk_tokens": int(chunk_tokens)}).encode("utf-8")
+            "chunk_tokens": int(chunk_tokens)}
+        if tenant:
+            body["tenant"] = str(tenant)
+        req = json.dumps(body).encode("utf-8")
         eps = self.replicas(model)
         if not eps:
             raise RuntimeError(f"no live decode replicas for {model!r}")

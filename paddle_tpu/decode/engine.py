@@ -49,9 +49,11 @@ from .model import TransformerLM
 from ..core import flags as _flags
 from ..core.executor import Executor
 from ..distributed import faults as _faults
+from ..observability import capacity as _capacity
 from ..observability import debug_server as _debug_server
 from ..observability import phase as _phase
 from ..observability import stats as _obs_stats
+from ..observability import tenant as _tenant
 from ..serving.batcher import BucketLadder, Overloaded, RequestTooLong
 
 # decode request phases (FLAGS_phase_attribution): queue = submit ->
@@ -91,13 +93,16 @@ class SamplingParams:
 
 
 class DecodeRequest:
-    __slots__ = ("rid", "prompt", "sampling", "t_enq", "handle", "tl")
+    __slots__ = ("rid", "prompt", "sampling", "t_enq", "handle", "tl",
+                 "tenant")
 
     def __init__(self, rid: int, prompt: np.ndarray,
-                 sampling: SamplingParams):
+                 sampling: SamplingParams,
+                 tenant: Optional[str] = None):
         self.rid = rid
         self.prompt = prompt
         self.sampling = sampling
+        self.tenant = tenant
         self.t_enq = time.monotonic()
         self.handle = DecodeHandle(rid)
         # phase timeline sharing the enqueue stamp (flag-gated; None
@@ -309,6 +314,16 @@ class _EngineStats:
     def lat(self) -> Optional[_LatencyStats]:
         return self._lat
 
+    def capacity_tracker(self) -> "_capacity.CapacityTracker":
+        """Get-or-create this engine's capacity tracker (callers gate
+        on ``_capacity.enabled()`` so a flag-off process never
+        registers ``decode.<name>.util.*`` series)."""
+        return _capacity.tracker(f"decode.{self._name}",
+                                 ("prefill", "decode"))
+
+    def capacity(self) -> Optional["_capacity.CapacityTracker"]:
+        return _capacity.get(f"decode.{self._name}")
+
 
 class DecodeEngine:
     """One model's stateful generative scheduler (module doc)."""
@@ -374,12 +389,14 @@ class DecodeEngine:
         return min(self.model.config.max_seq_len,
                    self.cache.max_context(self.max_blocks_per_seq))
 
-    def submit(self, prompt, sampling: Optional[SamplingParams] = None
-               ) -> DecodeHandle:
-        """Enqueue one generation.  Raises :class:`RequestTooLong`
-        (prompt off the prefill ladder or prompt+budget past the
-        context bound) or :class:`Overloaded` (queue bound) — both
-        typed, never queued."""
+    def submit(self, prompt, sampling: Optional[SamplingParams] = None,
+               tenant: Optional[str] = None) -> DecodeHandle:
+        """Enqueue one generation.  ``tenant`` is an optional
+        client-supplied id for per-tenant usage metering
+        (``FLAGS_tenant_accounting``; ignored when off).  Raises
+        :class:`RequestTooLong` (prompt off the prefill ladder or
+        prompt+budget past the context bound) or :class:`Overloaded`
+        (queue bound) — both typed, never queued."""
         sampling = sampling or SamplingParams()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -404,7 +421,10 @@ class DecodeEngine:
                 self.name, "blocks",
                 need * self.cache.block_tokens,
                 (self.cache.num_blocks - 1) * self.cache.block_tokens)
-        req = DecodeRequest(next(self._rid), prompt, sampling)
+        req = DecodeRequest(next(self._rid), prompt, sampling,
+                            tenant=tenant)
+        if _tenant.enabled():
+            _tenant.account(tenant, requests=1)
         with self._lock:
             if self._closed:
                 raise RuntimeError(f"decode engine {self.name!r} is closed")
@@ -535,7 +555,17 @@ class DecodeEngine:
         slot.t_last = time.monotonic()
         self.stats.prefills.inc()
         self.stats.tokens.inc()
-        self.stats.prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        prefill_ms = (time.perf_counter() - t0) * 1e3
+        self.stats.prefill_ms.observe(prefill_ms)
+        if _capacity.enabled():
+            # the engine thread is serial: prefill wall IS busy time
+            self.stats.capacity_tracker().note(
+                "prefill", prefill_ms, bucket=bucket, work=1)
+        if _tenant.enabled():
+            # a prefill serves exactly one request: its whole wall is
+            # that tenant's device time
+            _tenant.account(req.tenant, prefill_tokens=P,
+                            device_ms=prefill_ms)
         if req.tl is not None:
             req.tl.stamp("prefill", t=slot.t_last)
             lat = self.stats.latency()
@@ -597,7 +627,19 @@ class DecodeEngine:
         logits_np = np.asarray(logits) if self.capture_logits else None
         now = time.monotonic()
         self.stats.steps.inc()
-        self.stats.step_ms.observe((time.perf_counter() - t0) * 1e3)
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self.stats.step_ms.observe(step_ms)
+        if _capacity.enabled():
+            self.stats.capacity_tracker().note(
+                "decode", step_ms, work=len(live))
+        if _tenant.enabled():
+            # the fixed-width step's wall splits evenly over the LIVE
+            # slots (pad lanes belong to nobody), so per-tenant
+            # device-ms sums to the measured step wall
+            share = step_ms / len(live)
+            for i in live:
+                _tenant.account(self._slots[i].req.tenant,
+                                decode_tokens=1, device_ms=share)
         lat = self.stats.latency() if _phase.enabled() else None
         if lat is not None:
             lat.live_slot_steps.inc(len(live))
@@ -637,6 +679,13 @@ class DecodeEngine:
             self.stats.blocks_free.set(self.cache.allocator.free_blocks)
             self._lock.notify_all()   # blocks freed: admit the queue head
         req = slot.req
+        if _capacity.enabled():
+            self.stats.capacity_tracker().note_done(1)
+        if _tenant.enabled():
+            _tenant.account(
+                req.tenant,
+                cancellations=1 if reason == "cancelled" else 0,
+                latency_ms=(time.monotonic() - req.t_enq) * 1e3)
         if req.tl is not None:
             lat = self.stats.latency()
             if reason == "cancelled":
@@ -723,6 +772,9 @@ class DecodeEngine:
                 out["tbt_p99_ms"] = lat.tbt_ms.percentile(0.99)
             out["goodput"] = lat.goodput()
             out["phases"] = lat.phases.snapshot()
+        cap = self.stats.capacity()
+        if cap is not None:
+            out["capacity"] = cap.snapshot()
         return out
 
     # -- lifecycle ---------------------------------------------------------
@@ -745,3 +797,4 @@ class DecodeEngine:
             self._lock.notify_all()
         self._thread.join(timeout=timeout)
         _debug_server.unregister_decodez(self.name)
+        _capacity.unregister(f"decode.{self.name}")

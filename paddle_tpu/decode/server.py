@@ -97,8 +97,15 @@ class DecodeService:
                     f"decode: unknown model {name!r}".encode()
             sampling = SamplingParams.from_dict(body)
             chunk = max(1, int(body.get("chunk_tokens", 1)))
+            # wire-optional tenant id: present only when the client set
+            # one (old peers ignore unknown JSON keys — interop both
+            # ways, absent ⇒ byte-identical request bodies)
+            tenant = body.get("tenant")
+            if not isinstance(tenant, str) or not tenant:
+                tenant = None
             try:
-                handle = eng.submit(body.get("prompt") or [], sampling)
+                handle = eng.submit(body.get("prompt") or [], sampling,
+                                    tenant=tenant)
             except Overloaded as e:
                 return transport.OK, [
                     _TAG_OVERLOAD + json.dumps(e.to_dict()).encode("utf-8")]
@@ -284,6 +291,15 @@ class DecodeServer:
                 for k in ("ttft_p99_ms", "tbt_p99_ms"):
                     if k in z:
                         out[k] = z[k]
+                # capacity headroom rides the same lease payload
+                # (present iff FLAGS_capacity_attribution with
+                # completed work): the fleet reads saturation, not
+                # just liveness
+                cap = eng.stats.capacity()
+                if cap is not None:
+                    hr = cap.headroom()
+                    if hr is not None:
+                        out.update(hr)
             return out
         return data
 

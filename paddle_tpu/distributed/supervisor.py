@@ -378,6 +378,11 @@ class Supervisor:
         self._next_cut = 0.0
         self._health: Dict[str, dict] = {}
         self._leases: Dict[str, str] = {}
+        # capacity headroom harvested from lease DATA payloads
+        # (FLAGS_capacity_attribution at the replicas): {lease key:
+        # {headroom_frac, binding_phase, ...}} — empty when no replica
+        # publishes it, so flags-off /fleetz is unchanged
+        self._headroom: Dict[str, dict] = {}
         # SLO-breach observation (heartbeat slo dimension): per-worker
         # consecutive-poll streaks, and the confirmed-breach set after
         # spec.hysteresis agreeing observations
@@ -579,6 +584,7 @@ class Supervisor:
             holds = dict(self._holds)
             roles = {}
             now = time.monotonic()
+            headroom = dict(self._headroom)
             for r, rs in self.spec.roles.items():
                 window = [t for t in self._deaths.get(r, ())
                           if now - t <= rs.restart_window_s]
@@ -586,6 +592,18 @@ class Supervisor:
                             "restart_budget": rs.restart_budget,
                             "deaths_in_window": len(window),
                             "hold": holds.get(r)}
+                # lease-data capacity next to liveness: the tightest
+                # replica's headroom, matched by the announce-key
+                # prefix of the role's health plane (serving/decode)
+                prefix = {"SERVING": "serving/",
+                          "DECODE": "decode/"}.get(
+                    (rs.health_role or "").upper())
+                if prefix:
+                    fracs = [v["headroom_frac"]
+                             for k, v in headroom.items()
+                             if k.startswith(prefix)]
+                    if fracs:
+                        roles[r]["headroom_frac"] = min(fracs)
         with self.lock:
             slo = {w: list(r) for w, r in self._slo_confirmed.items()}
         out = {"fleet": self.spec.name,
@@ -594,6 +612,8 @@ class Supervisor:
                "rollback_roles": list(self.spec.rollback_roles),
                "roles": roles, "workers": workers,
                "slo_breaches": slo}
+        if headroom:
+            out["headroom"] = headroom
         root = self.spec.checkpoint_root
         if root:
             out["checkpoint"] = {
@@ -736,8 +756,15 @@ class Supervisor:
             return              # registry blip: keep the last view
         leases = {k: v["endpoint"]
                   for k, v in (snap.get("leases") or {}).items()}
+        headroom = {}
+        for key, data in (snap.get("data") or {}).items():
+            if isinstance(data, dict) and "headroom_frac" in data:
+                headroom[key] = {k: data[k] for k in
+                                 ("headroom_frac", "binding_phase",
+                                  "predicted_max_qps") if k in data}
         with self.lock:
             self._leases = leases
+            self._headroom = headroom
             self._health = health
             self._observe_slo_locked(health)
             for w in self.workers.values():

@@ -44,6 +44,20 @@ The latency-anatomy / SLO plane (all strictly flag-gated):
   a downsampled time series, served on ``/varz?window=...`` and
   carried (age-aligned, clock-skew-proof) through the STATS_PULL
   fleet merge.
+- :mod:`capacity` — phase-level utilization + capacity modeling
+  (``FLAGS_capacity_attribution``): per-component busy-time windows
+  (``*.util.*`` gauges), operational-law service-time fits (U = X·S),
+  ``predicted_max_qps`` / ``headroom_frac`` with a saturation verdict
+  naming the binding phase; served on ``/capacityz``, merged over
+  STATS_PULL, riding serving/decode lease data into the
+  ElasticController's HOLD-safe ``capacity`` input.
+- :mod:`tenant` — per-tenant usage metering
+  (``FLAGS_tenant_accounting``): wire-optional tenant ids accounted
+  into a space-saving top-K sketch (requests/rows/tokens/cancellations
+  + proportionally attributed device-ms, per-tenant p99); served on
+  ``/tenantz``, fleet-merged so a fleet-wide heavy hitter is visible
+  from one endpoint.  Ids are client-supplied — attribution, not
+  isolation.
 - :mod:`slo` — the declarative SLO watchdog (``FLAGS_slo_rules``):
   metric × percentile/rate × threshold × sustain-window rules
   evaluated in-process; breaches count, leave flight notes, render on
@@ -68,6 +82,7 @@ from __future__ import annotations
 
 from . import (  # noqa: F401
     aggregate,
+    capacity,
     debug_server,
     flight,
     health,
@@ -78,6 +93,7 @@ from . import (  # noqa: F401
     slo,
     stats,
     step_stats,
+    tenant,
     trace,
 )
 from .aggregate import FleetAggregator  # noqa: F401

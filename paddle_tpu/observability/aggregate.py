@@ -26,8 +26,10 @@ from __future__ import annotations
 import json
 from typing import Dict, Mapping, Optional
 
+from . import capacity as _capacity
 from . import history as _history
 from . import stats as _stats
+from . import tenant as _tenant
 from . import trace as _trace
 
 # wire form version guard (payloads cross processes of possibly
@@ -47,6 +49,15 @@ def local_snapshot_payload() -> bytes:
     hist = _history.export_history()
     if hist is not None:
         state["history"] = hist
+    # saturation-anatomy riders (FLAGS_capacity_attribution /
+    # FLAGS_tenant_accounting): same byte-identity discipline — the
+    # key exists only when the plane is armed and has data
+    cap = _capacity.export_state()
+    if cap is not None:
+        state["capacity"] = cap
+    ten = _tenant.export_state()
+    if ten is not None:
+        state["tenants"] = ten
     return json.dumps(state).encode("utf-8")
 
 
@@ -89,10 +100,19 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
     # worker's own pull — summing or zipping across workers would
     # invent alignment the clocks never had)
     history: Dict[str, dict] = {}
+    # capacity snapshots stay per-worker AND roll into a fleet view
+    # (summed ceilings, min headroom); tenant tables merge into one
+    # fleet-wide heavy-hitter table
+    capacity_pw: Dict[str, dict] = {}
+    tenants_pw: Dict[str, dict] = {}
     for worker in sorted(per_worker):
         state = per_worker[worker]
         if isinstance(state.get("history"), dict):
             history[worker] = state["history"]
+        if isinstance(state.get("capacity"), dict):
+            capacity_pw[worker] = state["capacity"]
+        if isinstance(state.get("tenants"), dict):
+            tenants_pw[worker] = state["tenants"]
         for name, m in state.get("metrics", {}).items():
             kind = m.get("kind")
             if kind == "counter":
@@ -116,6 +136,11 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
            "counters": counters, "gauges": gauges, "histograms": hists}
     if history:
         out["history"] = history
+    if capacity_pw:
+        out["capacity"] = {"per_worker": capacity_pw,
+                           "fleet": _capacity.merge_states(capacity_pw)}
+    if tenants_pw:
+        out["tenants"] = _tenant.merge_states(tenants_pw)
     return out
 
 

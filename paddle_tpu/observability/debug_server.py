@@ -251,7 +251,7 @@ def _healthz() -> dict:
     # and iterating the live dict could 500 a healthy process's probe
     for plane, ts in sorted(dict(_activity).items()):
         ages[plane] = round(now - ts, 3)
-    return {
+    out = {
         "status": "ok",
         "role": _current_role(),
         "uptime_s": round(now - _START_TIME, 3),
@@ -260,6 +260,15 @@ def _healthz() -> dict:
         "last_step_age_s": (min(ages.values()) if ages else None),
         "activity_age_s": ages,
     }
+    # load next to liveness (FLAGS_capacity_attribution): a drained-
+    # but-saturated replica must read differently from an idle one.
+    # Flag off ⇒ no key, payload identical to the pre-capacity build
+    from . import capacity as _capacity
+    if _capacity.enabled():
+        hr = _capacity.headroom()
+        if hr:
+            out["headroom"] = hr
+    return out
 
 
 def _statusz() -> dict:
@@ -404,6 +413,27 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, json.dumps(_slo.sloz(), indent=2,
                                             default=repr),
                             "application/json")
+            elif path in ("/capacityz", "/tenantz"):
+                # the saturation-anatomy plane (observability/
+                # capacity.py + tenant.py): phase-level utilization,
+                # operational-law headroom and per-tenant usage
+                # metering.  JSON by default, ?text=1 for the human
+                # rendering (tools/dump_metrics.py --capacityz /
+                # --tenantz is the operator CLI)
+                from urllib.parse import parse_qs
+                from . import capacity as _capacity
+                from . import tenant as _tenant
+                q = parse_qs(query)
+                text = q.get("text", ["0"])[0] not in ("0", "", "false")
+                if path == "/capacityz":
+                    body = (_capacity.capacityz_text() if text
+                            else json.dumps(_capacity.capacityz(),
+                                            indent=2))
+                else:
+                    body = (_tenant.tenantz_text() if text
+                            else json.dumps(_tenant.tenantz(), indent=2))
+                self._reply(200, body,
+                            "text/plain" if text else "application/json")
             elif path == "/chaosz":
                 # fault-injection control plane (distributed/faults.py):
                 # ?inject=<spec> arms rules, ?clear=1 removes runtime
@@ -443,6 +473,9 @@ class _Handler(BaseHTTPRequestHandler):
                      "/varz  (metric history rings; ?window=<s> "
                      "?grep=<substr>)",
                      "/sloz  (SLO watchdog rule table)",
+                     "/capacityz  (phase utilization + headroom; "
+                     "?text=1)",
+                     "/tenantz  (per-tenant usage metering; ?text=1)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),
                     "text/plain")
             else:

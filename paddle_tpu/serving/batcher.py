@@ -45,8 +45,10 @@ import numpy as np
 from ..core import flags as _flags
 from ..core.types import np_dtype
 from ..distributed import faults as _faults
+from ..observability import capacity as _capacity
 from ..observability import debug_server as _debug_server
 from ..observability import phase as _phase
+from ..observability import tenant as _tenant
 from ..observability import stats as _obs_stats
 from ..observability import trace as _obs_trace
 
@@ -62,6 +64,9 @@ from ..observability import trace as _obs_trace
 #             the one batched readback, incl. completion-queue wait)
 #   reply     materialized -> this request's future completed
 SERVING_PHASES = ("queue", "assemble", "dispatch", "device", "reply")
+# capacity-tracked components: the phases that consume a worker
+# thread's wall ("queue" is waiting, not busy — it never saturates)
+SERVING_CAPACITY_COMPONENTS = ("assemble", "dispatch", "device", "reply")
 
 
 class Overloaded(RuntimeError):
@@ -197,11 +202,13 @@ class BucketLadder:
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "future", "t_enq", "tl")
+    __slots__ = ("feed", "rows", "future", "t_enq", "tl", "tenant")
 
-    def __init__(self, feed: Dict[str, np.ndarray], rows: int):
+    def __init__(self, feed: Dict[str, np.ndarray], rows: int,
+                 tenant: Optional[str] = None):
         self.feed = feed
         self.rows = rows
+        self.tenant = tenant
         self.future: "Future" = Future()
         self.t_enq = time.monotonic()
         # phase timeline, sharing the enqueue stamp (flag-gated; None
@@ -302,6 +309,16 @@ class BatcherStats:
         with self._lock:
             return self._phases
 
+    def capacity_tracker(self) -> "_capacity.CapacityTracker":
+        """Get-or-create this model's capacity tracker (callers gate on
+        ``_capacity.enabled()`` so a flag-off process never registers
+        ``serving.<model>.util.*`` series)."""
+        return _capacity.tracker(f"serving.{self.model}",
+                                 SERVING_CAPACITY_COMPONENTS)
+
+    def capacity(self) -> Optional["_capacity.CapacityTracker"]:
+        return _capacity.get(f"serving.{self.model}")
+
     def snapshot(self) -> dict:
         now = time.monotonic()
         with self._lock:
@@ -328,6 +345,9 @@ class BatcherStats:
             })
         if phases is not None:
             out["phases"] = phases.snapshot()
+        cap = self.capacity()
+        if cap is not None:
+            out["capacity"] = cap.snapshot()
         return out
 
 
@@ -430,9 +450,12 @@ class DynamicBatcher:
         self._completer.start()
 
     # -- request side ------------------------------------------------------
-    def submit(self, feed: Dict[str, np.ndarray]) -> "Future":
+    def submit(self, feed: Dict[str, np.ndarray],
+               tenant: Optional[str] = None) -> "Future":
         """Enqueue one request; the Future resolves to the list of fetch
-        arrays (leading dim = this request's rows).  Raises
+        arrays (leading dim = this request's rows).  ``tenant`` is an
+        optional client-supplied id for per-tenant usage metering
+        (``FLAGS_tenant_accounting``; ignored when off).  Raises
         :class:`Overloaded` (shed, never queued) or ``ValueError``
         (malformed feed / batch beyond the top bucket)."""
         arrs = {}
@@ -470,7 +493,7 @@ class DynamicBatcher:
             raise ValueError(
                 f"request of {rows} rows exceeds the top bucket "
                 f"{self.ladder.max}; split it client-side")
-        req = _Request(arrs, rows)
+        req = _Request(arrs, rows, tenant=tenant)
         with self._cv:
             if self._closed:
                 raise RuntimeError(f"batcher {self.name!r} is closed")
@@ -510,12 +533,15 @@ class DynamicBatcher:
             self.stats.set_depth(self._rows_queued)
             self._cv.notify_all()
         self.stats.note_submit(rows)
+        if _tenant.enabled():
+            _tenant.account(tenant, requests=1, rows=rows)
         return req.future
 
     def infer(self, feed: Dict[str, np.ndarray],
-              timeout: Optional[float] = None) -> List[np.ndarray]:
+              timeout: Optional[float] = None,
+              tenant: Optional[str] = None) -> List[np.ndarray]:
         """Blocking convenience over :meth:`submit`."""
-        return self.submit(feed).result(timeout=timeout)
+        return self.submit(feed, tenant=tenant).result(timeout=timeout)
 
     # -- scheduler ---------------------------------------------------------
     def _sched_loop(self) -> None:
@@ -558,6 +584,8 @@ class DynamicBatcher:
         t0 = time.monotonic()
         _debug_server.note_activity("serving")
         stamped = any(r.tl is not None for r in take)
+        cap = (self.stats.capacity_tracker()
+               if _capacity.enabled() else None)
         if stamped:
             # one clock read stamps the whole batch: queue ends when
             # its batch starts assembling
@@ -565,14 +593,16 @@ class DynamicBatcher:
                 if r.tl is not None:
                     r.tl.stamp("queue", t=t0)
         trace_id = None
+        t_asm = t_disp = None
         try:
             feed = {}
             for n in self.predictor.feed_names:
                 a = (take[0].feed[n] if len(take) == 1
                      else np.concatenate([r.feed[n] for r in take], axis=0))
                 feed[n] = _pad_rows(a, bucket - total)
-            if stamped:
+            if stamped or cap is not None:
                 t_asm = time.monotonic()
+            if stamped:
                 for r in take:
                     if r.tl is not None:
                         r.tl.stamp("assemble", t=t_asm)
@@ -588,17 +618,24 @@ class DynamicBatcher:
                                              "rows": total}) as sp:
                 outs = self.predictor.run(feed)
                 trace_id = getattr(sp, "trace_id", None)
-            if stamped:
+            if stamped or cap is not None:
                 t_disp = time.monotonic()
+            if stamped:
                 for r in take:
                     if r.tl is not None:
                         r.tl.stamp("dispatch", t=t_disp)
             err = None
         except Exception as e:
             outs, err = None, e
+        if cap is not None and t_disp is not None:
+            # the scheduler thread's busy legs: ONE span per batch
+            # (batch members share the wall — per-request would
+            # double-count), so windowed busy/wall is a utilization
+            cap.note("assemble", (t_asm - t0) * 1e3)
+            cap.note("dispatch", (t_disp - t_asm) * 1e3)
         self.stats.note_batch(total, bucket)
         with self._done_cv:
-            self._done_q.append((take, outs, err, t0, trace_id))
+            self._done_q.append((take, outs, err, t0, trace_id, bucket))
             self._done_cv.notify()
 
     # -- completion --------------------------------------------------------
@@ -612,7 +649,8 @@ class DynamicBatcher:
                     if self._closed and not self._sched.is_alive():
                         return
                     self._done_cv.wait(timeout=0.2)
-                take, outs, err, t0, trace_id = self._done_q.popleft()
+                take, outs, err, t0, trace_id, bucket = \
+                    self._done_q.popleft()
             now = time.monotonic()
             if err is not None:
                 for r in take:
@@ -621,10 +659,22 @@ class DynamicBatcher:
                     len(take), [(now - r.t_enq) * 1e3 for r in take],
                     error=True)
             else:
+                cap = (self.stats.capacity_tracker()
+                       if _capacity.enabled() else None)
                 # materializing the first array flushes the whole
                 # batch's pending LazyFetch set in ONE device readback
                 outs = [np.asarray(o) for o in outs]
                 t_mat = time.monotonic()
+                total = sum(r.rows for r in take)
+                if cap is not None:
+                    # device busy counts from popleft (`now`), not
+                    # from dispatch: batches queue in _done_q behind
+                    # prior materializations, and that wait is the
+                    # PREVIOUS batch's device time
+                    cap.note("device", (t_mat - now) * 1e3,
+                             bucket=bucket, work=total)
+                ten_on = _tenant.enabled()
+                dev_ms = (t_mat - now) * 1e3 if ten_on else 0.0
                 off = 0
                 for r in take:
                     if r.tl is not None:
@@ -638,6 +688,18 @@ class DynamicBatcher:
                         self.stats.note_phases(r.tl, trace_id=trace_id)
                     off += r.rows
                 now = time.monotonic()
+                if cap is not None:
+                    cap.note("reply", (now - t_mat) * 1e3)
+                    cap.note_done(len(take))
+                if ten_on:
+                    # the shared batch's device wall splits by row
+                    # share, so per-tenant device-ms sums to the
+                    # measured wall by construction
+                    for r in take:
+                        _tenant.account(
+                            r.tenant,
+                            device_ms=dev_ms * (r.rows / max(total, 1)),
+                            latency_ms=(now - r.t_enq) * 1e3)
                 self.stats.note_done(
                     len(take), [(now - r.t_enq) * 1e3 for r in take])
             batch_ms = (now - t0) * 1e3
@@ -673,6 +735,7 @@ class DynamicBatcher:
             self._done_cv.notify_all()
         self._sched.join(timeout=timeout)
         self._completer.join(timeout=timeout)
+        _capacity.unregister(f"serving.{self.stats.model}")
 
     def queue_rows(self) -> int:
         with self._cv:

@@ -107,10 +107,18 @@ class ServingClient:
 
     # -- inference ---------------------------------------------------------
     def infer_pairs(self, model: str,
-                    feed: Dict[str, np.ndarray]) -> List[Tuple[str, object]]:
+                    feed: Dict[str, np.ndarray],
+                    tenant: Optional[str] = None) -> List[Tuple[str, object]]:
         """One inference: returns the server's fetch ``(name, array)``
-        pairs, failing over across replicas (module doc)."""
+        pairs, failing over across replicas (module doc).  ``tenant``
+        rides as a reserved serde pair ONLY when set — absent, the
+        frame is byte-identical to tenant-unaware builds, and an old
+        server ignores the extra feed (interop both ways)."""
         pairs = [(n, np.asarray(v)) for n, v in sorted(feed.items())]
+        if tenant:
+            pairs.append((_server.TENANT_FEED_KEY,
+                          np.frombuffer(str(tenant).encode("utf-8"),
+                                        np.uint8)))
         payload = serde.dumps_batch_vec(pairs)
         eps = self._routable(model)
         if not eps:
@@ -153,9 +161,11 @@ class ServingClient:
             f"no replica answered for model {model!r}")
 
     def infer(self, model: str,
-              feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+              feed: Dict[str, np.ndarray],
+              tenant: Optional[str] = None) -> List[np.ndarray]:
         """Fetch arrays in the server's fetch order."""
-        return [np.asarray(v) for _, v in self.infer_pairs(model, feed)]
+        return [np.asarray(v)
+                for _, v in self.infer_pairs(model, feed, tenant=tenant)]
 
     # -- admin -------------------------------------------------------------
     def admin(self, endpoint: str, command: dict) -> dict:

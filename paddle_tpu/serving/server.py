@@ -54,6 +54,10 @@ transport.MSG_NAMES.update({INFER: "infer",
                             SERVING_ADMIN: "serving_admin"})
 
 # INFER reply tag bytes (first payload byte)
+# reserved serde feed name carrying an optional utf-8 tenant id
+# (uint8 bytes); never a real model feed, popped before validation
+TENANT_FEED_KEY = "__tenant__"
+
 _TAG_RESULT = b"R"
 _TAG_OVERLOAD = b"O"
 _TAG_TOO_LONG = b"L"
@@ -123,8 +127,18 @@ class ServingService:
                 return transport.OK, [
                     _TAG_DRAINING + json.dumps(e.to_dict()).encode("utf-8")]
             feed = dict(serde.loads_batch(payload, copy=False))
+            # wire-optional tenant id: a reserved serde pair the client
+            # appends ONLY when set (absent ⇒ frames byte-identical to
+            # tenant-unaware builds; old servers ignore the extra feed)
+            tenant = None
+            t_arr = feed.pop(TENANT_FEED_KEY, None)
+            if t_arr is not None:
+                import numpy as _np
+                tenant = bytes(_np.asarray(t_arr, _np.uint8)).decode(
+                    "utf-8", "replace") or None
             try:
-                fut, sm = self.manager.serve_request(name, feed)
+                fut, sm = self.manager.serve_request(name, feed,
+                                                     tenant=tenant)
             except Overloaded as e:
                 return transport.OK, [
                     _TAG_OVERLOAD + json.dumps(e.to_dict()).encode("utf-8")]
@@ -314,6 +328,15 @@ class ModelServer:
                 if ph and ph.get("slowest_phase"):
                     out["slowest_phase"] = ph["slowest_phase"]
                     out["phase_total_p99_ms"] = ph.get("total_p99_ms")
+                # capacity headroom rides the same lease payload
+                # (present iff FLAGS_capacity_attribution and the
+                # tracker has completed work): a drained-but-saturated
+                # replica reads differently from an idle one fleet-wide
+                cap = sm.batcher.stats.capacity()
+                if cap is not None:
+                    hr = cap.headroom()
+                    if hr is not None:
+                        out.update(hr)
             except KeyError:
                 pass
             return out

@@ -79,6 +79,12 @@ LOWER_BETTER_RELATIVE_KEYS = frozenset({"recovery_mttr_s",
 # "<config>:<key>" entry with the same classification machinery
 SECONDARY_GATE_KEYS = ("decode_ttft_ms_p99",)
 
+# informational keys carried through the comparison WITHOUT gating:
+# recorded per config when present in either round (the evidence
+# chain keeps capacity headroom round-over-round), never classified,
+# never part of the verdict
+INFORMATIONAL_KEYS = ("headroom_frac",)
+
 DEFAULT_THRESHOLD = 0.10
 
 # configs that are analysis-only BY NATURE (cost-model numbers): rounds
@@ -213,6 +219,13 @@ def compare(old: dict, new: dict,
             continue
         analysis = _is_analysis(name, oc) or _is_analysis(name, nc)
         _classify(out, name, ent, key, ov, nv, threshold, analysis)
+        # informational carry-through: recorded, never classified
+        for ikey in INFORMATIONAL_KEYS:
+            iov, inv = oc.get(ikey), nc.get(ikey)
+            if isinstance(iov, (int, float)) or \
+                    isinstance(inv, (int, float)):
+                ent.setdefault("info", {})[ikey] = {"old": iov,
+                                                    "new": inv}
         # tail-latency secondaries gate NEXT TO the headline: a config
         # whose throughput held but whose TTFT p99 blew out must still
         # read regression (entries keyed "<config>:<metric>")

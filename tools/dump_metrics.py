@@ -17,6 +17,8 @@ Operator companion to ``paddle_tpu/observability/debug_server.py``
     python tools/dump_metrics.py 8085 --decodez       # decode engines
     python tools/dump_metrics.py 8085 --sloz          # SLO watchdog
     python tools/dump_metrics.py 8085 --varz --window 600   # history
+    python tools/dump_metrics.py 8085 --capacityz     # util + headroom
+    python tools/dump_metrics.py 8085 --tenantz --text  # tenant table
 
 JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
 through (optionally filtered with ``--grep``) so the output pastes
@@ -96,9 +98,19 @@ def main(argv=None) -> int:
     ap.add_argument("--window", type=float, default=None,
                     help="with --varz: only samples younger than this "
                          "many seconds (?window=)")
+    ap.add_argument("--capacityz", action="store_true",
+                    help="fetch the capacity page (/capacityz: per-"
+                         "pipeline phase utilization, operational-law "
+                         "service fits, predicted_max_qps + headroom "
+                         "with the binding phase named)")
+    ap.add_argument("--tenantz", action="store_true",
+                    help="fetch the per-tenant usage page (/tenantz: "
+                         "top-K heavy-hitter table with requests/rows/"
+                         "tokens/device-ms and the `other` rollup)")
     ap.add_argument("--text", action="store_true",
-                    help="with --memz/--profilez: the human text "
-                         "rendering (?text=1) instead of JSON")
+                    help="with --memz/--profilez/--capacityz/--tenantz:"
+                         " the human text rendering (?text=1) instead "
+                         "of JSON")
     ap.add_argument("port", type=int,
                     help="the worker's FLAGS_debug_server_port")
     ap.add_argument("pages", nargs="*", default=list(DEFAULT_PAGES),
@@ -108,7 +120,8 @@ def main(argv=None) -> int:
 
     rc = 0
     if args.tracez or args.flight or args.memz or args.profilez or \
-            args.decodez or args.sloz or args.varz:
+            args.decodez or args.sloz or args.varz or \
+            args.capacityz or args.tenantz:
         pages = []
         if args.tracez:
             pages.append("tracez?raw=1" if args.raw else "tracez")
@@ -126,6 +139,10 @@ def main(argv=None) -> int:
         if args.varz:
             pages.append("varz" + (f"?window={args.window:g}"
                                    if args.window else ""))
+        if args.capacityz:
+            pages.append("capacityz" + suffix)
+        if args.tenantz:
+            pages.append("tenantz" + suffix)
         for page in pages:
             try:
                 body = fetch(args.host, args.port, page,

@@ -170,7 +170,36 @@ def main(argv=None) -> int:
             params["wait"] = str(args.wait)
     out = fleetz_request(args.endpoint, params)
     print(json.dumps(out, indent=2, default=repr))
+    if args.cmd == "status" and isinstance(out, dict):
+        _print_role_table(out)
     return 2 if isinstance(out, dict) and "error" in out else 0
+
+
+def _print_role_table(out: dict) -> None:
+    """Per-role summary under the JSON card: liveness, SLO breaches
+    and — when replicas publish capacity (FLAGS_capacity_attribution)
+    — the tightest replica's headroom next to the SLO column."""
+    fleets = out if all(isinstance(v, dict) and "roles" in v
+                        for v in out.values()) and out else {"": out}
+    for fname, status in fleets.items():
+        roles = status.get("roles")
+        if not isinstance(roles, dict) or not roles:
+            continue
+        slo = status.get("slo_breaches") or {}
+        print()
+        title = f"fleet {status.get('fleet', fname) or fname}"
+        print(f"{title}  [{status.get('state', '?')}]")
+        print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}".format(
+            "role", "count", "target", "hold", "slo_breach", "headroom"))
+        for r in sorted(roles):
+            rs = roles[r]
+            n_slo = sum(1 for w in slo if str(w).startswith(f"{r}-"))
+            hr = rs.get("headroom_frac")
+            print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}".format(
+                r, rs.get("count", "?"), rs.get("target", "?"),
+                "yes" if rs.get("hold") else "-",
+                n_slo or "-",
+                f"{hr:.1%}" if isinstance(hr, (int, float)) else "-"))
 
 
 if __name__ == "__main__":

@@ -52,6 +52,11 @@ MODULES = [
     "paddle_tpu.observability.phase",
     "paddle_tpu.observability.history",
     "paddle_tpu.observability.slo",
+    # the saturation-anatomy plane (phase utilization + capacity
+    # modeling, per-tenant metering): frozen so the snapshot shapes
+    # and the STATS_PULL rider forms drift loudly
+    "paddle_tpu.observability.capacity",
+    "paddle_tpu.observability.tenant",
     "bench_compare",   # tools/bench_compare.py (tools/ on sys.path here)
     "runlog_report",   # tools/runlog_report.py
     # pipeline parallelism plane (stage transpiler, schedules, drivers,

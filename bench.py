@@ -1106,14 +1106,26 @@ def bench_serving():
     # regression names its phase and a capacity shift is visible
     # round-over-round (finally-restored: a mid-bench error must not
     # leave the flags on to skew every later config in this process)
+    # the golden canary probes ride the measured window too
+    # (FLAGS_canary_probe at a bench cadence): goldens are recorded
+    # against the live manager before load starts, so the artifact
+    # carries canary_overhead_frac (what correctness probing costs) and
+    # canary_failures (0 on a healthy build — a secondary gate in
+    # tools/bench_compare.py)
     _flags.set_flags({"phase_attribution": True,
-                      "capacity_attribution": True})
+                      "capacity_attribution": True,
+                      "canary_probe": True,
+                      "canary_interval_s": 0.25})
     try:
         return _bench_serving_inner()
     finally:
         _flags.set_flags({"phase_attribution": False,
-                          "capacity_attribution": False})
+                          "capacity_attribution": False,
+                          "canary_probe": False,
+                          "canary_interval_s": 5.0})
+        from paddle_tpu.observability import canary as _canary
         from paddle_tpu.observability import capacity as _capacity
+        _canary.reset()
         _capacity.reset()
 
 
@@ -1167,9 +1179,36 @@ def _bench_serving_inner():
         t0 = time.perf_counter()
         mgr.infer(kind, reqs[0], timeout=600)
         warm_ms = (time.perf_counter() - t0) * 1e3
+
+        # golden canary in-window: record 2 goldens against the live
+        # manager (trusted by construction: same build, same params),
+        # then let the prober replay them through the REAL batcher
+        # submit path concurrently with the measured load — probes are
+        # tenant-tagged __canary__ so metering excludes them
+        from paddle_tpu.observability import canary as _canary
+        fetch = mgr.fetch_names(kind)
+        cases = []
+        for feed in reqs[:2]:
+            outs = mgr.infer(kind, feed, timeout=600,
+                             tenant=_canary.CANARY_TENANT)
+            cases.append({"feeds": dict(feed),
+                          "expect": list(zip(fetch, outs))})
+        cp = _canary.prober()
+        if cp is not None:
+            cp.goldens.models[kind] = {"rtol": None, "cases": cases}
+        _canary.register_target(
+            f"bench/{kind}", kind,
+            lambda feeds, tenant, _k=kind, _m=mgr, _f=fetch: list(zip(
+                _f, _m.infer(_k, feeds, timeout=600, tenant=tenant))))
+        _canary.maybe_start_from_flags()
+
         bat_qps, bat_p50, bat_p99, bat_err = _serving_load(
             lambda feed: mgr.submit(kind, feed),
             reqs, GEN_CLIENTS, window=WINDOW)
+        # the swap below flips to a DIFFERENT predictor version — the
+        # v1 goldens would (correctly) fail against v2, so the target
+        # retires with its window
+        _canary.unregister_target(f"bench/{kind}")
 
         res = {
             "seq_qps": seq_qps, "seq_p50_ms": seq_p50,
@@ -1260,6 +1299,14 @@ def _bench_serving_inner():
               "predicted_max_qps"):
         if out["mnist"].get(k) is not None:
             out[k] = out["mnist"][k]
+    # correctness-in-window headline: what the canary cost
+    # (informational) and whether any probe mismatched (a secondary
+    # gate — 0 on a healthy build)
+    from paddle_tpu.observability import canary as _canary
+    cp = _canary.prober(create=False)
+    out["canary_overhead_frac"] = round(_canary.overhead_frac(), 6)
+    out["canary_failures"] = (sum(
+        s["failures"] for s in cp.streaks().values()) if cp else 0)
     return out
 
 
@@ -1296,14 +1343,24 @@ def bench_decode():
     # token-level tail anatomy (TTFT/TBT histograms, goodput, phases)
     # plus capacity attribution ride the saturation window — host-side
     # stamps, no device syncs (finally-restored like bench_serving)
+    # golden canary rides the continuous window too (bench_serving
+    # precedent): a recorded greedy completion replayed through the
+    # real engine submit path, costed as canary_overhead_frac and
+    # gated as canary_failures in tools/bench_compare.py
     _flags.set_flags({"phase_attribution": True,
-                      "capacity_attribution": True})
+                      "capacity_attribution": True,
+                      "canary_probe": True,
+                      "canary_interval_s": 0.25})
     try:
         return _bench_decode_inner()
     finally:
         _flags.set_flags({"phase_attribution": False,
-                          "capacity_attribution": False})
+                          "capacity_attribution": False,
+                          "canary_probe": False,
+                          "canary_interval_s": 5.0})
+        from paddle_tpu.observability import canary as _canary
         from paddle_tpu.observability import capacity as _capacity
+        _canary.reset()
         _capacity.reset()
 
 
@@ -1387,6 +1444,33 @@ def _bench_decode_inner():
     # warm: one request per prefill bucket + the decode step
     for b in BUCKETS:
         eng.generate(np.zeros(b - 2, np.int32), max_new_tokens=2)
+
+    # golden canary in-window: record one greedy completion against the
+    # warmed engine, then let the prober replay it through the REAL
+    # submit path concurrently with the continuous window (probes are
+    # __canary__-tenant streams, excluded from user metering)
+    from paddle_tpu.observability import canary as _canary
+    g_prompt, g_new = reqs[0][0], 8
+    g_toks = eng.generate(g_prompt, max_new_tokens=g_new)["tokens"]
+    cp = _canary.prober()
+    if cp is not None:
+        cp.goldens.models["bench"] = {"rtol": None, "cases": [{
+            "feeds": {"prompt": np.asarray(g_prompt, np.int32),
+                      "max_new_tokens": np.asarray(g_new, np.int32)},
+            "expect": [("tokens", np.asarray(g_toks, np.int32))]}]}
+
+    def _canary_decode(feeds, tenant, _eng=eng):
+        h = _eng.submit(
+            np.asarray(feeds["prompt"], np.int32),
+            SamplingParams(max_new_tokens=int(
+                np.asarray(feeds["max_new_tokens"]))),
+            tenant=tenant)
+        return [("tokens",
+                 np.asarray(h.result(timeout=600)["tokens"], np.int32))]
+
+    _canary.register_target("bench/decode", "bench", _canary_decode)
+    _canary.maybe_start_from_flags()
+
     before = _exec_counters()
     t0 = time.perf_counter()
     handles = [eng.submit(p, SamplingParams(max_new_tokens=m))
@@ -1410,6 +1494,12 @@ def _bench_decode_inner():
     # greedy parity: continuous tokens == re-prefill argmax tokens
     mismatches = sum(1 for i, r in enumerate(results)
                     if r["tokens"] != base_tokens[i])
+    # retire the canary target BEFORE close (a probe against a closed
+    # engine would read as a correctness failure)
+    _canary.unregister_target("bench/decode")
+    canary_overhead = round(_canary.overhead_frac(), 6)
+    canary_failures = (sum(s["failures"] for s in cp.streaks().values())
+                       if cp else 0)
     eng.close()
 
     base_lats.sort()
@@ -1442,6 +1532,10 @@ def _bench_decode_inner():
         "headroom_frac": cap_snap.get("headroom_frac"),
         "binding_phase": cap_snap.get("binding_phase"),
         "predicted_max_qps": cap_snap.get("predicted_max_qps"),
+        # correctness-in-window: probe cost (informational) + mismatch
+        # count (secondary gate, 0 on a healthy build)
+        "canary_overhead_frac": canary_overhead,
+        "canary_failures": canary_failures,
         "speedup_vs_reprefill": round(cont_tps / max(base_tps, 1e-9), 2),
         "parity": {"greedy_mismatched_requests": mismatches,
                    "requests_compared": len(reqs)},

@@ -387,6 +387,62 @@ define_flag("slo_rules", "",
 define_flag("slo_eval_interval_s", 1.0,
             "SLO watchdog evaluation period in seconds (only read when "
             "FLAGS_slo_rules is non-empty)")
+define_flag("canary_probe", False,
+            "golden canary prober for the serving and decode planes "
+            "(observability/canary.py): a background thread "
+            "periodically replays a small golden set (recorded "
+            "input -> expected-output pairs, captured with "
+            "'tools/golden.py record' against a trusted build) through "
+            "the REAL submit path of every registered replica target, "
+            "compares replies against the goldens with per-model rtol, "
+            "and maintains per-replica pass/fail streaks (canary.* "
+            "counters, /canaryz, a 'canary' health dimension on every "
+            "registry heartbeat, and a STATS_PULL rider).  Probes are "
+            "tenant-tagged '__canary__' so per-tenant metering "
+            "(FLAGS_tenant_accounting) excludes them from user "
+            "accounting.  A canary pass is a REGRESSION check against "
+            "a recorded build, not a proof of correctness.  Off "
+            "(default): no thread, no series, heartbeats and "
+            "STATS_PULL byte-identical")
+define_flag("canary_interval_s", 5.0,
+            "golden canary probe period in seconds (only read when "
+            "FLAGS_canary_probe is on): each cycle replays the full "
+            "golden set through every registered target once")
+define_flag("canary_golden_path", "",
+            "path of the golden-set JSON consumed by the canary prober "
+            "(written by 'tools/golden.py record'); empty with "
+            "FLAGS_canary_probe on means the prober idles armed with "
+            "zero goldens (streaks stay empty) until a set is loaded")
+define_flag("canary_rtol", 1e-5,
+            "default relative tolerance for golden-vs-reply numeric "
+            "comparison in the canary prober; a golden set may carry a "
+            "tighter/looser per-model rtol which wins over this flag")
+define_flag("canary_fail_streak", 3,
+            "consecutive canary-probe failures on one replica target "
+            "before its heartbeat 'canary' health dimension flips to "
+            "'fail' (the supervisor additionally applies its own "
+            "hysteresis before quarantining, so a single flake can "
+            "never drain a replica)")
+define_flag("divergence_check", False,
+            "cross-replica divergence sentinel "
+            "(observability/audit.py): serving replicas fold a content "
+            "digest of each reply batch (decode servers a per-stream "
+            "token-id rolling hash) into a bounded audit ring that "
+            "rides their registry lease data; the supervisor groups "
+            "digests by (model, version, request-hash) across replicas "
+            "and NAMES a minority replica whose digest disagrees with "
+            "the majority (divergence.* counters, flight-recorder "
+            "note, /canaryz audit section).  Training: "
+            "ParallelExecutor folds a periodic u64 parameter checksum "
+            "per DP replica (every FLAGS_divergence_param_steps steps) "
+            "so state divergence is caught within K steps.  Off "
+            "(default): no digests, no series, lease payloads "
+            "byte-identical")
+define_flag("divergence_param_steps", 50,
+            "period in optimizer steps of the cross-DP-replica "
+            "parameter checksum (only read when FLAGS_divergence_check "
+            "is on): every K-th step each replica folds a u64 checksum "
+            "of its persistable parameters into the audit plane")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

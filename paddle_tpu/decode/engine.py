@@ -49,6 +49,7 @@ from .model import TransformerLM
 from ..core import flags as _flags
 from ..core.executor import Executor
 from ..distributed import faults as _faults
+from ..observability import audit as _audit
 from ..observability import capacity as _capacity
 from ..observability import debug_server as _debug_server
 from ..observability import phase as _phase
@@ -697,6 +698,17 @@ class DecodeEngine:
             req.tl.stamp("decode")
             lat.phases.observe(req.tl, rid=req.rid, finish=reason,
                                tokens=slot.n_generated)
+        if _audit.enabled() and reason != "cancelled":
+            # per-stream token-id rolling hash into the audit ring,
+            # keyed by the prompt's content hash so replicas that
+            # decoded the SAME prompt are comparable fleet-wide.
+            # Cancelled streams truncate at client timing, never at
+            # model output — they are not comparable and stay out
+            h = _audit.fnv1a64(b"")
+            for t in req.handle._tokens:
+                h = _audit.fold_token(h, t)
+            _audit.note_stream(self.name, "",
+                               _audit.request_hash(req.prompt), h)
         req.handle._finish(reason)
 
     def _release(self, req: DecodeRequest, slot_idx, error) -> None:

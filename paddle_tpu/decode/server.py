@@ -41,6 +41,8 @@ import numpy as np
 from .engine import DecodeEngine, SamplingParams
 from ..distributed import registry as _registry
 from ..distributed import serde, transport
+from ..observability import audit as _audit
+from ..observability import canary as _canary
 from ..observability import flight as _flight
 from ..serving.batcher import Draining, Overloaded, RequestTooLong
 
@@ -196,13 +198,18 @@ class DecodeServer:
         self.engines[name] = engine
         self.service.engines[name] = engine
         self._sync_announcements()
+        self._sync_canary_targets()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self._server.start()
         self._started = True
         self.service.endpoint = self.endpoint
+        # correctness plane: the golden prober self-arms in any decode
+        # process (no-op with FLAGS_canary_probe off)
+        _canary.maybe_start_from_flags()
         self._sync_announcements()
+        self._sync_canary_targets()
 
     def stop(self, drain: bool = False, drain_timeout: float = 60.0
              ) -> None:
@@ -225,6 +232,8 @@ class DecodeServer:
                 if not eng.drain(timeout=left):
                     _flight.note("decode_drain_timeout", model=name,
                                  endpoint=self.endpoint)
+        for model in self.engines:
+            _canary.unregister_target(replica_key(model, self.replica_id))
         # drain: mid-reply connections (a stream's trailing FIN frame)
         # get a bounded grace before the transport severs them
         self._server.stop(graceful_s=2.0 if drain else 0.0)
@@ -300,8 +309,55 @@ class DecodeServer:
                     hr = cap.headroom()
                     if hr is not None:
                         out.update(hr)
+            # correctness plane rides the same lease (canary streaks
+            # present iff FLAGS_canary_probe; per-stream token-hash
+            # digests present iff FLAGS_divergence_check) — the
+            # supervisor's sentinel groups them across replicas
+            can = _canary.lease_rider(replica_key(model, self.replica_id))
+            if can is not None:
+                out["canary"] = can
+            dig = _audit.recent_digests()
+            if dig is not None and model in dig:
+                out["digests"] = {model: dig[model]}
             return out
         return data
+
+    # -- golden canary targets ---------------------------------------------
+    def _canary_submit(self, model: str):
+        """A probe submit fn through the real decode submit path
+        (engine admission -> prefill -> continuous-batch steps).
+        Golden feeds: ``prompt`` (int ids) plus an optional
+        ``max_new_tokens`` scalar; the reply is the greedy token
+        stream as ``[("tokens", int32[n])]`` so the prober's generic
+        pair comparison applies (exact match — token ids carry no
+        rtol)."""
+        def submit(feeds: dict, tenant: Optional[str]):
+            eng = self.engines.get(model)
+            if eng is None:
+                raise RuntimeError(f"canary probe: no engine {model!r}")
+            prompt = np.asarray(feeds["prompt"], np.int32).reshape(-1)
+            mnt = 8
+            if "max_new_tokens" in feeds:
+                mnt = int(np.asarray(
+                    feeds["max_new_tokens"]).reshape(-1)[0])
+            handle = eng.submit(prompt,
+                                SamplingParams(max_new_tokens=mnt),
+                                tenant=tenant)
+            from ..core import flags as _flags
+            final = handle.result(
+                timeout=float(_flags.get_flags("rpc_deadline")))
+            return [("tokens", np.asarray(final["tokens"], np.int32))]
+        return submit
+
+    def _sync_canary_targets(self) -> None:
+        """Mirror :meth:`_sync_announcements` for the prober's target
+        registry (works registry-less too) — a no-op unless armed."""
+        if not _canary.enabled() or not self._started:
+            return
+        for model in self.engines:
+            _canary.register_target(
+                replica_key(model, self.replica_id), model,
+                self._canary_submit(model))
 
     def _sync_announcements(self) -> None:
         """One registry heartbeat per served model (the serving plane's

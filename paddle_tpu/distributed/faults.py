@@ -29,18 +29,29 @@ kinds
                       COMPLETE step authoritative).
     ``io_err``        file-write hook: raise ``OSError(EIO)`` — a dying
                       disk / dead mount at a matching write.
+    ``corrupt``       data-corruption hook (``corrupt_fault``): flip
+                      ``bits`` bits of one element of a reply buffer or
+                      parameter shard at a matching site — silent data
+                      corruption, injectable like every other fault
+                      class (the correctness plane's chaos hook:
+                      ``corrupt:serving_reply:n=1`` corrupts the first
+                      reply; the divergence sentinel / canary prober
+                      must then detect AND name the replica).
 
 target
     an RPC message name (``send_vars``, ``batch_barrier``, ``get_task``,
     ...), a loop event (``apply_round``, ``apply_async``,
     ``lease_grant``), a file-write site (``ckpt_write`` — every
-    checkpoint-store / io.py atomic write), or ``*`` / empty for any.
+    checkpoint-store / io.py atomic write), a corruption site
+    (``serving_reply``, ``param_shard`` — optionally replica-qualified
+    as ``serving_reply@r1``), or ``*`` / empty for any.
 
 params
     ``n=N``      trigger from the Nth matching hit (default 1)
     ``p=0.x``    per-hit probability once armed (default 1.0)
     ``times=K``  stop after K firings (default unlimited; kill fires once)
     ``ms=X``     delay milliseconds (``delay`` kind; default 100)
+    ``bits=B``   bits to flip per firing (``corrupt`` kind; default 1)
     ``for_s=X``  rule disarms X seconds after installation
     ``side=client|server|any``  which hook honors it (default any)
 
@@ -74,10 +85,15 @@ KILL_AFTER = "kill_after"
 REFUSE_ACCEPT = "refuse_accept"
 DISKFULL = "diskfull"
 IO_ERR = "io_err"
-_KINDS = (DROP_CONN, DELAY, KILL_AFTER, REFUSE_ACCEPT, DISKFULL, IO_ERR)
+CORRUPT = "corrupt"
+_KINDS = (DROP_CONN, DELAY, KILL_AFTER, REFUSE_ACCEPT, DISKFULL, IO_ERR,
+          CORRUPT)
 # kinds the file-write hook honors (a wildcard drop_conn rule must not
 # be consumed — or fired — by a write site it can't apply to)
 _IO_KINDS = (DISKFULL, IO_ERR, DELAY, KILL_AFTER)
+# kinds only a dedicated dispatcher may consume — a wire/event hook
+# must neither fire them nor burn their budget
+_SITE_KINDS = (DISKFULL, IO_ERR, CORRUPT)
 
 _lock = threading.Lock()
 _runtime_rules: List["Rule"] = []
@@ -85,12 +101,13 @@ _flag_cache: Dict[str, List["Rule"]] = {}
 
 
 class Rule:
-    __slots__ = ("kind", "target", "n", "p", "times", "ms", "for_s",
-                 "side", "source", "armed_at", "hits", "fires")
+    __slots__ = ("kind", "target", "n", "p", "times", "ms", "bits",
+                 "for_s", "side", "source", "armed_at", "hits", "fires")
 
     def __init__(self, kind: str, target: str = "", n: int = 1,
                  p: float = 1.0, times: Optional[int] = None,
-                 ms: float = 100.0, for_s: Optional[float] = None,
+                 ms: float = 100.0, bits: int = 1,
+                 for_s: Optional[float] = None,
                  side: str = "any", source: str = "runtime"):
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r} "
@@ -101,6 +118,7 @@ class Rule:
         self.p = float(p)
         self.times = None if times is None else int(times)
         self.ms = float(ms)
+        self.bits = max(1, int(bits))
         self.for_s = None if for_s is None else float(for_s)
         self.side = side
         self.source = source
@@ -132,9 +150,9 @@ class Rule:
     def to_dict(self) -> dict:
         return {"kind": self.kind, "target": self.target or "*",
                 "n": self.n, "p": self.p, "times": self.times,
-                "ms": self.ms, "for_s": self.for_s, "side": self.side,
-                "source": self.source, "hits": self.hits,
-                "fires": self.fires}
+                "ms": self.ms, "bits": self.bits, "for_s": self.for_s,
+                "side": self.side, "source": self.source,
+                "hits": self.hits, "fires": self.fires}
 
 
 def parse(spec: str, source: str = "runtime") -> List[Rule]:
@@ -151,10 +169,11 @@ def parse(spec: str, source: str = "runtime") -> List[Rule]:
             for kv in fields[2].split(","):
                 k, _, v = kv.partition("=")
                 k = k.strip()
-                if k not in ("n", "p", "times", "ms", "for_s", "side"):
+                if k not in ("n", "p", "times", "ms", "bits", "for_s",
+                             "side"):
                     raise ValueError(f"unknown fault param {k!r} in {part!r}")
                 kwargs[k] = v.strip() if k == "side" else float(v)
-        for k in ("n", "times"):
+        for k in ("n", "times", "bits"):
             if k in kwargs:
                 kwargs[k] = int(kwargs[k])
         rules.append(Rule(kind, target, source=source, **kwargs))
@@ -221,9 +240,9 @@ def _match(target: str, side: str) -> Optional[Rule]:
     with _lock:
         rules = list(_runtime_rules)
     for r in rules + _flag_rules():
-        # write-site-only kinds never fire (or burn their budget) on
-        # wire/event hooks — io_fault is their only dispatcher
-        if r.kind in (DISKFULL, IO_ERR):
+        # site-only kinds never fire (or burn their budget) on
+        # wire/event hooks — io_fault / corrupt_fault dispatch them
+        if r.kind in _SITE_KINDS:
             continue
         if r.matches(target, side, now) and r.fire():
             return r
@@ -263,6 +282,8 @@ def client_fault(target: str) -> Optional[str]:
     with _lock:
         rules = list(_runtime_rules)
     for r in rules + _flag_rules():
+        if r.kind in _SITE_KINDS:
+            continue
         if r.side == "client" and r.matches(target, "client", now) \
                 and r.fire():
             return _apply(r, target)
@@ -327,6 +348,57 @@ def io_fault(target: str) -> None:
                               target)
             _apply(r, target)   # delay sleeps in place; kill never returns
             return
+
+
+def corrupt_fault(*targets: str) -> Optional[int]:
+    """Hook at a data-corruption site (serving reply assembly, the
+    parameter-checksum walk).  Callers pass their site name plus any
+    replica-qualified aliases (``"serving_reply@r1"``,
+    ``"serving_reply"``) so one rule can hit exactly one replica OR the
+    whole site class.  A matching ``corrupt`` rule fires and returns
+    the number of bits to flip (``bits`` param); ``None`` = clean.
+    Like ``io_fault``, this is the ONLY dispatcher for the kind."""
+    if not active():
+        return None
+    now = time.monotonic()
+    with _lock:
+        rules = list(_runtime_rules)
+    for r in rules + _flag_rules():
+        if r.kind != CORRUPT:
+            continue
+        for t in targets:
+            if r.matches(t, "server", now) and r.fire():
+                _fired(r, t)
+                return r.bits
+    return None
+
+
+def corrupt_array(arr, bits: int = 1):
+    """Flip ``bits`` bits of ONE element of ``arr`` (a fresh copy) —
+    the silent-data-corruption model: a plausible value, not garbage.
+    The largest-magnitude element is hit (a zero bit-flips into a
+    denormal no tolerance check could see), and bits flip from the top
+    of the element's middle byte — a float's low exponent / high
+    mantissa, so the value moves by a factor ~2: far outside any sane
+    canary rtol, yet still a finite number the NaN/Inf sentinel cannot
+    see."""
+    import numpy as np
+    a = np.array(arr, copy=True)
+    if a.size == 0 or a.dtype.itemsize == 0:
+        return a
+    flat = a.reshape(-1)
+    try:
+        elem = int(np.argmax(np.abs(flat).astype(np.float64)))
+    except (TypeError, ValueError):
+        elem = 0
+    view = flat.view(np.uint8)
+    itemsize = a.dtype.itemsize
+    for b in range(int(bits)):
+        # walk down from the top bit of the middle byte, wrapping into
+        # neighboring bytes of the same element when bits > 8
+        idx = elem * itemsize + (itemsize // 2 + b // 8) % itemsize
+        view[idx] ^= np.uint8(1 << (7 - (b % 8)))
+    return a
 
 
 def accept_fault() -> bool:

@@ -53,6 +53,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import transport
+from ..observability import canary as _canary
 from ..observability import flight as _flight
 from ..observability import slo as _slo
 from ..observability import stats as _obs_stats
@@ -237,7 +238,9 @@ class RegistryService:
                         last_error=hb.get("last_error"),
                         trainer_id=hb.get("trainer_id"),
                         standby=hb.get("standby"), slo=hb.get("slo"),
-                        slo_rules=hb.get("slo_rules"))
+                        slo_rules=hb.get("slo_rules"),
+                        canary=hb.get("canary"),
+                        canary_targets=hb.get("canary_targets"))
                 return transport.OK, b"{}"
             ttl = float(body["ttl"])
             now = time.monotonic()
@@ -311,7 +314,9 @@ class RegistryService:
                     step=hb.get("step"), last_error=hb.get("last_error"),
                     trainer_id=hb.get("trainer_id"),
                     standby=hb.get("standby"), slo=hb.get("slo"),
-                    slo_rules=hb.get("slo_rules"))
+                    slo_rules=hb.get("slo_rules"),
+                    canary=hb.get("canary"),
+                    canary_targets=hb.get("canary_targets"))
             # plain primary registrations keep the PR-5 empty response
             # byte-identical; only HA registrations carry an answer
             return (transport.OK,
@@ -531,6 +536,13 @@ class Heartbeat:
         slo_dim = _slo.health_dimension()
         if slo_dim:
             hb.update(slo_dim)
+        # correctness dimension (observability/canary.py): same
+        # discipline — a process running an armed prober stamps its
+        # golden-canary verdict on every heartbeat; flag off adds
+        # nothing (payload byte-identical)
+        canary_dim = _canary.health_dimension()
+        if canary_dim:
+            hb.update(canary_dim)
         if self.health_fn is not None:
             try:
                 hb.update(self.health_fn() or {})

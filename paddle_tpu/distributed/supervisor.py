@@ -63,6 +63,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from ..observability import audit as _audit
 from ..observability import debug_server as _debug_server
 from ..observability import flight as _flight
 from ..observability import stats as _obs_stats
@@ -218,6 +219,7 @@ class FleetSpec:
                  cut_role: Optional[str] = None,
                  checkpoint_every_s: float = 0.0,
                  hysteresis: int = 2,
+                 quarantine_on_canary_fail: bool = False,
                  name: str = "fleet"):
         self.roles = {r: (s if isinstance(s, RoleSpec)
                           else RoleSpec.from_dict(s))
@@ -227,6 +229,11 @@ class FleetSpec:
         self.rollback_roles = list(rollback_roles)
         self.checkpoint_every_s = float(checkpoint_every_s)
         self.hysteresis = max(1, int(hysteresis))
+        # correctness quarantine (observability/canary.py): when True, a
+        # worker whose heartbeat reports a confirmed canary-fail streak
+        # is DRAINED — never killed — so in-flight requests finish while
+        # the lying replica leaves the serving set
+        self.quarantine_on_canary_fail = bool(quarantine_on_canary_fail)
         self.name = name
         for r in self.rollback_roles:
             if r not in self.roles:
@@ -264,6 +271,7 @@ class FleetSpec:
                 "cut_role": self.cut_role,
                 "checkpoint_every_s": self.checkpoint_every_s,
                 "hysteresis": self.hysteresis,
+                "quarantine_on_canary_fail": self.quarantine_on_canary_fail,
                 "roles": {r: s.to_dict() for r, s in self.roles.items()}}
 
 
@@ -346,6 +354,21 @@ class _SupMetrics:
         self.slo_breach_workers = sc.gauge(
             "slo_breach_workers", "workers currently in confirmed "
             "(hysteresis-damped) SLO breach")
+        self.canary_fails = sc.counter(
+            "canary_fails", "sustained canary-fail transitions observed "
+            "via the heartbeat canary dimension (observability/"
+            "canary.py) after hysteresis damping")
+        self.canary_quarantines = sc.counter(
+            "canary_quarantines", "workers DRAINED (never killed) under "
+            "spec.quarantine_on_canary_fail after a confirmed "
+            "canary-fail streak")
+        self.canary_fail_workers = sc.gauge(
+            "canary_fail_workers", "workers currently in confirmed "
+            "(hysteresis-damped) canary fail")
+        self.divergence_named = sc.counter(
+            "divergence_named", "divergent replicas the cross-replica "
+            "sentinel named from lease-data digests "
+            "(observability/audit.py)")
 
 
 class Supervisor:
@@ -388,6 +411,16 @@ class Supervisor:
         # spec.hysteresis agreeing observations
         self._slo_streak: Dict[str, int] = {}
         self._slo_confirmed: Dict[str, list] = {}
+        # canary-fail observation (heartbeat canary dimension), same
+        # damping discipline; confirmed entries drive the optional
+        # quarantine_on_canary_fail DRAIN policy
+        self._canary_streak: Dict[str, int] = {}
+        self._canary_confirmed: Dict[str, list] = {}
+        # the cross-replica divergence verdict over lease-data digest
+        # riders (FLAGS_divergence_check at the replicas); {} when no
+        # replica publishes digests, so flags-off /fleetz is unchanged
+        self._divergence: dict = {}
+        self._divergence_seen: set = set()
         self._started = False
         self._client = None
 
@@ -585,6 +618,7 @@ class Supervisor:
             roles = {}
             now = time.monotonic()
             headroom = dict(self._headroom)
+            canary_streaks = dict(self._canary_streak)
             for r, rs in self.spec.roles.items():
                 window = [t for t in self._deaths.get(r, ())
                           if now - t <= rs.restart_window_s]
@@ -604,14 +638,26 @@ class Supervisor:
                              if k.startswith(prefix)]
                     if fracs:
                         roles[r]["headroom_frac"] = min(fracs)
+                    # the worst live canary-fail streak among this
+                    # role's announce keys (absent when all pass, so
+                    # flags-off status is unchanged)
+                    streaks = [s for k, s in canary_streaks.items()
+                               if k.startswith(prefix)]
+                    if streaks:
+                        roles[r]["canary_fail_streak"] = max(streaks)
         with self.lock:
             slo = {w: list(r) for w, r in self._slo_confirmed.items()}
+            canary = {w: list(t)
+                      for w, t in self._canary_confirmed.items()}
+            divergence = dict(self._divergence)
         out = {"fleet": self.spec.name,
                "state": "HOLD" if holds else "RUNNING",
                "registry": self.registry_ep,
                "rollback_roles": list(self.spec.rollback_roles),
                "roles": roles, "workers": workers,
-               "slo_breaches": slo}
+               "slo_breaches": slo, "canary_fails": canary}
+        if divergence.get("divergent") or divergence.get("suspect"):
+            out["divergence"] = divergence
         if headroom:
             out["headroom"] = headroom
         root = self.spec.checkpoint_root
@@ -757,16 +803,30 @@ class Supervisor:
         leases = {k: v["endpoint"]
                   for k, v in (snap.get("leases") or {}).items()}
         headroom = {}
+        digests = {}
         for key, data in (snap.get("data") or {}).items():
-            if isinstance(data, dict) and "headroom_frac" in data:
+            if not isinstance(data, dict):
+                continue
+            if "headroom_frac" in data:
                 headroom[key] = {k: data[k] for k in
                                  ("headroom_frac", "binding_phase",
                                   "predicted_max_qps") if k in data}
+            if isinstance(data.get("digests"), dict):
+                digests[key] = data["digests"]
+        # the sentinel proper: group digest riders ACROSS replicas and
+        # name a divergent minority (pure function, outside the lock)
+        verdict = _audit.name_divergent(digests) if digests else {}
         with self.lock:
             self._leases = leases
             self._headroom = headroom
             self._health = health
             self._observe_slo_locked(health)
+            # detect (canary streak) is noted before name (divergence
+            # verdict) within a poll; the confirm+quarantine fires a
+            # hysteresis-damped poll later — so the flight record reads
+            # detect → name → drain in order
+            self._observe_canary_locked(health)
+            self._observe_divergence_locked(verdict)
             for w in self.workers.values():
                 if w.logical and w.logical in leases:
                     w.physical = leases[w.logical]
@@ -804,6 +864,92 @@ class Supervisor:
                 self._slo_confirmed.pop(worker)
                 self._slo_streak.pop(worker, None)
         self.metrics.slo_breach_workers.set(len(self._slo_confirmed))
+
+    def _observe_canary_locked(self, health: Dict[str, dict]) -> None:
+        """Fold one FRESH health view's canary dimensions into the
+        damped observation (call with the lock held).  Same discipline
+        as :meth:`_observe_slo_locked` — ``spec.hysteresis`` agreeing
+        polls confirm — but with one extra tooth: under
+        ``spec.quarantine_on_canary_fail`` a confirmed replica is
+        DRAINED (the PR-13 typed drain: SIGTERM → deregister → finish
+        in-flight → reap), never killed.  A canary fail means the
+        replica answers WRONG, so leaving it in the serving set is
+        worse than losing its capacity; draining quarantines it with
+        zero dropped requests.
+
+        Attribution: the canary dimension is process-global, so a
+        process serving several announce keys stamps ``fail`` on every
+        one of its heartbeats.  When the failing target's OWN announce
+        key is present in this same health view, blame lands there and
+        its innocent siblings are treated as passing — only a target
+        name that maps to no visible key falls back to blaming the
+        reporting key."""
+        need = self.spec.hysteresis
+        for worker, info in health.items():
+            failing = info.get("canary") == "fail"
+            targets = list(info.get("canary_targets") or [])
+            if failing and targets and worker not in targets \
+                    and any(t in health for t in targets):
+                failing = False
+            if failing:
+                streak = self._canary_streak.get(worker, 0) + 1
+                self._canary_streak[worker] = streak
+                if streak == 1:
+                    _flight.note("supervisor_canary_detect",
+                                 worker=worker, targets=targets)
+                if streak >= need and worker not in self._canary_confirmed:
+                    self._canary_confirmed[worker] = targets
+                    self.metrics.canary_fails.inc()
+                    _flight.note("supervisor_canary_fail", worker=worker,
+                                 targets=targets, streak=streak)
+                    if self.spec.quarantine_on_canary_fail:
+                        self._quarantine_locked(worker)
+            else:
+                self._canary_streak.pop(worker, None)
+                if worker in self._canary_confirmed:
+                    self._canary_confirmed.pop(worker)
+                    _flight.note("supervisor_canary_clear", worker=worker)
+        for worker in list(self._canary_confirmed):
+            if worker not in health:
+                self._canary_confirmed.pop(worker)
+                self._canary_streak.pop(worker, None)
+        self.metrics.canary_fail_workers.set(len(self._canary_confirmed))
+
+    def _quarantine_locked(self, key: str) -> None:
+        """Map a confirmed-failing heartbeat key to its supervised
+        worker and drain it.  Serving/decode replicas heartbeat under
+        announce keys (``serving/<model>/<replica>``) whose lease
+        endpoint matches the worker's announced physical endpoint;
+        plain workers heartbeat under their logical id directly.  An
+        unmapped key (an unsupervised replica sharing the registry) is
+        flight-noted, never guessed at."""
+        ep = self._leases.get(key)
+        for w in self.workers.values():
+            if w.state not in (LIVE, STARTING):
+                continue
+            if w.logical == key or (ep is not None
+                                    and ep in (w.physical, w.logical)):
+                self.metrics.canary_quarantines.inc()
+                _flight.note("supervisor_canary_quarantine",
+                             worker=w.name, key=key)
+                self._drain_locked(w)
+                return
+        _flight.note("supervisor_canary_quarantine_unmapped", key=key)
+
+    def _observe_divergence_locked(self, verdict: dict) -> None:
+        """Record the newest sentinel verdict; count + flight-note each
+        NEWLY named (replica, group, digest) finding exactly once (the
+        same divergence re-observed every poll is one event, not a
+        counter storm)."""
+        self._divergence = verdict
+        for f in verdict.get("divergent") or ():
+            fp = (f.get("replica"), f.get("model"), f.get("version"),
+                  f.get("request_hash"), f.get("digest"))
+            if fp in self._divergence_seen:
+                continue
+            self._divergence_seen.add(fp)
+            self.metrics.divergence_named.inc()
+            _flight.note("supervisor_divergence_named", **f)
 
     def _winding_down(self) -> bool:
         """True when every done_ok worker has finished (state COMPLETED

@@ -63,6 +63,20 @@ The latency-anatomy / SLO plane (all strictly flag-gated):
   evaluated in-process; breaches count, leave flight notes, render on
   ``/sloz`` and ride the registry heartbeat as an ``slo`` health
   dimension the ElasticController/supervisor consume.
+- :mod:`canary` — the golden canary prober (``FLAGS_canary_probe``):
+  a background thread replays recorded input→expected-output goldens
+  (``tools/golden.py record``) through every registered replica's real
+  submit path, compares with per-model rtol, keeps per-replica
+  pass/fail streaks; served on ``/canaryz``, fleet-merged, riding the
+  heartbeat as a ``canary`` health dimension the supervisor's
+  ``quarantine_on_canary_fail`` policy consumes (DRAIN, never kill).
+- :mod:`audit` — the cross-replica divergence sentinel
+  (``FLAGS_divergence_check``): reply-batch content digests / decode
+  token rolling hashes / periodic DP parameter checksums folded into a
+  bounded ring riding the lease data; digests grouped by (model,
+  version, request-hash) across replicas NAME a divergent minority
+  replica — silent data corruption surfaces without trusting any
+  single machine.
 
 The export/aggregation half (this package's fleet plane):
 
@@ -82,6 +96,8 @@ from __future__ import annotations
 
 from . import (  # noqa: F401
     aggregate,
+    audit,
+    canary,
     capacity,
     debug_server,
     flight,

@@ -26,6 +26,8 @@ from __future__ import annotations
 import json
 from typing import Dict, Mapping, Optional
 
+from . import audit as _audit
+from . import canary as _canary
 from . import capacity as _capacity
 from . import history as _history
 from . import stats as _stats
@@ -58,6 +60,14 @@ def local_snapshot_payload() -> bytes:
     ten = _tenant.export_state()
     if ten is not None:
         state["tenants"] = ten
+    # correctness-anatomy riders (FLAGS_canary_probe /
+    # FLAGS_divergence_check): same discipline again
+    can = _canary.export_state()
+    if can is not None:
+        state["canary"] = can
+    aud = _audit.export_state()
+    if aud is not None:
+        state["audit"] = aud
     return json.dumps(state).encode("utf-8")
 
 
@@ -105,6 +115,10 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
     # fleet-wide heavy-hitter table
     capacity_pw: Dict[str, dict] = {}
     tenants_pw: Dict[str, dict] = {}
+    # correctness plane: canary streaks union fleet-wide, audit rings
+    # feed the cross-worker divergence sentinel
+    canary_pw: Dict[str, dict] = {}
+    audit_pw: Dict[str, dict] = {}
     for worker in sorted(per_worker):
         state = per_worker[worker]
         if isinstance(state.get("history"), dict):
@@ -113,6 +127,10 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
             capacity_pw[worker] = state["capacity"]
         if isinstance(state.get("tenants"), dict):
             tenants_pw[worker] = state["tenants"]
+        if isinstance(state.get("canary"), dict):
+            canary_pw[worker] = state["canary"]
+        if isinstance(state.get("audit"), dict):
+            audit_pw[worker] = state["audit"]
         for name, m in state.get("metrics", {}).items():
             kind = m.get("kind")
             if kind == "counter":
@@ -141,6 +159,11 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
                            "fleet": _capacity.merge_states(capacity_pw)}
     if tenants_pw:
         out["tenants"] = _tenant.merge_states(tenants_pw)
+    if canary_pw:
+        out["canary"] = {"per_worker": canary_pw,
+                         "fleet": _canary.merge_states(canary_pw)}
+    if audit_pw:
+        out["audit"] = _audit.merge_states(audit_pw)
     return out
 
 

@@ -434,6 +434,25 @@ class _Handler(BaseHTTPRequestHandler):
                             else json.dumps(_tenant.tenantz(), indent=2))
                 self._reply(200, body,
                             "text/plain" if text else "application/json")
+            elif path == "/canaryz":
+                # the correctness-anatomy plane (observability/
+                # canary.py + audit.py): golden-probe streak table plus
+                # the divergence audit ring.  JSON by default, ?text=1
+                # for the human rendering (tools/dump_metrics.py
+                # --canaryz is the operator CLI)
+                from urllib.parse import parse_qs
+                from . import audit as _audit
+                from . import canary as _canary
+                q = parse_qs(query)
+                text = q.get("text", ["0"])[0] not in ("0", "", "false")
+                if text:
+                    body = _canary.canaryz_text()
+                else:
+                    payload = _canary.canaryz()
+                    payload.update(_audit.auditz())
+                    body = json.dumps(payload, indent=2, default=repr)
+                self._reply(200, body,
+                            "text/plain" if text else "application/json")
             elif path == "/chaosz":
                 # fault-injection control plane (distributed/faults.py):
                 # ?inject=<spec> arms rules, ?clear=1 removes runtime
@@ -476,6 +495,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "/capacityz  (phase utilization + headroom; "
                      "?text=1)",
                      "/tenantz  (per-tenant usage metering; ?text=1)",
+                     "/canaryz  (golden canary streaks + divergence "
+                     "audit; ?text=1)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),
                     "text/plain")
             else:
@@ -557,8 +578,10 @@ def maybe_start_from_flags() -> Optional[DebugServer]:
     behind its OWN flag — they work without the HTTP server; flags at
     defaults, each check is one dict lookup)."""
     from ..core import flags as _flags
+    from . import canary as _canary
     _history.maybe_start_from_flags()
     _slo.maybe_start_from_flags()
+    _canary.maybe_start_from_flags()
     try:
         port = int(_flags.get_flags("debug_server_port"))
     except KeyError:  # pragma: no cover

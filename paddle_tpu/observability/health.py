@@ -45,7 +45,7 @@ def _flag(name: str, default: float) -> float:
 class _WorkerEntry:
     __slots__ = ("name", "role", "step", "last_error", "trainer_id",
                  "ttl", "last_seen", "heartbeats", "standby", "slo",
-                 "slo_rules")
+                 "slo_rules", "canary", "canary_targets")
 
     def __init__(self, name: str):
         self.name = name
@@ -67,6 +67,12 @@ class _WorkerEntry:
         # watchdog (the pre-slo wire)
         self.slo = None
         self.slo_rules = None
+        # correctness dimension (observability/canary.py): "ok"/"fail"
+        # as reported by the worker's own golden-canary prober, plus
+        # the replica-qualified targets at/over the fail-streak
+        # threshold.  None = worker runs no prober (the pre-canary wire)
+        self.canary = None
+        self.canary_targets = None
 
 
 class HealthTable:
@@ -113,7 +119,8 @@ class HealthTable:
                 step: Optional[int] = None,
                 last_error: Optional[str] = None,
                 trainer_id: Optional[int] = None,
-                standby=None, slo=None, slo_rules=None) -> None:
+                standby=None, slo=None, slo_rules=None,
+                canary=None, canary_targets=None) -> None:
         """File one heartbeat (idempotent re-registration included)."""
         with self._lock:
             e = self._workers.get(name)
@@ -133,6 +140,8 @@ class HealthTable:
             e.standby = standby
             e.slo = slo
             e.slo_rules = slo_rules
+            e.canary = canary
+            e.canary_targets = canary_targets
             e.last_seen = time.monotonic()
             e.heartbeats += 1
 
@@ -191,6 +200,10 @@ class HealthTable:
                 ent["slo"] = e.slo
                 if e.slo_rules:
                     ent["slo_rules"] = e.slo_rules
+            if e.canary is not None:
+                ent["canary"] = e.canary
+                if e.canary_targets:
+                    ent["canary_targets"] = list(e.canary_targets)
             out[e.name] = ent
         sc = _stats.scope("health")
         sc.gauge("workers_healthy").set(tallies[HEALTHY])

@@ -58,6 +58,8 @@ __all__ = [
 
 UNTENANTED = "-"        # reserved id for requests without a tenant
 OTHER = "other"         # the eviction rollup bucket
+CANARY = "__canary__"   # reserved id for golden canary probes (canary.py)
+                        # — synthetic traffic, excluded from metering
 _MAX_ID_LEN = 64        # clip abusive ids (attribution, not storage)
 _LAT_RING = 128         # per-tenant recent-latency samples for p99
 
@@ -183,8 +185,10 @@ def meter(create: bool = True) -> Optional[TenantMeter]:
 
 
 def account(tenant: Optional[str], **kw) -> None:
-    """Module-level fold — a no-op unless the flag is armed."""
-    if not enabled():
+    """Module-level fold — a no-op unless the flag is armed.  Canary
+    probes (the reserved ``__canary__`` id) are synthetic traffic and
+    never enter user accounting."""
+    if not enabled() or tenant == CANARY:
         return
     m = meter()
     if m is not None:

@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.executor import Executor, Scope, global_scope
 from ..core.program import OP_ROLE_ATTR, OpRole, Program, default_main_program
 from ..core.backward import grad_var_name
+from ..observability import audit as _audit
 from ..observability import stats as _obs_stats
 from ..observability.step_stats import approx_nbytes as _approx_nbytes
 from .strategy import (
@@ -107,6 +108,9 @@ class ParallelExecutor(Executor):
         # flat world, nccl_helper.h:105-120); each process contributes its
         # local slice of feeds/state via make_array_from_* below
         self._multiproc = jax.process_count() > 1
+        # divergence sentinel (FLAGS_divergence_check): training steps
+        # since the last parameter checksum
+        self._div_step = 0
 
     # -- public API (reference parallel_executor.py:169 signature) ---------
     def run(self, fetch_list=None, feed=None, feed_dict=None,
@@ -146,7 +150,43 @@ class ParallelExecutor(Executor):
                     and (i >= len(names) or _batch_aligned(names[i]))
                     else o
                     for i, o in enumerate(outs)]
+        if program is None and _audit.enabled() and self._program_trains():
+            self._maybe_param_checksum()
         return outs
+
+    def _maybe_param_checksum(self) -> None:
+        """Every ``FLAGS_divergence_param_steps`` training steps, fold
+        one u64 checksum of the persistable parameters into the audit
+        plane under the reserved ``__params__`` model, keyed by the
+        step index — the STATS_PULL merge (or the supervisor's lease
+        sweep) groups the checksums ACROSS DP replicas, so a replica
+        whose state silently diverged (bad optimizer apply, SDC in a
+        parameter shard) is NAMED within K steps.  Identical-state
+        replicas checksum identically by construction: the walk is
+        name-sorted over the same program on every host."""
+        self._div_step += 1
+        if self._div_step % _audit.param_steps():
+            return
+        import zlib
+        h = 0
+        scope = self._scope
+        from ..distributed import faults as _faults
+        for name in sorted(self._persist_names(self._program, scope)):
+            val = scope.find_var(name)
+            if val is None:
+                continue
+            arr = np.ascontiguousarray(self._fetch_to_numpy(val))
+            # chaos site: perturb one element of the checksummed view
+            # (device state untouched) so only THIS replica's checksum
+            # moves — the injected-SDC drill for the training sentinel
+            if _faults.active():
+                nbits = _faults.corrupt_fault(f"param_shard@{name}",
+                                              "param_shard")
+                if nbits:
+                    arr = _faults.corrupt_array(arr, nbits)
+            h = zlib.crc32(name.encode(), h)
+            h = zlib.crc32(arr.tobytes(), h)
+        _audit.note_param_checksum(self._div_step, h)
 
     def _maybe_pad_partial_batch(self, feed):
         """Pad a last partial batch up to the dp multiple so the feeds

@@ -41,8 +41,11 @@ from ..observability import flight as _flight
 
 from .batcher import Draining, Overloaded, RequestTooLong
 from .model_registry import ModelManager
+from ..distributed import faults as _faults
 from ..distributed import registry as _registry
 from ..distributed import serde, transport
+from ..observability import audit as _audit
+from ..observability import canary as _canary
 from ..observability import debug_server as _debug_server
 
 # message types: 21/22 keep the one-namespace msg-type space clear of
@@ -81,11 +84,14 @@ class ServingService:
     """``handle()`` contract of transport.RPCServer services."""
 
     def __init__(self, manager: ModelManager, on_change=None,
-                 endpoint: str = ""):
+                 endpoint: str = "", replica_id: str = ""):
         self.manager = manager
         # server hook: re-announce registry leases after admin changes
         self._on_change = on_change
         self.endpoint = endpoint
+        # replica-qualifies the corrupt-fault site so chaos can hit
+        # exactly one replica (``corrupt:serving_reply@r1``)
+        self.replica_id = replica_id
         # graceful drain: once set, new INFERs get a typed Draining
         # reply (the lease is already deregistered — only stragglers
         # racing the deregistration land here) while accepted requests
@@ -154,6 +160,20 @@ class ServingService:
             # reply names come from the model that ANSWERED — a re-route
             # for names could race a hot-swap onto a different version
             pairs = list(zip(sm.predictor.fetch_names, outs))
+            if _faults.active():
+                # silent-data-corruption chaos site: applied BEFORE the
+                # divergence digest, so an injected SDC looks to the
+                # sentinel exactly like a real one (wrong bytes leave
+                # the replica, digest and all)
+                nbits = _faults.corrupt_fault(
+                    f"serving_reply@{self.replica_id}", "serving_reply")
+                if nbits and pairs:
+                    fname, fval = pairs[0]
+                    pairs[0] = (fname, _faults.corrupt_array(fval, nbits))
+            if _audit.enabled():
+                _audit.note_reply(name, str(sm.version),
+                                  _audit.request_hash(feed),
+                                  _audit.digest_pairs(pairs))
             return transport.OK, [_TAG_RESULT] + serde.dumps_batch_vec(pairs)
         if msg_type == SERVING_ADMIN:
             body = json.loads(bytes(payload).decode("utf-8"))
@@ -212,6 +232,8 @@ class ModelServer:
         self.registry_ep = registry_ep
         self.lease_ttl = lease_ttl
         self.replica_id = replica_id or f"{self.endpoint}"
+        self.service.replica_id = self.replica_id
+        self._canary_client: Optional[transport.RPCClient] = None
         self._hb_lock = threading.Lock()
         self._heartbeats: Dict[str, _registry.Heartbeat] = {}
         self._started = False
@@ -232,7 +254,11 @@ class ModelServer:
         self.service.endpoint = self.endpoint
         _debug_server.register_servingz(self.endpoint,
                                         self.manager.servingz)
+        # correctness plane: the golden prober self-arms in any serving
+        # process (no-op with FLAGS_canary_probe off)
+        _canary.maybe_start_from_flags()
         self._sync_announcements()
+        self._sync_canary_targets()
 
     def stop(self, drain: bool = False, drain_timeout: float = 30.0
              ) -> None:
@@ -278,6 +304,8 @@ class ModelServer:
                 _flight.note("serving_drain_handler_timeout",
                              endpoint=self.endpoint)
         _debug_server.unregister_servingz(self.endpoint)
+        for sm in self.manager.models():
+            _canary.unregister_target(replica_key(sm.name, self.replica_id))
         # drain: the transport grants mid-reply connections a bounded
         # grace so the last replies reach the wire before severing
         self._server.stop(graceful_s=2.0 if drain else 0.0)
@@ -288,11 +316,13 @@ class ModelServer:
     def load(self, *args, **kw):
         sm = self.manager.load(*args, **kw)
         self._sync_announcements()
+        self._sync_canary_targets()
         return sm
 
     def swap(self, *args, **kw):
         out = self.manager.swap(*args, **kw)
         self._sync_announcements()
+        self._sync_canary_targets()
         return out
 
     # -- registry announce -------------------------------------------------
@@ -339,8 +369,55 @@ class ModelServer:
                         out.update(hr)
             except KeyError:
                 pass
+            # correctness plane rides the same lease (canary streaks
+            # present iff FLAGS_canary_probe and this replica is a
+            # probed target; reply digests present iff
+            # FLAGS_divergence_check) — the supervisor's sentinel
+            # groups digests ACROSS replicas with zero new RPCs
+            can = _canary.lease_rider(replica_key(model, self.replica_id))
+            if can is not None:
+                out["canary"] = can
+            dig = _audit.recent_digests()
+            if dig is not None and model in dig:
+                out["digests"] = {model: dig[model]}
             return out
         return data
+
+    # -- golden canary targets ---------------------------------------------
+    def _canary_submit(self, model: str):
+        """A probe submit fn taking the REAL path: loopback RPC through
+        the wire INFER handler, so serde, batcher, device, reply
+        assembly — and any silent corruption on the way — are all
+        inside the probed surface."""
+        def submit(feeds: dict, tenant: Optional[str]):
+            import numpy as np
+            pairs = [(n, np.asarray(v)) for n, v in sorted(feeds.items())]
+            if tenant:
+                pairs.append((TENANT_FEED_KEY,
+                              np.frombuffer(str(tenant).encode("utf-8"),
+                                            np.uint8)))
+            if self._canary_client is None:
+                self._canary_client = transport.RPCClient(0)
+            body = self._canary_client._raw_request(
+                self.endpoint, INFER, model, serde.dumps_batch_vec(pairs))
+            body = memoryview(bytes(body)) if not isinstance(
+                body, memoryview) else body
+            tag, rest = bytes(body[:1]), body[1:]
+            if tag != _TAG_RESULT:
+                raise RuntimeError(f"canary probe got reply tag {tag!r}")
+            return serde.loads_batch(rest, copy=True)
+        return submit
+
+    def _sync_canary_targets(self) -> None:
+        """Mirror :meth:`_sync_announcements` for the prober's target
+        registry (works registry-less too) — a no-op unless armed."""
+        if not _canary.enabled() or not self._started:
+            return
+        for sm in self.manager.models():
+            if sm.state not in ("RETIRED",):
+                _canary.register_target(
+                    replica_key(sm.name, self.replica_id), sm.name,
+                    self._canary_submit(sm.name))
 
     def _sync_announcements(self) -> None:
         """One registry heartbeat per served MODEL NAME: the lease

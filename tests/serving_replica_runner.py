@@ -1,0 +1,48 @@
+"""One serving replica as a real supervised worker process.
+
+Spawned by the correctness-anatomy e2e test through the supervisor:
+reads the fleet registry + replica id from env, serves a deterministic
+stub model, and drains gracefully on SIGTERM (the supervisor's
+quarantine path), so in-flight requests finish before the process
+exits.  The correctness plane (golden canary prober, reply digests)
+arms itself from FLAGS_* env vars at import; the lying replica gets
+``FLAGS_fault_inject=corrupt:serving_reply@<id>`` via ``env_once``.
+"""
+import os
+import signal
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.serving.server import ModelServer  # noqa: E402
+
+
+class _StubPredictor:
+    feed_names = ["x"]
+    fetch_names = ["y"]
+
+    def run(self, feed):
+        return [np.asarray(feed["x"]) * 2.0]
+
+
+def main() -> int:
+    srv = ModelServer("127.0.0.1:0",
+                      registry_ep=os.environ["PADDLE_REGISTRY"],
+                      replica_id=os.environ["REPLICA_ID"],
+                      lease_ttl=0.3)
+    srv.load("mlp", "1", predictor=_StubPredictor(), warm=False,
+             buckets=(1, 2, 4), activate=True, max_delay_ms=1.0)
+    srv.start()
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
+    done.wait()
+    srv.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

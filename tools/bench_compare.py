@@ -64,7 +64,7 @@ METRIC_KEYS = (
 # async-snapshot step-loop overhead fraction): the delta sign flips for
 # classification, the reported delta stays raw
 LOWER_BETTER_KEYS = frozenset({"ckpt_overhead_frac", "recovery_mttr_s",
-                               "decode_ttft_ms_p99"})
+                               "decode_ttft_ms_p99", "canary_failures"})
 
 # lower-better keys in ABSOLUTE units (seconds, not a fraction): their
 # delta is relative when the baseline is positive — a 3 s -> 3.5 s MTTR
@@ -76,14 +76,17 @@ LOWER_BETTER_RELATIVE_KEYS = frozenset({"recovery_mttr_s",
 # tail-latency keys gated IN ADDITION to a config's headline: a round
 # whose decode throughput held but whose TTFT p99 doubled must still
 # read regression.  Each secondary present in BOTH rounds gets its own
-# "<config>:<key>" entry with the same classification machinery
-SECONDARY_GATE_KEYS = ("decode_ttft_ms_p99",)
+# "<config>:<key>" entry with the same classification machinery.
+# canary_failures rides the same gate: a round that got FASTER while
+# the in-window golden canary started mismatching is a correctness
+# regression, not a win
+SECONDARY_GATE_KEYS = ("decode_ttft_ms_p99", "canary_failures")
 
 # informational keys carried through the comparison WITHOUT gating:
 # recorded per config when present in either round (the evidence
-# chain keeps capacity headroom round-over-round), never classified,
-# never part of the verdict
-INFORMATIONAL_KEYS = ("headroom_frac",)
+# chain keeps capacity headroom + canary probe cost round-over-round),
+# never classified, never part of the verdict
+INFORMATIONAL_KEYS = ("headroom_frac", "canary_overhead_frac")
 
 DEFAULT_THRESHOLD = 0.10
 

@@ -19,6 +19,8 @@ Operator companion to ``paddle_tpu/observability/debug_server.py``
     python tools/dump_metrics.py 8085 --varz --window 600   # history
     python tools/dump_metrics.py 8085 --capacityz     # util + headroom
     python tools/dump_metrics.py 8085 --tenantz --text  # tenant table
+    python tools/dump_metrics.py 8085 --canaryz       # canary + audit
+    python tools/dump_metrics.py 8085 --canaryz --text  # streak table
 
 JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
 through (optionally filtered with ``--grep``) so the output pastes
@@ -107,10 +109,14 @@ def main(argv=None) -> int:
                     help="fetch the per-tenant usage page (/tenantz: "
                          "top-K heavy-hitter table with requests/rows/"
                          "tokens/device-ms and the `other` rollup)")
+    ap.add_argument("--canaryz", action="store_true",
+                    help="fetch the correctness page (/canaryz: golden "
+                         "canary per-target pass/fail streaks plus the "
+                         "divergence-audit digest ring)")
     ap.add_argument("--text", action="store_true",
-                    help="with --memz/--profilez/--capacityz/--tenantz:"
-                         " the human text rendering (?text=1) instead "
-                         "of JSON")
+                    help="with --memz/--profilez/--capacityz/--tenantz/"
+                         "--canaryz: the human text rendering (?text=1) "
+                         "instead of JSON")
     ap.add_argument("port", type=int,
                     help="the worker's FLAGS_debug_server_port")
     ap.add_argument("pages", nargs="*", default=list(DEFAULT_PAGES),
@@ -121,7 +127,7 @@ def main(argv=None) -> int:
     rc = 0
     if args.tracez or args.flight or args.memz or args.profilez or \
             args.decodez or args.sloz or args.varz or \
-            args.capacityz or args.tenantz:
+            args.capacityz or args.tenantz or args.canaryz:
         pages = []
         if args.tracez:
             pages.append("tracez?raw=1" if args.raw else "tracez")
@@ -143,6 +149,8 @@ def main(argv=None) -> int:
             pages.append("capacityz" + suffix)
         if args.tenantz:
             pages.append("tenantz" + suffix)
+        if args.canaryz:
+            pages.append("canaryz" + suffix)
         for page in pages:
             try:
                 body = fetch(args.host, args.port, page,

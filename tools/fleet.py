@@ -176,9 +176,11 @@ def main(argv=None) -> int:
 
 
 def _print_role_table(out: dict) -> None:
-    """Per-role summary under the JSON card: liveness, SLO breaches
-    and — when replicas publish capacity (FLAGS_capacity_attribution)
-    — the tightest replica's headroom next to the SLO column."""
+    """Per-role summary under the JSON card: liveness, SLO breaches,
+    — when replicas publish capacity (FLAGS_capacity_attribution) —
+    the tightest replica's headroom next to the SLO column, and — when
+    the golden canary runs (FLAGS_canary_probe) — the worst live
+    canary-fail streak (`-` = all replicas passing)."""
     fleets = out if all(isinstance(v, dict) and "roles" in v
                         for v in out.values()) and out else {"": out}
     for fname, status in fleets.items():
@@ -189,17 +191,20 @@ def _print_role_table(out: dict) -> None:
         print()
         title = f"fleet {status.get('fleet', fname) or fname}"
         print(f"{title}  [{status.get('state', '?')}]")
-        print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}".format(
-            "role", "count", "target", "hold", "slo_breach", "headroom"))
+        print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}{:>9}".format(
+            "role", "count", "target", "hold", "slo_breach", "headroom",
+            "canary"))
         for r in sorted(roles):
             rs = roles[r]
             n_slo = sum(1 for w in slo if str(w).startswith(f"{r}-"))
             hr = rs.get("headroom_frac")
-            print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}".format(
+            streak = rs.get("canary_fail_streak")
+            print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}{:>9}".format(
                 r, rs.get("count", "?"), rs.get("target", "?"),
                 "yes" if rs.get("hold") else "-",
                 n_slo or "-",
-                f"{hr:.1%}" if isinstance(hr, (int, float)) else "-"))
+                f"{hr:.1%}" if isinstance(hr, (int, float)) else "-",
+                f"fail:{streak}" if streak else "-"))
 
 
 if __name__ == "__main__":

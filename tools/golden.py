@@ -1,0 +1,170 @@
+"""Record / inspect / replay golden canary sets (observability/canary.py).
+
+The golden canary prober replays recorded input -> expected-output
+pairs through live replicas; this tool captures those pairs against a
+TRUSTED build — record on a build you believe, then every later build
+is continuously regression-checked against it in production:
+
+    # feeds.json: {"cases": [{"feeds": {"x": {"dtype": "float32",
+    #                                         "shape": [1, 4],
+    #                                         "data": [..flat..]}}}]}
+    python tools/golden.py record --model mnist --feeds feeds.json \
+        --endpoint 127.0.0.1:9000 --out golden.json --rtol 1e-5
+    python tools/golden.py show golden.json
+    python tools/golden.py replay golden.json --model mnist \
+        --endpoint 127.0.0.1:9000     # offline parity check
+
+``record`` sends each feeds case through the real INFER path
+(``ServingClient.infer_pairs``, tenant-tagged ``__canary__``) and
+stores the replies as the expected outputs.  ``replay`` re-sends and
+compares with the set's rtol — the same comparison the in-process
+prober runs, usable as a one-shot parity check between two builds.
+``--registry`` records through registry discovery instead of a static
+endpoint.
+
+Trust caveat (module doc of canary.py): a golden set blesses whatever
+build recorded it.  Keep provenance honest — the recorded endpoint,
+time, and case count are stamped into the file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.observability import canary as _canary  # noqa: E402
+
+
+def load_feeds(path: str) -> List[Dict[str, object]]:
+    """Parse a feeds file into a list of decoded feed dicts."""
+    with open(path, "r", encoding="utf-8") as f:
+        payload = json.load(f)
+    cases = payload["cases"] if isinstance(payload, dict) else payload
+    out = []
+    for case in cases:
+        enc = case.get("feeds") if isinstance(case, dict) else case
+        out.append({n: _canary.decode_array(e) for n, e in enc.items()})
+    return out
+
+
+def record_cases(infer_pairs_fn: Callable, model: str,
+                 feeds_list: List[dict], rtol: Optional[float] = None,
+                 provenance: Optional[dict] = None) -> "_canary.GoldenSet":
+    """Build a :class:`GoldenSet` by running every feeds case through
+    ``infer_pairs_fn(feeds) -> [(name, array), ...]`` (the trusted
+    build).  Library entry point — the CLI wraps a ServingClient
+    around it, tests pass a local predictor closure."""
+    cases = []
+    for feeds in feeds_list:
+        expect = [(str(n), v) for n, v in infer_pairs_fn(feeds)]
+        cases.append({"feeds": dict(feeds), "expect": expect})
+    gs = _canary.GoldenSet()
+    gs.provenance = dict(provenance or {})
+    gs.models[str(model)] = {"rtol": rtol, "cases": cases}
+    return gs
+
+
+def write_goldens(gs: "_canary.GoldenSet", path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(gs.to_payload(), f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def replay_cases(infer_pairs_fn: Callable, gs: "_canary.GoldenSet",
+                 model: str) -> List[Optional[str]]:
+    """Replay one model's goldens; returns per-case ``None`` (pass) or
+    the mismatch description (the prober's own comparison)."""
+    rtol = gs.rtol(model)
+    results = []
+    for case in gs.cases(model):
+        got = infer_pairs_fn(case["feeds"])
+        results.append(_canary.compare_pairs(case["expect"], got, rtol))
+    return results
+
+
+def _make_client(args):
+    from paddle_tpu.serving.client import ServingClient
+    if args.registry:
+        return ServingClient(registry_ep=args.registry)
+    if args.endpoint:
+        return ServingClient(endpoints=[args.endpoint])
+    raise SystemExit("need --endpoint or --registry")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="record / inspect / replay golden canary sets")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rec = sub.add_parser("record", help="capture goldens from a "
+                         "trusted live build")
+    rec.add_argument("--model", required=True)
+    rec.add_argument("--feeds", required=True,
+                     help="feeds JSON ({'cases': [{'feeds': ...}]})")
+    rec.add_argument("--out", required=True, help="golden JSON to write")
+    rec.add_argument("--rtol", type=float, default=None,
+                     help="per-model rtol stored in the set (default: "
+                     "prober falls back to FLAGS_canary_rtol)")
+    rec.add_argument("--endpoint", help="static serving replica")
+    rec.add_argument("--registry", help="discover replicas by registry")
+
+    shw = sub.add_parser("show", help="summarize a golden set")
+    shw.add_argument("path")
+
+    rep = sub.add_parser("replay", help="replay goldens against a live "
+                         "build and compare")
+    rep.add_argument("path")
+    rep.add_argument("--model", required=True)
+    rep.add_argument("--endpoint", help="static serving replica")
+    rep.add_argument("--registry", help="discover replicas by registry")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "show":
+        gs = _canary.load_goldens(args.path)
+        print(json.dumps({
+            "provenance": gs.provenance,
+            "models": {m: {"rtol": spec.get("rtol"),
+                           "cases": len(spec["cases"])}
+                       for m, spec in gs.models.items()}}, indent=2,
+            sort_keys=True))
+        return 0
+
+    if args.cmd == "record":
+        client = _make_client(args)
+        feeds_list = load_feeds(args.feeds)
+        gs = record_cases(
+            lambda feeds: client.infer_pairs(
+                args.model, feeds, tenant=_canary.CANARY_TENANT),
+            args.model, feeds_list, rtol=args.rtol,
+            provenance={"recorded_unix_s": int(time.time()),
+                        "endpoint": args.endpoint or args.registry,
+                        "cases": len(feeds_list)})
+        write_goldens(gs, args.out)
+        print(f"recorded {len(feeds_list)} case(s) for model "
+              f"{args.model!r} -> {args.out}")
+        return 0
+
+    # replay
+    gs = _canary.load_goldens(args.path)
+    client = _make_client(args)
+    results = replay_cases(
+        lambda feeds: client.infer_pairs(
+            args.model, feeds, tenant=_canary.CANARY_TENANT),
+        gs, args.model)
+    fails = [(i, r) for i, r in enumerate(results) if r is not None]
+    for i, r in fails:
+        print(f"FAIL case {i}: {r}")
+    print(f"{len(results) - len(fails)}/{len(results)} case(s) passed")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -57,6 +57,12 @@ MODULES = [
     # and the STATS_PULL rider forms drift loudly
     "paddle_tpu.observability.capacity",
     "paddle_tpu.observability.tenant",
+    # the correctness plane (golden canary prober, divergence audit
+    # ring) + the golden-set operator CLI: frozen so the golden file
+    # format, digest scheme and rider shapes drift loudly
+    "paddle_tpu.observability.canary",
+    "paddle_tpu.observability.audit",
+    "golden",          # tools/golden.py (tools/ on sys.path here)
     "bench_compare",   # tools/bench_compare.py (tools/ on sys.path here)
     "runlog_report",   # tools/runlog_report.py
     # pipeline parallelism plane (stage transpiler, schedules, drivers,

@@ -1548,6 +1548,290 @@ def _bench_decode_inner():
     return out
 
 
+def bench_decode_prefix():
+    """Prefix caching + overcommit (the refcounted block lifecycle,
+    ``FLAGS_decode_prefix_cache`` / ``FLAGS_decode_overcommit``) vs the
+    single-owner baseline, two legs:
+
+    - **shared prefix**: 64 requests sharing an 87% system prompt
+      (416 of 480 tokens), offered to a prefix-on engine vs the same
+      engine with the flag off.  The prefix-on run prefills the shared
+      blocks ONCE (request 0), every later admission reuses them and
+      prefills only its 64-token suffix — ``saved_prefill_tokens`` must
+      equal the analytic count EXACTLY (63 x 416) and the greedy tokens
+      must match the prefix-off run per request.  Headline:
+      ``decode_tokens_per_sec`` over the offered window plus mean TTFT
+      both ways; ``prefix_hit_rate`` gates as a secondary in
+      tools/bench_compare.py (a hit rate collapse is a regression even
+      if throughput holds).  Zero recompiles in both measured windows
+      (suffix lengths ride the resume bucket ladder).
+    - **overcommit**: a block pool sized for HALF the offered streams'
+      full reservation.  The reservation baseline can only run as many
+      slots as full reservations fit; overcommit admits on the prompt
+      footprint, grows block-by-block, and preempts the newest stream
+      under pressure (token-exact re-prefill resume).  Measured: slot
+      occupancy over the loaded window (queue nonempty) both ways —
+      the overcommit run must hold >= 0.9 with >= 1 real preemption —
+      completion of ALL streams, and zero token divergence between
+      preempted-and-resumed streams and the reservation run.
+
+    Off-TPU both legs are CPU policy evidence (``analysis: true``, the
+    bench_decode precedent)."""
+    from paddle_tpu.core import flags as _flags
+
+    # token-level anatomy (TTFT histograms + goodput lane counters —
+    # the occupancy evidence) rides both legs, finally-restored
+    _flags.set_flags({"phase_attribution": True})
+    try:
+        return _bench_decode_prefix_inner()
+    finally:
+        _flags.set_flags({"phase_attribution": False})
+
+
+def _bench_decode_prefix_inner():
+    import threading
+
+    import jax
+
+    from paddle_tpu.decode import (DecodeEngine, LMConfig, SamplingParams,
+                                   TransformerLM)
+
+    impl = "xla" if jax.default_backend() != "tpu" else None
+
+    # -- leg 1: shared-prefix prefill dedup --------------------------------
+    # heavier geometry than bench_decode: the full prefill runs 512
+    # dense rows where the suffix path runs 64, so model cost widens
+    # the gap the cache exploits.  max_new=1: the first token samples
+    # inside the prefill dispatch, so the window isolates exactly what
+    # the prefix cache accelerates (decode-step throughput is
+    # bench_decode's row; the overcommit leg below runs
+    # decode-step-heavy traffic on a smaller model)
+    cfg = LMConfig(vocab=256, d_model=192, n_head=4, d_ffn=768, n_layer=3,
+                   max_seq_len=512)
+    lm = TransformerLM(cfg)
+    params = lm.init_params(seed=7)
+    BS, SLOTS, N, MAX_NEW = 32, 16, 64, 1
+    SHARED, UNIQ = 416, 64           # 13 shared blocks, 87% of the prompt
+    BUCKETS = (512,)
+    rng = np.random.RandomState(3)
+    shared = rng.randint(0, cfg.vocab, SHARED).astype("int32")
+    prompts = [np.concatenate([shared,
+                               rng.randint(0, cfg.vocab, UNIQ).astype(
+                                   "int32")]) for _ in range(N)]
+
+    def run_shared(prefix_on):
+        eng = DecodeEngine(lm, params,
+                           name="bpx_on" if prefix_on else "bpx_off",
+                           max_slots=SLOTS, block_tokens=BS,
+                           prefill_buckets=BUCKETS, max_queue=N + 4,
+                           attn_impl=impl, prefix_cache=prefix_on,
+                           overcommit=False)
+        # warm out-of-window: the full-prefill bucket + the decode step,
+        # and (prefix on) the suffix executable — a second warm prompt
+        # sharing the first one's block prefix dispatches prefill_sfx
+        # on the same resume bucket the measured suffixes snap to
+        w1 = np.full(510, 1, np.int32)
+        eng.generate(w1, max_new_tokens=2)
+        if prefix_on:
+            w2 = w1.copy()
+            w2[448:] = 2             # diverge at block 14: 62-token suffix
+            eng.generate(w2, max_new_tokens=2)
+        ps = eng._pstats
+        saved0 = ps.saved_prefill_tokens.value if ps else 0
+        hits0 = ps.prefix_hits.value if ps else 0
+        lk0 = ps.prefix_lookups.value if ps else 0
+        before = _exec_counters()
+        ttfts = [0.0] * N
+        threads = []
+
+        def first_tok(i, h, t0):
+            h.next_token(timeout=600)
+            ttfts[i] = (time.perf_counter() - t0) * 1e3
+
+        t_start = time.perf_counter()
+        # request 0 goes first and we WAIT for its first token: its
+        # prefill registers the shared blocks, so every later request
+        # hits them — the analytic saved-token count stays exact.  The
+        # prefix-off run follows the same staged protocol for fairness.
+        h0 = eng.submit(prompts[0], SamplingParams(max_new_tokens=MAX_NEW))
+        h0.next_token(timeout=600)
+        ttfts[0] = (time.perf_counter() - t_start) * 1e3
+        handles = [h0]
+        for i in range(1, N):
+            t0 = time.perf_counter()
+            h = eng.submit(prompts[i],
+                           SamplingParams(max_new_tokens=MAX_NEW))
+            th = threading.Thread(target=first_tok, args=(i, h, t0))
+            th.start()
+            threads.append(th)
+            handles.append(h)
+        results = [h.result(timeout=600) for h in handles]
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t_start
+        after = _exec_counters()
+        z = eng.decodez()
+        leaked = eng.cache.allocator.leaked(
+            eng.prefix.parked_blocks if eng.prefix else 0)
+        out = {
+            "tps": (N * MAX_NEW) / wall,
+            "ttft_mean_ms": sum(ttfts) / N,
+            "tokens": [r["tokens"] for r in results],
+            "saved": (ps.saved_prefill_tokens.value - saved0) if ps else 0,
+            "hits": (ps.prefix_hits.value - hits0) if ps else 0,
+            "lookups": (ps.prefix_lookups.value - lk0) if ps else 0,
+            "leaked": leaked,
+            "prefix_card": z.get("prefix_cache"),
+            "recompiles": {k.split(".", 1)[1]: after[k] - before[k]
+                           for k in after},
+        }
+        eng.close()
+        return out
+
+    off = run_shared(False)
+    on = run_shared(True)
+    assert on["tokens"] == off["tokens"], \
+        "prefix-on greedy tokens diverged from prefix-off"
+    expect_saved = (N - 1) * SHARED
+    assert on["saved"] == expect_saved, (on["saved"], expect_saved)
+    assert on["leaked"] == 0 and off["leaked"] == 0, (on["leaked"],
+                                                      off["leaked"])
+    for leg in (off, on):
+        assert all(v == 0 for v in leg["recompiles"].values()), \
+            leg["recompiles"]
+    hit_rate = on["hits"] / max(on["lookups"], 1)
+
+    # -- leg 2: overcommit + preemption under a half-sized pool ------------
+    # smaller model (decode steps dominate this leg, the policy under
+    # test is block accounting, not matmul throughput)
+    cfg2 = LMConfig(vocab=256, d_model=128, n_head=4, d_ffn=256,
+                    n_layer=2, max_seq_len=512)
+    lm2 = TransformerLM(cfg2)
+    params2 = lm2.init_params(seed=11)
+    BS2, SLOTS2, N2, M2, P2 = 16, 16, 24, 112, 16
+    FULL = (P2 + M2 + BS2 - 1) // BS2          # reservation: 8 blocks
+    POOL = 1 + (N2 // 2) * FULL                # half the offered streams
+    BUCKETS2 = (16, 32, 64, 128)
+    prompts2 = [rng.randint(0, cfg2.vocab, P2).astype("int32")
+                for _ in range(N2)]
+
+    def run_overcommit(overcommit_on):
+        eng = DecodeEngine(lm2, params2,
+                           name="boc_on" if overcommit_on else "boc_off",
+                           max_slots=SLOTS2, block_tokens=BS2,
+                           num_blocks=POOL, prefill_buckets=BUCKETS2,
+                           max_queue=N2 + 4, attn_impl=impl,
+                           prefix_cache=False, overcommit=overcommit_on)
+        # warm every prefill bucket: preemption re-prefill lengths
+        # (P2..P2+M2-1) snap onto the same ladder, so the churny
+        # window stays recompile-free too
+        for b in BUCKETS2:
+            eng.generate(np.full(b - 2, 1, np.int32), max_new_tokens=2)
+        lat = eng.stats.lat
+        before = _exec_counters()
+        live0, pad0 = lat.live_slot_steps.value, lat.pad_slot_steps.value
+        loaded = {"live": live0, "pad": pad0}
+        done = threading.Event()
+
+        def monitor():
+            # loaded-window occupancy: lane counters at the LAST
+            # instant the queue was nonempty (the drain tail, where
+            # slots empty because no work is left, must not read as
+            # an occupancy loss)
+            while not done.is_set():
+                if eng.stats.queue.value > 0:
+                    loaded["live"] = lat.live_slot_steps.value
+                    loaded["pad"] = lat.pad_slot_steps.value
+                time.sleep(0.002)
+
+        mon = threading.Thread(target=monitor)
+        mon.start()
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, SamplingParams(max_new_tokens=M2))
+                   for p in prompts2]
+        results = [h.result(timeout=600) for h in handles]
+        wall = time.perf_counter() - t0
+        done.set()
+        mon.join()
+        after = _exec_counters()
+        lw, pw = loaded["live"] - live0, loaded["pad"] - pad0
+        ps = eng._pstats
+        leaked = eng.cache.allocator.leaked()
+        out = {
+            "tps": sum(r["n_tokens"] for r in results) / wall,
+            "occupancy": lw / max(lw + pw, 1),
+            "tokens": [r["tokens"] for r in results],
+            "completed": sum(1 for r in results
+                             if r["finish"] == "length"),
+            "preempts": ps.preempts.value if ps else 0,
+            "resumes": ps.preempt_resumes.value if ps else 0,
+            "reprefill_tokens": ps.reprefill_tokens.value if ps else 0,
+            "leaked": leaked,
+            "recompiles": {k.split(".", 1)[1]: after[k] - before[k]
+                           for k in after},
+        }
+        eng.close()
+        return out
+
+    oc_off = run_overcommit(False)
+    oc_on = run_overcommit(True)
+    # token-exactness across preemption: greedy decode is per-stream
+    # deterministic, so the reservation run IS the uninterrupted truth
+    divergent = sum(1 for a, b in zip(oc_on["tokens"], oc_off["tokens"])
+                    if a != b)
+    assert divergent == 0, f"{divergent} preempted streams diverged"
+    assert oc_on["completed"] == N2 and oc_off["completed"] == N2
+    assert oc_on["preempts"] >= 1, "overcommit leg saw no preemption"
+    assert oc_on["leaked"] == 0 and oc_off["leaked"] == 0
+    assert all(v == 0 for v in oc_on["recompiles"].values()), \
+        oc_on["recompiles"]
+
+    out = {
+        "note": "CPU in-process: isolates the block-lifecycle policy "
+                "(prefix dedup, COW, preemption); on-chip capture "
+                "pending tunnel (ROADMAP item 1 'decode' row)",
+        "model": cfg.to_dict(),
+        "overcommit_model": cfg2.to_dict(),
+        "requests": N, "shared_prefix_tokens": SHARED,
+        "unique_tail_tokens": UNIQ, "max_new": MAX_NEW,
+        "slots": SLOTS, "block_tokens": BS,
+        # headline (gated by tools/bench_compare.py METRIC_KEYS)
+        "decode_tokens_per_sec": round(on["tps"], 1),
+        "prefix_off_tokens_per_sec": round(off["tps"], 1),
+        "prefix_speedup": round(on["tps"] / max(off["tps"], 1e-9), 2),
+        "ttft_mean_ms_prefix_on": round(on["ttft_mean_ms"], 2),
+        "ttft_mean_ms_prefix_off": round(off["ttft_mean_ms"], 2),
+        "ttft_speedup": round(off["ttft_mean_ms"] /
+                              max(on["ttft_mean_ms"], 1e-9), 2),
+        # secondary gate (bench_compare SECONDARY_GATE_KEYS): a hit
+        # rate collapse is a regression even when throughput holds
+        "prefix_hit_rate": round(hit_rate, 4),
+        "saved_prefill_tokens": on["saved"],
+        "saved_prefill_tokens_expected": expect_saved,
+        "prefix_cache": on["prefix_card"],
+        "recompiles_in_window": on["recompiles"],
+        "overcommit": {
+            "offered_streams": N2, "slots": SLOTS2,
+            "pool_blocks": POOL, "full_blocks_per_stream": FULL,
+            "overcommit_tokens_per_sec": round(oc_on["tps"], 1),
+            "reservation_tokens_per_sec": round(oc_off["tps"], 1),
+            "occupancy_overcommit": round(oc_on["occupancy"], 4),
+            "occupancy_reservation": round(oc_off["occupancy"], 4),
+            "preempts": oc_on["preempts"],
+            "resumes": oc_on["resumes"],
+            "reprefill_tokens": oc_on["reprefill_tokens"],
+            "divergent_streams": divergent,
+            "completed_streams": oc_on["completed"],
+        },
+    }
+    assert out["prefix_speedup"] >= 2.0, out["prefix_speedup"]
+    assert out["ttft_speedup"] >= 2.0, out["ttft_speedup"]
+    assert oc_on["occupancy"] >= 0.9, oc_on["occupancy"]
+    if jax.default_backend() != "tpu":
+        out["analysis"] = True
+    return out
+
+
 A100_RESNET50_IMG_S = 2500.0
 A100_TRANSFORMER_TOK_S = 50000.0
 
@@ -2226,6 +2510,9 @@ CONFIG_TABLE = [
     # ``analysis: true`` off-TPU (the deepfm_fused precedent); the
     # on-chip number is the ROADMAP item 1 'decode' capture row
     ("decode", bench_decode, 420, False),
+    # refcounted block lifecycle: shared-prefix dedup + overcommit
+    # preemption legs (CPU policy evidence off-TPU, like decode)
+    ("decode_prefix", bench_decode_prefix, 420, False),
     ("pipeline", bench_pipeline, 900, False),
     ("compile_cache", bench_compile_cache, 600, False),
     ("checkpoint", bench_checkpoint, 600, False),

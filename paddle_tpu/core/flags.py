@@ -310,6 +310,30 @@ define_flag("decode_max_queue", 64,
             "request queue: past it, new generation requests are shed "
             "with the serving plane's typed Overloaded reply (counted "
             "in decode.shed) instead of queueing into timeout")
+define_flag("decode_prefix_cache", False,
+            "content-addressed prefix caching for the decode plane "
+            "(paddle_tpu/decode/cache.py PrefixCache): full prompt "
+            "blocks are keyed by a rolling hash of (model, token ids "
+            "to the block boundary); admission walks the new prompt's "
+            "block-aligned prefix against the cache and adopts hits "
+            "as refcounted copy-on-write references, so a shared "
+            "system prompt prefills ONCE and later requests prefill "
+            "only their suffix.  Zero-refcount cached blocks park in "
+            "an LRU and are reclaimed under pool pressure.  Latched "
+            "when a DecodeEngine is built; off (default): legacy "
+            "full-reservation behavior, byte-identical")
+define_flag("decode_overcommit", False,
+            "lazy block reservation + preemption for the decode plane "
+            "(paddle_tpu/decode/engine.py): admission reserves only "
+            "ceil((P+1)/block_tokens) blocks instead of the full "
+            "prompt+max_new worst case and grows one block per decode "
+            "step; when growth cannot allocate, the newest running "
+            "stream is preempted (blocks freed, generated tokens kept "
+            "host-side) and re-admitted head-of-line via suffix "
+            "re-prefill — token-for-token identical to an "
+            "uninterrupted run (counter-hash sampling is positional). "
+            "Latched when a DecodeEngine is built; off (default): "
+            "full reservation at admission, byte-identical")
 define_flag("phase_attribution", False,
             "per-request latency-phase attribution for the serving and "
             "decode planes (observability/phase.py): each request "

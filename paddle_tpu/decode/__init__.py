@@ -31,7 +31,18 @@ package is that state plane, built on the repo's own primitives:
 - **On-device sampling** (:mod:`model`): greedy / top-k / temperature
   inside the decode dispatch; incremental beam search rides
   :class:`paddle_tpu.contrib.decoder.IncrementalBeamDecoder` (the
-  reference beam machinery, one ``beam_search`` step per decode step).
+  reference beam machinery, one ``beam_search`` step per decode step),
+  and :class:`~paddle_tpu.decode.beam.PagedBeamDecoder` runs its beams
+  as copy-on-write references into the paged cache (the parent gather
+  becomes a block-table operation, not a state copy).
+- **Refcounted block lifecycle** (``FLAGS_decode_prefix_cache`` /
+  ``FLAGS_decode_overcommit``, both latched per engine): blocks carry
+  refcounts; full prompt blocks are content-addressed in a
+  :class:`~paddle_tpu.decode.cache.PrefixCache` so shared system
+  prompts prefill once (later requests prefill only their suffix);
+  admission may overcommit the pool, with decode-step growth and
+  newest-stream preemption + token-exact re-prefill resume under
+  pressure.  Both flags off: byte-identical legacy behavior.
 - **Streaming serving** (:mod:`server` / :mod:`client`): tokens stream
   to clients over a new framed ``DECODE`` msg type on the existing
   zero-copy transport (multi-frame replies — the transport's STREAM
@@ -44,11 +55,13 @@ builds an engine gets no new arrays, threads, or sockets.
 """
 from __future__ import annotations
 
-from .cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .cache import (BlockAllocator, PagedKVCache,  # noqa: F401
+                    PrefixCache)
 from .model import (LMConfig, TransformerLM, load_lm,  # noqa: F401
                     save_lm)
 from .engine import (DecodeEngine, DecodeHandle,  # noqa: F401
                      DecodeRequest, SamplingParams)
+from .beam import PagedBeamDecoder  # noqa: F401
 from .server import DecodeServer, DecodeService  # noqa: F401
 from .client import DecodeClient  # noqa: F401
 from ..contrib.decoder import IncrementalBeamDecoder  # noqa: F401
@@ -56,9 +69,10 @@ from ..serving.batcher import (Draining, Overloaded,  # noqa: F401
                                RequestTooLong)
 
 __all__ = [
-    "BlockAllocator", "PagedKVCache",
+    "BlockAllocator", "PagedKVCache", "PrefixCache",
     "LMConfig", "TransformerLM", "save_lm", "load_lm",
     "DecodeEngine", "DecodeHandle", "DecodeRequest", "SamplingParams",
+    "PagedBeamDecoder",
     "DecodeServer", "DecodeService", "DecodeClient",
     "IncrementalBeamDecoder", "Draining", "Overloaded",
     "RequestTooLong",

@@ -31,6 +31,38 @@ past the block-table context bound) is a typed
 ``ceil((prompt+max_new)/block_tokens)`` blocks up front — so a running
 stream can never hit cache OOM mid-generation.
 
+Two latched flags rebuild the block lifecycle on the refcounted
+allocator (:mod:`paddle_tpu.decode.cache`); both off (default) keeps
+every code path, allocation order and metric series byte-identical to
+the legacy engine:
+
+- ``FLAGS_decode_prefix_cache`` — admission walks the prompt's
+  block-aligned prefix against a content-addressed
+  :class:`~paddle_tpu.decode.cache.PrefixCache` and ADOPTS hits as
+  refcounted references, so a shared system prompt prefills once and
+  later requests dispatch only a suffix prefill
+  (:meth:`TransformerLM.prefill_suffix`).  Full prompt blocks register
+  after prefill; zero-ref cached blocks park in an LRU reclaimed under
+  pool pressure.  Hits are capped one block short of the prompt so the
+  suffix is never empty (the last position's logits seed the stream).
+- ``FLAGS_decode_overcommit`` — admission reserves only
+  ``ceil((P+1)/block_tokens)`` blocks and the decode step grows one
+  block as a stream crosses each block boundary; when growth cannot
+  allocate, the NEWEST running stream is preempted (blocks decref'd,
+  generated tokens kept host-side on its handle) and re-queued
+  head-of-line for re-prefill of ``prompt + generated[:-1]`` — the
+  counter-hash sampler is positional, so a resumed stream's remaining
+  tokens are identical to an uninterrupted run.  The oldest stream is
+  never evicted: it finishes, frees blocks, and the FIFO head (the
+  preempted request) re-admits — no livelock.
+
+Writes into a block that is shared (refcount > 1) or advertised by the
+prefix cache fork it first — device block-copy plus a block-table
+remap (copy-on-write).  Inside this engine streams only ever append
+past their adopted prefix, so forks are the beam decoder's path
+(:mod:`paddle_tpu.decode.beam`); the step-side check is the safety
+invariant that makes that true by construction.
+
 Observability: ``decode.<name>.*`` counters/gauges/histograms plus the
 ``/decodez`` debug page (:func:`DecodeEngine.decodez`).
 """
@@ -44,7 +76,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .cache import PagedKVCache, blocks_for
+from .cache import PagedKVCache, PrefixCache, blocks_for
 from .model import TransformerLM
 from ..core import flags as _flags
 from ..core.executor import Executor
@@ -95,7 +127,7 @@ class SamplingParams:
 
 class DecodeRequest:
     __slots__ = ("rid", "prompt", "sampling", "t_enq", "handle", "tl",
-                 "tenant")
+                 "tenant", "resume_tokens")
 
     def __init__(self, rid: int, prompt: np.ndarray,
                  sampling: SamplingParams,
@@ -104,6 +136,10 @@ class DecodeRequest:
         self.prompt = prompt
         self.sampling = sampling
         self.tenant = tenant
+        # set by preemption: the tokens generated before eviction; a
+        # non-None value marks a queued request as a RESUME (re-prefill
+        # prompt + resume_tokens[:-1], then continue token-exact)
+        self.resume_tokens: Optional[List[int]] = None
         self.t_enq = time.monotonic()
         self.handle = DecodeHandle(rid)
         # phase timeline sharing the enqueue stamp (flag-gated; None
@@ -204,16 +240,24 @@ class DecodeHandle:
 
 class _Slot:
     __slots__ = ("req", "blocks", "pos_next", "n_generated", "last_token",
-                 "t_last")
+                 "t_last", "cached_tokens", "seq")
 
     def __init__(self, req: DecodeRequest, blocks: List[int],
-                 prompt_len: int, first_token: int):
+                 prompt_len: int, first_token: int,
+                 cached_tokens: int = 0, seq: Optional[np.ndarray] = None):
         self.req = req
         self.blocks = blocks
         self.pos_next = prompt_len   # where the last sampled token's
         self.n_generated = 1         # K/V lands on the next step
         self.last_token = first_token
         self.t_last = time.monotonic()
+        # prefix-cache / resume bookkeeping (0 / None on the legacy
+        # path): positions [0, cached_tokens) are already resident in
+        # adopted blocks; ``seq`` is the full token sequence prefill
+        # must make resident (prompt, or prompt+generated[:-1] on a
+        # preemption resume)
+        self.cached_tokens = cached_tokens
+        self.seq = seq
 
 
 class _LatencyStats:
@@ -231,8 +275,8 @@ class _LatencyStats:
       block), every prefill token either real prompt or bucket pad,
       and cancelled streams generated into the void — the counters
       say how much of the device time bought tokens a client kept.
-      (Re-prefill accounting joins when preemption lands; today a
-      admitted request is never evicted, so there is nothing to count.)
+      (Preemption re-prefill compute is accounted separately in
+      :class:`_PrefixStats` — ``preempt_reprefill_tokens``.)
     """
 
     def __init__(self, name: str):
@@ -276,6 +320,53 @@ class _LatencyStats:
             "cancelled": self.cancelled.value,
             "cancelled_tokens": self.cancelled_tokens.value,
         }
+
+
+class _PrefixStats:
+    """Refcounted-pool metric bundle: prefix-cache hit accounting,
+    copy-on-write forks, preemption/resume accounting and the pool
+    leak invariant.  Created only when ``FLAGS_decode_prefix_cache``
+    or ``FLAGS_decode_overcommit`` latched on at engine construction,
+    so a flags-off process registers none of these series (the
+    byte-identical metric-surface pin)."""
+
+    def __init__(self, name: str):
+        sc = _obs_stats.scope(f"decode.{name}")
+        self.prefix_lookups = sc.counter(
+            "prefix_lookups", "full prompt blocks walked against the "
+            "prefix cache at admission (the hit-rate denominator)")
+        self.prefix_hits = sc.counter(
+            "prefix_hits", "blocks adopted from the prefix cache — "
+            "prompt positions that did NOT re-prefill")
+        self.prefix_inserts = sc.counter(
+            "prefix_inserts", "freshly prefilled full blocks registered "
+            "into the prefix cache")
+        self.prefix_evictions = sc.counter(
+            "prefix_evictions", "parked zero-ref cached blocks reclaimed "
+            "to the free list under pool pressure (LRU order)")
+        self.prefix_collisions = sc.counter(
+            "prefix_collisions", "hash hits rejected by the full "
+            "token-id verify (served as a miss, never as wrong K/V)")
+        self.saved_prefill_tokens = sc.counter(
+            "prefix_saved_prefill_tokens", "prompt tokens whose prefill "
+            "compute was skipped via adopted cached blocks")
+        self.cow_forks = sc.counter(
+            "cow_forks", "shared blocks forked (device block-copy + "
+            "table remap) on the first divergent write")
+        self.preempts = sc.counter(
+            "preempts", "running streams evicted by overcommit pressure "
+            "(blocks freed, generated tokens kept host-side)")
+        self.preempt_resumes = sc.counter(
+            "preempt_resumes", "preempted streams re-admitted via "
+            "re-prefill")
+        self.reprefill_tokens = sc.counter(
+            "preempt_reprefill_tokens", "tokens re-prefilled resuming "
+            "preempted streams (overcommit's compute cost)")
+        self.blocks_referenced = sc.gauge("blocks_referenced")
+        self.blocks_cached = sc.gauge("blocks_cached")
+        self.blocks_leaked = sc.gauge(
+            "blocks_leaked", "pool invariant: usable blocks neither "
+            "free, referenced nor cached — MUST be zero")
 
 
 class _EngineStats:
@@ -339,7 +430,9 @@ class DecodeEngine:
                  executor: Optional[Executor] = None,
                  capture_logits: bool = False,
                  attn_impl: Optional[str] = None,
-                 cache_dtype: str = "float32"):
+                 cache_dtype: str = "float32",
+                 prefix_cache: Optional[bool] = None,
+                 overcommit: Optional[bool] = None):
         self.model = model
         self.name = name
         cfg = model.config
@@ -371,6 +464,31 @@ class DecodeEngine:
             else Executor(training=False)
         self._plist = model.param_list(params)
         self.stats = _EngineStats(name)
+        # refcounted block lifecycle (module doc) — latched here; both
+        # flags off keeps the legacy single-owner paths byte-identical
+        self._prefix_on = bool(_flags.get_flags("decode_prefix_cache")
+                               if prefix_cache is None else prefix_cache)
+        self._overcommit_on = bool(_flags.get_flags("decode_overcommit")
+                                   if overcommit is None else overcommit)
+        self._refc = self._prefix_on or self._overcommit_on
+        self.prefix = (PrefixCache(
+            self.cache.allocator, bs,
+            model_key=f"{name}/{cfg.vocab}x{cfg.d_model}x{cfg.n_layer}")
+            if self._prefix_on else None)
+        self._pstats = _PrefixStats(name) if self._refc else None
+        if self._refc:
+            # suffix / resume bucket ladder: a prefix-hit suffix (or a
+            # preemption re-prefill, whose length can exceed the
+            # prefill ladder) snaps onto block-size doublings so a
+            # handful of executables cover every residual length
+            limit = self.max_context()
+            sizes2 = set(self.prefill_ladder.sizes)
+            b2 = bs
+            while b2 < limit:
+                sizes2.add(b2)
+                b2 *= 2
+            sizes2.add(limit)
+            self._resume_ladder = BucketLadder(sorted(sizes2))
 
         self._lock = threading.Condition()
         self._pending: List[DecodeRequest] = []
@@ -484,32 +602,91 @@ class DecodeEngine:
             if dropped.tl is not None:
                 self.stats.latency().cancelled.inc()
             dropped.handle._finish("cancelled")
+        bs = self.cache.block_tokens
         for i, slot in enumerate(self._slots):
             if slot is not None or not self._pending:
                 continue
             req = self._pending[0]
-            need = blocks_for(
-                req.prompt.size + req.sampling.max_new_tokens,
-                self.cache.block_tokens)
-            blocks = self.cache.allocator.alloc(need)
+            resume = req.resume_tokens is not None
+            if resume and len(req.resume_tokens) > 1:
+                # re-prefill target: prompt + generated[:-1]; the LAST
+                # generated token's K/V is written by the next decode
+                # step (exactly the post-prefill slot contract)
+                seq = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.resume_tokens[:-1], np.int32)])
+            else:
+                seq = req.prompt
+            L = int(seq.size)
+            if self._overcommit_on:
+                # lazy reservation: enough for the resident sequence
+                # plus the next write position; the decode step grows
+                # one block per boundary crossing (or preempts)
+                need = blocks_for(L + 1, bs)
+            else:
+                need = blocks_for(
+                    req.prompt.size + req.sampling.max_new_tokens, bs)
+            acquired: List[int] = []
+            start = 0
+            if self.prefix is not None:
+                # cap one block short of the sequence: prefill must
+                # compute >= 1 real position (the stream's next logits)
+                cap = min((L - 1) // bs, need)
+                if cap > 0:
+                    c0 = self.prefix.collisions
+                    hits = self.prefix.match(seq, cap)
+                    self._pstats.prefix_lookups.inc(cap)
+                    dc = self.prefix.collisions - c0
+                    if dc:
+                        self._pstats.prefix_collisions.inc(dc)
+                    # acquire BEFORE the fresh alloc: a referenced hit
+                    # cannot be stolen by the LRU reclaim that alloc
+                    # may trigger under pressure
+                    acquired = [self.prefix.acquire(k) for k, _ in hits]
+                    start = len(acquired) * bs
+            blocks = self._alloc_blocks(need - len(acquired))
             if blocks is None:
-                break   # head-of-line waits for blocks; keep FIFO order
+                for b in acquired:       # re-park the hits; FIFO head
+                    self.cache.allocator.decref(b)   # waits for blocks
+                break
+            blocks = acquired + blocks
+            if start:
+                self._pstats.prefix_hits.inc(len(acquired))
+                self._pstats.saved_prefill_tokens.inc(start)
             self._pending.pop(0)
             # the slot is claimed NOW (table row filled) so a later
             # admission in the same sweep can't take it
             row = self._tables[i]
             row[:] = 0
             row[:len(blocks)] = blocks
-            self._slots[i] = _Slot(req, blocks, req.prompt.size,
-                                   first_token=-1)   # token set by prefill
-            if req.tl is not None:
+            self._slots[i] = _Slot(req, blocks, L,
+                                   first_token=-1,   # token set by prefill
+                                   cached_tokens=start,
+                                   seq=seq if (start or resume) else None)
+            if req.tl is not None and not resume:
                 req.tl.stamp("queue")   # queue wait ends at slot claim
-            self.stats.joins.inc()   # every join has a matching leave
-            out.append(req)          # through _retire
+            if not resume:
+                self.stats.joins.inc()   # every join has a matching
+            out.append(req)              # leave through _retire
         self.stats.queue.set(len(self._pending))
         self.stats.blocks_free.set(self.cache.allocator.free_blocks)
         self.stats.active.set(sum(s is not None for s in self._slots))
+        if self._refc:
+            self._update_pool_gauges()
         return out
+
+    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """Allocator alloc with prefix-cache backpressure: a miss
+        reclaims parked (zero-ref cached) blocks LRU-first and retries
+        — a cached block is only ever a loan from the free pool."""
+        got = self.cache.allocator.alloc(n)
+        if got is None and self.prefix is not None:
+            freed = self.prefix.reclaim(
+                n - self.cache.allocator.free_blocks)
+            if freed:
+                self._pstats.prefix_evictions.inc(freed)
+                got = self.cache.allocator.alloc(n)
+        return got
 
     def _slot_of(self, req: DecodeRequest):
         for i, s in enumerate(self._slots):
@@ -523,6 +700,11 @@ class DecodeEngine:
         i, slot = self._slot_of(req)
         if req.handle.cancelled:   # client vanished between admit and here
             self._retire(i, slot, "cancelled")
+            return
+        resume = req.resume_tokens is not None
+        start = slot.cached_tokens
+        if resume or start > 0:
+            self._prefill_partial(i, slot, req, t0)
             return
         P = req.prompt.size
         bucket = self.prefill_ladder.snap(P)
@@ -573,9 +755,127 @@ class DecodeEngine:
             lat.ttft_ms.observe((slot.t_last - req.t_enq) * 1e3)
             lat.prefill_tokens.inc(P)
             lat.pad_prefill_tokens.inc(bucket - P)
+        self._register_prefix(slot, req.prompt)
         req.handle._emit(
             first, np.asarray(logits) if self.capture_logits else None)
         self._maybe_finish(i, slot, first)
+
+    def _prefill_partial(self, i: int, slot: _Slot, req: DecodeRequest,
+                         t0: float) -> None:
+        """Prefill with a resident prefix (prefix-cache hits) and/or a
+        preemption resume: only positions [start, L) dispatch, via
+        :meth:`TransformerLM.prefill_suffix` (a full re-prefill when
+        start == 0 rides the dense :meth:`TransformerLM.prefill` on
+        the wider resume ladder).  On resume the sampled token is
+        DISCARDED and the slot restored to its pre-eviction state —
+        the next decode step re-samples token index n_generated, which
+        the positional counter-hash makes identical to the token the
+        stream would have produced uninterrupted."""
+        resume = req.resume_tokens is not None
+        seq = slot.seq if slot.seq is not None else req.prompt
+        L = int(seq.size)
+        start = slot.cached_tokens
+        model = self.model
+        if start > 0:
+            n = L - start
+            bucket = self._resume_ladder.snap(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = seq[start:]
+
+            def build():
+                def fn(feed, state, const):
+                    kc, vc, tok, logits = model.prefill_suffix(
+                        const, state[0], state[1], *feed)
+                    return [tok, logits], [kc, vc]
+                return fn
+
+            feed = [tokens,
+                    np.int32(start),
+                    np.int32(L),
+                    self._tables[i].copy(),
+                    np.uint32(req.sampling.seed & 0xFFFFFFFF),
+                    np.float32(req.sampling.temperature),
+                    np.int32(req.sampling.top_k)]
+            key = f"decode/{self.name}/prefill_sfx/{bucket}"
+        else:
+            n = L
+            bucket = self._resume_ladder.snap(L)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :L] = seq
+
+            def build():
+                def fn(feed, state, const):
+                    kc, vc, tok, logits = model.prefill(
+                        const, state[0], state[1], *feed)
+                    return [tok, logits], [kc, vc]
+                return fn
+
+            feed = [tokens,
+                    np.int32(L),
+                    self._tables[i].copy(),
+                    np.uint32(req.sampling.seed & 0xFFFFFFFF),
+                    np.float32(req.sampling.temperature),
+                    np.int32(req.sampling.top_k)]
+            key = f"decode/{self.name}/prefill/{bucket}"
+        _debug_server.note_activity("decode")
+        _faults.event("decode_prefill")
+        (tok, logits), new_state = self._exe.run_callable(
+            key, build, feed, state=self.cache.state(), const=self._plist)
+        self.cache.update(new_state)
+        slot.t_last = time.monotonic()
+        self.stats.prefills.inc()
+        prefill_ms = (time.perf_counter() - t0) * 1e3
+        self.stats.prefill_ms.observe(prefill_ms)
+        if _capacity.enabled():
+            self.stats.capacity_tracker().note(
+                "prefill", prefill_ms, bucket=bucket, work=1)
+        if _tenant.enabled():
+            _tenant.account(req.tenant, prefill_tokens=n,
+                            device_ms=prefill_ms)
+        if req.tl is not None and not resume:
+            req.tl.stamp("prefill", t=slot.t_last)
+            lat = self.stats.latency()
+            lat.ttft_ms.observe((slot.t_last - req.t_enq) * 1e3)
+            lat.prefill_tokens.inc(n)
+            lat.pad_prefill_tokens.inc(bucket - n)
+        self._register_prefix(slot, seq)
+        if resume:
+            # restore the evicted stream's exact slot state; the
+            # freshly sampled token is a DISCARD (it re-derives
+            # resume_tokens[start's] successor which the client
+            # already has)
+            gen = req.resume_tokens
+            slot.pos_next = L
+            slot.n_generated = len(gen)
+            slot.last_token = int(gen[-1])
+            req.resume_tokens = None
+            self._pstats.preempt_resumes.inc()
+            self._pstats.reprefill_tokens.inc(n)
+            return
+        first = int(np.asarray(tok))
+        slot.last_token = first
+        self.stats.tokens.inc()
+        req.handle._emit(
+            first, np.asarray(logits) if self.capture_logits else None)
+        self._maybe_finish(i, slot, first)
+
+    def _register_prefix(self, slot: _Slot, seq: np.ndarray) -> None:
+        """Advertise the slot's freshly prefilled FULL blocks in the
+        prefix cache (content is immutable from here: the stream only
+        ever appends past them).  Hit blocks [0, cached_tokens) are
+        already registered."""
+        if self.prefix is None:
+            return
+        bs = self.cache.block_tokens
+        toks = [int(t) for t in seq]
+        keys = self.prefix.chain_keys(toks)
+        inserted = 0
+        for bi in range(slot.cached_tokens // bs, len(seq) // bs):
+            if self.prefix.insert(keys[bi], toks[:(bi + 1) * bs],
+                                  slot.blocks[bi]):
+                inserted += 1
+        if inserted:
+            self._pstats.prefix_inserts.inc(inserted)
 
     def _decode_step(self) -> None:
         t0 = time.perf_counter()
@@ -585,6 +885,9 @@ class DecodeEngine:
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.req.handle.cancelled:
                 self._retire(i, slot, "cancelled")
+        if self._refc:
+            # overcommit growth + copy-on-write forks (may preempt)
+            self._ensure_blocks()
         tokens = np.zeros((self.max_slots,), np.int32)
         positions = np.zeros((self.max_slots,), np.int32)
         seeds = np.zeros((self.max_slots,), np.uint32)
@@ -660,6 +963,117 @@ class DecodeEngine:
                 tok, logits_np[i] if logits_np is not None else None)
             self._maybe_finish(i, slot, tok)
 
+    # -- refcounted block lifecycle (prefix cache / overcommit) ------------
+    def _ensure_blocks(self) -> None:
+        """Make every live slot's write-target block PRESENT (overcommit
+        growth: one block per boundary crossing) and PRIVATE (fork a
+        block that is shared or advertised by the prefix cache before
+        writing into it).  Runs before each step dispatch; allocation
+        failure preempts the newest stream and retries — bounded by the
+        live-slot count, and the oldest stream is never evicted, so the
+        engine always makes forward progress."""
+        bs = self.cache.block_tokens
+        alloc = self.cache.allocator
+        for i in range(self.max_slots):
+            slot = self._slots[i]
+            if slot is None:
+                continue
+            j = slot.pos_next // bs
+            while j >= len(slot.blocks):
+                got = self._alloc_blocks(1)
+                if got is not None:
+                    with self._lock:
+                        slot.blocks.append(got[0])
+                        self._tables[i, len(slot.blocks) - 1] = got[0]
+                    break
+                self._preempt_newest()
+                if self._slots[i] is None:   # preempted itself
+                    break
+            slot = self._slots[i]
+            if slot is None or j >= len(slot.blocks):
+                continue
+            b = slot.blocks[j]
+            if alloc.refcount(b) > 1 or (self.prefix is not None
+                                         and self.prefix.holds(b)):
+                nb: Optional[int] = None
+                while nb is None:
+                    got = self._alloc_blocks(1)
+                    if got is not None:
+                        nb = got[0]
+                        break
+                    self._preempt_newest()
+                    if self._slots[i] is None:
+                        break
+                if self._slots[i] is None or nb is None:
+                    continue
+                self._copy_block(b, nb)
+                with self._lock:
+                    slot.blocks[j] = nb
+                    self._tables[i, j] = nb
+                alloc.decref(b)
+                self._pstats.cow_forks.inc()
+        self._update_pool_gauges()
+
+    def _preempt_newest(self) -> None:
+        """Evict the NEWEST (highest rid) live stream: free its blocks,
+        keep its generated tokens host-side on the handle, and requeue
+        it head-of-line for re-prefill.  Newest-victim keeps the oldest
+        stream running to completion — freed blocks then admit the FIFO
+        head (the preempted request), the no-livelock argument."""
+        v = None
+        for j, s in enumerate(self._slots):
+            if s is not None and (v is None or
+                                  s.req.rid > self._slots[v].req.rid):
+                v = j
+        if v is None:
+            return
+        slot = self._slots[v]
+        req = slot.req
+        # chaos hook: `kill_after:decode_preempt` dies HERE, mid-
+        # eviction — the replica vanishes with the pool half-mutated;
+        # the supervisor-respawned replica must come back with a clean
+        # pool invariant (the chaos_lite pin)
+        _faults.event("decode_preempt")
+        with self._lock:
+            self._slots[v] = None
+            self.cache.allocator.release(slot.blocks)
+            self._tables[v, :] = 0
+            req.resume_tokens = list(req.handle._tokens)
+            self._pending.insert(0, req)
+            self.stats.queue.set(len(self._pending))
+            self.stats.active.set(
+                sum(s is not None for s in self._slots))
+            self.stats.blocks_free.set(self.cache.allocator.free_blocks)
+            self._lock.notify_all()
+        self._pstats.preempts.inc()
+
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Device block-copy (the COW fork): one tiny jitted callable
+        on the donated cache state — K/V never round-trip to host."""
+        def build():
+            def fn(feed, state, const):
+                s, d = feed
+                k, v = state
+                k = k.at[:, d].set(k[:, s])
+                v = v.at[:, d].set(v[:, s])
+                return [], [k, v]
+            return fn
+
+        _, new_state = self._exe.run_callable(
+            f"decode/{self.name}/blkcopy", build,
+            [np.int32(src), np.int32(dst)],
+            state=self.cache.state(), const=[])
+        self.cache.update(new_state)
+
+    def _update_pool_gauges(self) -> None:
+        if not self._refc:
+            return
+        alloc = self.cache.allocator
+        parked = self.prefix.parked_blocks if self.prefix is not None else 0
+        self._pstats.blocks_referenced.set(alloc.referenced_blocks)
+        self._pstats.blocks_cached.set(parked)
+        self._pstats.blocks_leaked.set(alloc.leaked(parked))
+
     # -- retirement --------------------------------------------------------
     def _maybe_finish(self, i: int, slot: _Slot, token: int) -> None:
         s = slot.req.sampling
@@ -678,6 +1092,7 @@ class DecodeEngine:
             self.stats.leaves.inc()
             self.stats.active.set(sum(x is not None for x in self._slots))
             self.stats.blocks_free.set(self.cache.allocator.free_blocks)
+            self._update_pool_gauges()
             self._lock.notify_all()   # blocks freed: admit the queue head
         req = slot.req
         if _capacity.enabled():
@@ -721,6 +1136,7 @@ class DecodeEngine:
                     self.stats.leaves.inc()
             self.stats.blocks_free.set(self.cache.allocator.free_blocks)
             self.stats.active.set(sum(x is not None for x in self._slots))
+            self._update_pool_gauges()
         req.handle._fail(error)
 
     def _fail_all(self, error) -> None:
@@ -732,6 +1148,7 @@ class DecodeEngine:
                     self.cache.allocator.release(s.blocks)
                     self.stats.leaves.inc()
             self._tables[:] = 0
+            self._update_pool_gauges()
         for s in slots:
             if s is not None:
                 s.req.handle._fail(error)
@@ -764,6 +1181,41 @@ class DecodeEngine:
             "leaves": self.stats.leaves.value,
             "shed": self.stats.shed.value,
         }
+        if self._refc:
+            # the refcounted block lifecycle (flag-latched; absent
+            # flags-off so the payload shape stays byte-identical)
+            alloc = self.cache.allocator
+            parked = (self.prefix.parked_blocks
+                      if self.prefix is not None else 0)
+            ps = self._pstats
+            out["block_pool"] = {
+                "size": self.cache.num_blocks,
+                "free": alloc.free_blocks,
+                "referenced": alloc.referenced_blocks,
+                "cached": parked,
+                "leaked": alloc.leaked(parked),
+                "cow_forks": ps.cow_forks.value,
+                "overcommit": self._overcommit_on,
+            }
+            if self.prefix is not None:
+                lk, ht = ps.prefix_lookups.value, ps.prefix_hits.value
+                out["prefix_cache"] = {
+                    "entries": len(self.prefix),
+                    "cached_blocks": parked,
+                    "lookups": lk,
+                    "hits": ht,
+                    "hit_rate": round(ht / max(lk, 1), 4),
+                    "saved_prefill_tokens": ps.saved_prefill_tokens.value,
+                    "inserts": ps.prefix_inserts.value,
+                    "evictions": ps.prefix_evictions.value,
+                    "collisions": self.prefix.collisions,
+                }
+            if self._overcommit_on:
+                out["preemption"] = {
+                    "preempts": ps.preempts.value,
+                    "resumes": ps.preempt_resumes.value,
+                    "reprefill_tokens": ps.reprefill_tokens.value,
+                }
         snap = self.stats.step_ms.snapshot()
         if snap.get("count"):
             out["step_p50_ms"] = self.stats.step_ms.percentile(0.50)

@@ -244,6 +244,70 @@ class TransformerLM:
                       top_k[None])[0]
         return kc, vc, tok, logits
 
+    # -- suffix prefill (prefix-cache hits / preemption resume) ------------
+    def prefill_suffix(self, plist, kc, vc, tokens, start, length,
+                       block_table, seed, temperature, top_k):
+        """tokens [1, Sb] (bucket-padded suffix), start [] int32 (how
+        many leading positions are already resident in the cache —
+        block-aligned prefix-cache hits), length [] int32 (total real
+        sequence length; the suffix is positions start..length-1),
+        block_table [MB] int32 → (kc', vc', next_token [] int32,
+        logits [V]).
+
+        The prompt's cached prefix is NOT recomputed: suffix K/V is
+        scattered into the request's blocks first, then — because
+        every suffix lane shares the SAME block table — the whole
+        context is gathered ONCE per layer ([MB, bs] → [MB*bs] rows)
+        and attention is a dense masked matmul of the Sb suffix
+        queries against it (a lane at absolute position ``pos`` sees
+        context rows 0..pos: the cached prefix plus the suffix rows
+        written this dispatch, in the same layer).  That keeps the
+        gather O(context) instead of the per-lane paged path's
+        O(lanes x context).  Pad lanes scatter into trash block 0 and
+        attend (masked) to position 0 only; their output is
+        discarded.  Unwritten table slots are trash block 0 too — as
+        flattened rows their positions exceed every real ``pos``, so
+        the mask drops them.  Samples the first generated token like
+        :meth:`prefill` (token index 0)."""
+        cfg = self.config
+        p = self._unpack(plist)
+        Sb = tokens.shape[1]
+        bs = kc.shape[2]
+        MB = block_table.shape[0]
+        sc = float(1.0 / np.sqrt(cfg.head_dim))
+        lane = jnp.arange(Sb, dtype=jnp.int32)
+        n = length - start                      # real suffix length
+        valid = lane < n
+        pos = start + lane
+        safe_pos = jnp.minimum(jnp.where(valid, pos, 0),
+                               cfg.max_seq_len - 1)
+        blocks = jnp.where(valid, block_table[safe_pos // bs], 0)
+        offsets = safe_pos % bs
+        tpos = jnp.arange(MB * bs, dtype=jnp.int32)
+        mask = tpos[None, :] <= safe_pos[:, None]   # [Sb, MB*bs]
+        h = (p["emb"][tokens[0]] * (cfg.d_model ** 0.5)
+             + self._pos[safe_pos])
+        for i in range(cfg.n_layer):
+            q, k, v = self._qkv(p, i, h)          # [Sb, H, Dh]
+            kc = self._scatter_kv(kc, i, blocks, offsets, k)
+            vc = self._scatter_kv(vc, i, blocks, offsets, v)
+            ck = kc[i][block_table].reshape(MB * bs, cfg.n_head,
+                                            cfg.head_dim)
+            cv = vc[i][block_table].reshape(MB * bs, cfg.n_head,
+                                            cfg.head_dim)
+            s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                           ck.astype(jnp.float32)) * sc
+            s = jnp.where(mask[None], s, -1e30)
+            w = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("hqk,khd->qhd", w, cv.astype(jnp.float32))
+            h = self._post_attn(p, i, h, ctx.astype(h.dtype))
+        last = h[jnp.maximum(n - 1, 0)]
+        logits = last @ p["out_proj"]
+        tok = _sample(logits[None], seed[None],
+                      jnp.zeros((1,), jnp.int32), temperature[None],
+                      top_k[None])[0]
+        return kc, vc, tok, logits
+
     # -- decode step (the continuous-batching hot dispatch) ----------------
     def decode_step(self, plist, kc, vc, tokens, positions, block_tables,
                     seeds, steps, temperature, top_k, attn_impl=None):

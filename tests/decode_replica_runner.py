@@ -1,0 +1,47 @@
+"""One decode replica as a real supervised worker process.
+
+Spawned by the prefix-caching chaos test through the supervisor: reads
+the fleet registry + replica id from env, serves a deterministic tiny
+transformer behind an OVERCOMMITTED block pool (13 blocks = 12 usable,
+4 slots), and drains gracefully on SIGTERM.  The pool is sized so that
+four concurrent max_new=20 streams MUST trigger preemption (each grows
+to 7 blocks; 4 x 7 > 12), which is where the chaos replica's
+``FLAGS_fault_inject=kill_after:decode_preempt`` (armed via
+``env_once``) hard-kills the process mid-eviction.
+"""
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.decode import (DecodeEngine, DecodeServer,  # noqa: E402
+                               LMConfig, TransformerLM)
+
+TINY = LMConfig(vocab=48, d_model=32, n_head=2, d_ffn=48, n_layer=2,
+                max_seq_len=32)
+
+
+def main() -> int:
+    lm = TransformerLM(TINY)
+    params = lm.init_params(seed=5)
+    eng = DecodeEngine(lm, params, name="lm", max_slots=4,
+                       block_tokens=4, num_blocks=13,
+                       prefill_buckets=(8,), max_queue=32,
+                       prefix_cache=False, overcommit=True)
+    srv = DecodeServer("127.0.0.1:0", engines={"lm": eng},
+                       registry_ep=os.environ["PADDLE_REGISTRY"],
+                       replica_id=os.environ["REPLICA_ID"],
+                       lease_ttl=0.3)
+    srv.start()
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
+    done.wait()
+    srv.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

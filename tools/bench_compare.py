@@ -79,8 +79,11 @@ LOWER_BETTER_RELATIVE_KEYS = frozenset({"recovery_mttr_s",
 # "<config>:<key>" entry with the same classification machinery.
 # canary_failures rides the same gate: a round that got FASTER while
 # the in-window golden canary started mismatching is a correctness
-# regression, not a win
-SECONDARY_GATE_KEYS = ("decode_ttft_ms_p99", "canary_failures")
+# regression, not a win.  prefix_hit_rate (higher-better, decode_prefix
+# config) gates the same way: a dedup hit-rate collapse is a capacity
+# regression even when the round's throughput happened to hold
+SECONDARY_GATE_KEYS = ("decode_ttft_ms_p99", "canary_failures",
+                       "prefix_hit_rate")
 
 # informational keys carried through the comparison WITHOUT gating:
 # recorded per config when present in either round (the evidence

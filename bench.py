@@ -1347,21 +1347,28 @@ def bench_decode():
     # precedent): a recorded greedy completion replayed through the
     # real engine submit path, costed as canary_overhead_frac and
     # gated as canary_failures in tools/bench_compare.py
+    # memory anatomy rides the same window: the engine registers its KV
+    # block pool on the ledger, so the artifact carries the measured
+    # bytes-per-token cost and the reconciliation residual
     _flags.set_flags({"phase_attribution": True,
                       "capacity_attribution": True,
                       "canary_probe": True,
-                      "canary_interval_s": 0.25})
+                      "canary_interval_s": 0.25,
+                      "memory_attribution": True})
     try:
         return _bench_decode_inner()
     finally:
         _flags.set_flags({"phase_attribution": False,
                           "capacity_attribution": False,
                           "canary_probe": False,
-                          "canary_interval_s": 5.0})
+                          "canary_interval_s": 5.0,
+                          "memory_attribution": False})
         from paddle_tpu.observability import canary as _canary
         from paddle_tpu.observability import capacity as _capacity
+        from paddle_tpu.observability import memory as _memory
         _canary.reset()
         _capacity.reset()
+        _memory.reset()
 
 
 def _bench_decode_inner():
@@ -1490,6 +1497,15 @@ def _bench_decode_inner():
     # capacity snapshot BEFORE close() (close unregisters the tracker)
     cap = eng.stats.capacity()
     cap_snap = cap.snapshot() if cap is not None else {}
+    # memory ledger BEFORE close() (close unregisters the KV pool):
+    # measured per-token KV cost + the reconciliation residual
+    from paddle_tpu.observability import memory as _memory
+    kv_bytes_per_token = round(
+        eng._block_bytes / max(eng.cache.block_tokens, 1), 3)
+    led = _memory.ledger(set_gauges=False)
+    unattributed = sum(
+        int(d.get("unattributed_bytes") or 0)
+        for d in (led.get("devices") or {}).values())
 
     # greedy parity: continuous tokens == re-prefill argmax tokens
     mismatches = sum(1 for i, r in enumerate(results)
@@ -1536,6 +1552,10 @@ def _bench_decode_inner():
         # count (secondary gate, 0 on a healthy build)
         "canary_overhead_frac": canary_overhead,
         "canary_failures": canary_failures,
+        # memory anatomy over the same window (informational in
+        # bench_compare; kv_bytes_per_token is lower-better)
+        "kv_bytes_per_token": kv_bytes_per_token,
+        "unattributed_bytes": unattributed,
         "speedup_vs_reprefill": round(cont_tps / max(base_tps, 1e-9), 2),
         "parity": {"greedy_mismatched_requests": mismatches,
                    "requests_compared": len(reqs)},
@@ -1580,12 +1600,17 @@ def bench_decode_prefix():
     from paddle_tpu.core import flags as _flags
 
     # token-level anatomy (TTFT histograms + goodput lane counters —
-    # the occupancy evidence) rides both legs, finally-restored
-    _flags.set_flags({"phase_attribution": True})
+    # the occupancy evidence) rides both legs, finally-restored; memory
+    # attribution rides too so the artifact carries measured KV cost
+    _flags.set_flags({"phase_attribution": True,
+                      "memory_attribution": True})
     try:
         return _bench_decode_prefix_inner()
     finally:
-        _flags.set_flags({"phase_attribution": False})
+        _flags.set_flags({"phase_attribution": False,
+                          "memory_attribution": False})
+        from paddle_tpu.observability import memory as _memory
+        _memory.reset()
 
 
 def _bench_decode_prefix_inner():
@@ -1685,6 +1710,14 @@ def _bench_decode_prefix_inner():
             "recompiles": {k.split(".", 1)[1]: after[k] - before[k]
                            for k in after},
         }
+        # memory ledger BEFORE close() (close unregisters the KV pool)
+        from paddle_tpu.observability import memory as _memory
+        out["kv_bytes_per_token"] = round(
+            eng._block_bytes / max(eng.cache.block_tokens, 1), 3)
+        led = _memory.ledger(set_gauges=False)
+        out["unattributed_bytes"] = sum(
+            int(d.get("unattributed_bytes") or 0)
+            for d in (led.get("devices") or {}).values())
         eng.close()
         return out
 
@@ -1806,6 +1839,10 @@ def _bench_decode_prefix_inner():
         # secondary gate (bench_compare SECONDARY_GATE_KEYS): a hit
         # rate collapse is a regression even when throughput holds
         "prefix_hit_rate": round(hit_rate, 4),
+        # memory anatomy over the prefix-on window (informational in
+        # bench_compare; kv_bytes_per_token is lower-better)
+        "kv_bytes_per_token": on["kv_bytes_per_token"],
+        "unattributed_bytes": on["unattributed_bytes"],
         "saved_prefill_tokens": on["saved"],
         "saved_prefill_tokens_expected": expect_saved,
         "prefix_cache": on["prefix_card"],

@@ -249,6 +249,30 @@ class ElasticController:
                              "predicted_max_qps") if k in data}
         return out
 
+    def memory_headroom(self, role: Optional[str] = None) -> Dict[str, dict]:
+        """Measured memory headroom per lease, read from the same lease
+        DATA payloads as :meth:`headroom` (servers publish
+        ``memory_headroom_frac`` / ``memory_bytes`` there iff
+        FLAGS_memory_attribution is on at the replica): {lease key:
+        {memory_headroom_frac, memory_bytes, ...}}.  ``role`` filters by
+        the announce key prefix like :meth:`headroom`.  INFORMATIONAL —
+        empty when no replica publishes memory (flags off fleet-wide)."""
+        # reuse headroom()'s snapshot cache discipline (one registry
+        # poll feeds both planes)
+        self.headroom(role)
+        prefix = {"SERVING": "serving/", "DECODE": "decode/"}.get(
+            (role or "").upper())
+        out = {}
+        for key, data in self._snap_cache["data"].items():
+            if prefix is not None and not key.startswith(prefix):
+                continue
+            if isinstance(data, dict) and "memory_headroom_frac" in data:
+                out[key] = {k: data[k] for k in
+                            ("memory_headroom_frac", "memory_bytes",
+                             "memory_parked_bytes", "memory_leak")
+                            if k in data}
+        return out
+
     def decide(self, role: str, target: int) -> dict:
         """Grow/shrink recommendation for ``role`` against ``target``
         live workers: {"action": "grow"|"shrink"|"hold", "delta": n,
@@ -295,4 +319,9 @@ class ElasticController:
         cap = self.headroom(role)
         if cap:
             out["capacity"] = cap
+        # measured memory headroom rides the same way: HOLD-safe,
+        # informational, absent when no replica publishes it
+        mem = self.memory_headroom(role)
+        if mem:
+            out["memory"] = mem
         return out

@@ -82,6 +82,22 @@ def _statusz() -> dict:
 _debug_server.register_provider("checkpoint", _statusz)
 
 
+def _mem_pool_snapshot() -> dict:
+    """Host bytes pinned by in-flight snapshot buffers, summed over
+    every live snapshotter (memory anatomy ledger callback)."""
+    snaps = list(_live)
+    used = sum(s._inflight_bytes for s in snaps)
+    return {"used": used,
+            "inflight_writers": sum(1 for s in snaps
+                                    if s._inflight_bytes)}
+
+
+def _register_memory_pool() -> None:
+    from ..observability import memory as _memory
+    if _memory.enabled():
+        _memory.pool("checkpoint_staging", "host", _mem_pool_snapshot)
+
+
 class AsyncSnapshotter:
     """Write sharded checkpoint pieces off the step loop.
 
@@ -112,7 +128,9 @@ class AsyncSnapshotter:
         self.faults = 0
         self.snapshots = 0
         self.skipped = 0
+        self._inflight_bytes = 0   # host bytes pinned by an in-flight write
         _live.add(self)
+        _register_memory_pool()
 
     # -- public -----------------------------------------------------------
     def snapshot(self, step: int, wait: bool = False) -> bool:
@@ -142,6 +160,11 @@ class AsyncSnapshotter:
                     _cm().collect_ms.set(collect_ms)
                     _cm().inflight.set(1)
                 self.snapshots += 1
+                self._inflight_bytes = sum(
+                    int(np.asarray(a).nbytes) for a in arrays.values())
+                from ..observability import memory as _memory
+                _memory.note_event("alloc", "checkpoint_staging",
+                                   self._inflight_bytes, step=step)
                 t = threading.Thread(target=self._write,
                                      args=(step, arrays), daemon=True,
                                      name=f"ckpt-{self.writer}")
@@ -201,6 +224,11 @@ class AsyncSnapshotter:
         finally:
             if _telemetry_on():
                 _cm().inflight.set(0)
+            if self._inflight_bytes:
+                from ..observability import memory as _memory
+                _memory.note_event("free", "checkpoint_staging",
+                                   self._inflight_bytes, step=step)
+                self._inflight_bytes = 0
         committed = False
         try:
             committed = _store.try_commit(self.root, step,

@@ -503,6 +503,23 @@ def store_stats(d: Optional[str] = None) -> dict:
             "bytes": sum(e["bytes"] for e in entries)}
 
 
+def register_memory_pool() -> None:
+    """Register the on-disk store on the MemoryLedger (kind ``disk``)
+    so /allocz answers how many bytes the persistent cache holds
+    against its ``FLAGS_compile_cache_max_bytes`` cap.  No-op unless
+    both the cache and ``FLAGS_memory_attribution`` are on."""
+    from ..observability import memory as _memory
+    if not _memory.enabled() or not enabled():
+        return
+
+    def _snap() -> dict:
+        st = store_stats()
+        return {"used": st["bytes"], "entries": st["entries"],
+                "cap_bytes": max_bytes()}
+
+    _memory.pool("compile_cache_disk", "disk", _snap)
+
+
 def prune_lru(d: Optional[str] = None,
               cap: Optional[int] = None) -> List[str]:
     """Evict oldest-used entries until the tier-A files fit under the

@@ -68,6 +68,40 @@ def _executor_statusz() -> dict:
 _debug_server.register_provider("executors", _executor_statusz)
 
 
+def _executor_pool_snapshot() -> dict:
+    """MemoryLedger callback: the persistent-state scope's device
+    bytes (shape × itemsize — no LazyFetch materialization, no sync)
+    plus the live executors' executable-cache entry count."""
+    scope_bytes = 0
+    nvars = 0
+    for v in list(global_scope().vars.values()):
+        if isinstance(v, SelectedRows):
+            v = v.values
+        shape = getattr(v, "shape", None)
+        dt = getattr(v, "dtype", None)
+        if shape is None or dt is None:
+            continue
+        try:
+            scope_bytes += int(np.prod(shape)) * np.dtype(dt).itemsize
+            nvars += 1
+        except (TypeError, ValueError):  # pragma: no cover - odd var
+            continue
+    entries = sum(len(e._cache) for e in list(_live_executors))
+    return {"used": scope_bytes, "scope_vars": nvars,
+            "cache_entries": entries}
+
+
+def _register_memory_pools() -> None:
+    """Register the executor's byte holders on the MemoryLedger —
+    called from ``Executor.__init__`` so a flag-off process pays one
+    flag read and never creates a pool."""
+    from ..observability import memory as _memory
+    if not _memory.enabled():
+        return
+    _memory.pool("executor_scope", "device", _executor_pool_snapshot)
+    _compile_cache.register_memory_pool()
+
+
 def _em():
     """Cached executor metric handles: registering through the registry
     on every run costs a lock + dict round trip per metric; the handles
@@ -479,6 +513,11 @@ class Executor:
         # cache at FLAGS_compile_cache_dir/xla.  Flag unset (default):
         # one flag read, nothing else
         _compile_cache.wire_jax_cache()
+        # memory anatomy: register the executable-cache + persistent-
+        # scope pool (and the compile cache's disk pool) on the
+        # MemoryLedger — one flag read when FLAGS_memory_attribution
+        # is off, idempotent when on
+        _register_memory_pools()
         # HA promotion awareness: last fleet-topology epoch this executor
         # acted on (see _refresh_promoted_endpoints)
         self._promo_epoch = 0

@@ -467,6 +467,35 @@ define_flag("divergence_param_steps", 50,
             "parameter checksum (only read when FLAGS_divergence_check "
             "is on): every K-th step each replica folds a u64 checksum "
             "of its persistable parameters into the audit plane")
+define_flag("memory_attribution", False,
+            "memory anatomy (observability/memory.py): every "
+            "byte-holding subsystem (decode KV block pool, executor "
+            "executable cache + persistent scope, compile-cache disk "
+            "store, serving batch staging, checkpoint snapshot "
+            "buffers) registers a pool on the process MemoryLedger; "
+            "the ledger reconciles pool sums against live PJRT "
+            "bytes_in_use per device into an explicit "
+            "unattributed_bytes residual, keeps a bounded allocation "
+            "event ring (alloc/free/park/reclaim/preempt/evict), runs "
+            "a leak sentinel promoting failed refcount audits to a "
+            "'memory' health dimension on registry heartbeats, and "
+            "dumps OOM forensics (full ledger + top holders + event "
+            "tail) on any RESOURCE_EXHAUSTED escaping a dispatch.  "
+            "Surfaces: /allocz (+?text=1), /memz ledger section, "
+            "STATS_PULL rider with fleet merge, compact lease-data "
+            "rider for ElasticController.memory_headroom().  Off "
+            "(default): no pools, no series, no thread, heartbeat / "
+            "lease / STATS_PULL payloads byte-identical")
+define_flag("memory_audit_interval_s", 5.0,
+            "period of the memory leak sentinel's refcount-invariant "
+            "audit sweep (only read when FLAGS_memory_attribution is "
+            "on); <= 0 disables the sentinel thread while keeping "
+            "ledger attribution available for pull-based audits")
+define_flag("memory_event_ring", 1024,
+            "bounded capacity of the allocation event ring "
+            "(alloc/free/park/reclaim/preempt/evict records with "
+            "sizes and pool ids; oldest events are overwritten) — "
+            "only allocated when FLAGS_memory_attribution is on")
 define_flag("pserver_registry", "",
             "host:port of the pserver discovery registry "
             "(distributed/registry.py — the etcd analogue): pservers "

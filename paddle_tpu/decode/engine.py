@@ -84,6 +84,7 @@ from ..distributed import faults as _faults
 from ..observability import audit as _audit
 from ..observability import capacity as _capacity
 from ..observability import debug_server as _debug_server
+from ..observability import memory as _memory
 from ..observability import phase as _phase
 from ..observability import stats as _obs_stats
 from ..observability import tenant as _tenant
@@ -498,6 +499,21 @@ class DecodeEngine:
                                 np.int32)
         self._rid = itertools.count(1)
         self._closed = False
+        # memory anatomy (FLAGS_memory_attribution): the KV block pool
+        # registers on the process MemoryLedger — pool bytes, per-state
+        # block counts (incl. parked LRU blocks), bytes-per-resident-
+        # stream — and its refcount invariant feeds the leak sentinel.
+        # Flag off: no pool, no series, no thread, _mem_pool stays None
+        # so every event-filing site is one attribute check
+        self._block_bytes = self.cache.nbytes // max(self.cache.num_blocks,
+                                                     1)
+        self._mem_pool: Optional[str] = None
+        if _memory.enabled():
+            self._mem_pool = f"decode_kv.{name}"
+            _memory.pool(self._mem_pool, "device",
+                         self._mem_pool_snapshot,
+                         audit=self._mem_pool_audit)
+            _memory.maybe_start_sentinel()
         _debug_server.register_decodez(name, self.decodez)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"decode-sched-{name}")
@@ -579,12 +595,14 @@ class DecodeEngine:
                 try:
                     self._prefill(req)
                 except Exception as e:   # noqa: BLE001 — fail ONE stream
+                    _memory.oom_forensics(e, "decode_prefill")
                     self._release(req, None, error=e)
             if any(s is not None for s in self._slots):
                 try:
                     self._decode_step()
                 except Exception as e:   # noqa: BLE001
-                    self._fail_all(e)
+                    if not self._recover_oom(e):
+                        self._fail_all(e)
         for req in pending:
             req.handle._fail(RuntimeError("decode engine closed"))
         for slot in break_slots:
@@ -623,7 +641,10 @@ class DecodeEngine:
                 # plus the next write position; the decode step grows
                 # one block per boundary crossing (or preempts)
                 need = blocks_for(L + 1, bs)
-            else:
+                if self._mem_pool is not None and \
+                        not self._admit_headroom_ok(need):
+                    break   # measured bytes say no room: FIFO head
+            else:           # waits for a release, like an alloc miss
                 need = blocks_for(
                     req.prompt.size + req.sampling.max_new_tokens, bs)
             acquired: List[int] = []
@@ -649,7 +670,17 @@ class DecodeEngine:
                 for b in acquired:       # re-park the hits; FIFO head
                     self.cache.allocator.decref(b)   # waits for blocks
                 break
+            if self._mem_pool is not None and blocks:
+                _memory.note_event("alloc", self._mem_pool,
+                                   len(blocks) * self._block_bytes,
+                                   rid=req.rid)
             blocks = acquired + blocks
+            if _tenant.enabled():
+                # resident KV attribution: the stream now holds a ref
+                # on every one of its blocks (prefix hits included);
+                # the matching negative delta files at retire/preempt
+                _tenant.account(req.tenant, resident_kv_bytes=(
+                    len(blocks) * self._block_bytes))
             if start:
                 self._pstats.prefix_hits.inc(len(acquired))
                 self._pstats.saved_prefill_tokens.inc(start)
@@ -685,6 +716,9 @@ class DecodeEngine:
                 n - self.cache.allocator.free_blocks)
             if freed:
                 self._pstats.prefix_evictions.inc(freed)
+                if self._mem_pool is not None:
+                    _memory.note_event("reclaim", self._mem_pool,
+                                       freed * self._block_bytes)
                 got = self.cache.allocator.alloc(n)
         return got
 
@@ -920,8 +954,13 @@ class DecodeEngine:
 
         _debug_server.note_activity("decode")
         # chaos hook: `delay:decode_step` sleeps inside the decode
-        # phase (per-token latency); cheap active() guard when off
+        # phase (per-token latency); cheap active() guard when off.
+        # `oom:decode_step` raises a realistic RESOURCE_EXHAUSTED here
+        # — exactly where a real allocation failure would surface — so
+        # the OOM-forensics + preempt-and-recover path is drillable
+        # without real HBM pressure
         _faults.event("decode_step")
+        _faults.oom_fault("decode_step")
         (toks, logits), new_state = self._exe.run_callable(
             f"decode/{self.name}/step", build,
             [tokens, positions, tables, seeds, steps, temps, topks],
@@ -985,6 +1024,13 @@ class DecodeEngine:
                     with self._lock:
                         slot.blocks.append(got[0])
                         self._tables[i, len(slot.blocks) - 1] = got[0]
+                    if self._mem_pool is not None:
+                        _memory.note_event("alloc", self._mem_pool,
+                                           self._block_bytes,
+                                           rid=slot.req.rid, grow=True)
+                    if _tenant.enabled():
+                        _tenant.account(slot.req.tenant,
+                                        resident_kv_bytes=self._block_bytes)
                     break
                 self._preempt_newest()
                 if self._slots[i] is None:   # preempted itself
@@ -1012,6 +1058,12 @@ class DecodeEngine:
                     self._tables[i, j] = nb
                 alloc.decref(b)
                 self._pstats.cow_forks.inc()
+                if self._mem_pool is not None:
+                    # net-zero for the tenant (block swap), but the
+                    # timeline names the fork
+                    _memory.note_event("alloc", self._mem_pool,
+                                       self._block_bytes,
+                                       rid=slot.req.rid, cow=True)
         self._update_pool_gauges()
 
     def _preempt_newest(self) -> None:
@@ -1034,6 +1086,8 @@ class DecodeEngine:
         # the supervisor-respawned replica must come back with a clean
         # pool invariant (the chaos_lite pin)
         _faults.event("decode_preempt")
+        parked_before = (self.prefix.parked_blocks
+                         if self.prefix is not None else 0)
         with self._lock:
             self._slots[v] = None
             self.cache.allocator.release(slot.blocks)
@@ -1046,6 +1100,11 @@ class DecodeEngine:
             self.stats.blocks_free.set(self.cache.allocator.free_blocks)
             self._lock.notify_all()
         self._pstats.preempts.inc()
+        self._note_blocks_released(len(slot.blocks), parked_before,
+                                   "preempt", rid=req.rid)
+        if _tenant.enabled():
+            _tenant.account(req.tenant, resident_kv_bytes=-(
+                len(slot.blocks) * self._block_bytes))
 
     def _copy_block(self, src: int, dst: int) -> None:
         """Device block-copy (the COW fork): one tiny jitted callable
@@ -1085,6 +1144,8 @@ class DecodeEngine:
     def _retire(self, i: int, slot: _Slot, reason: str) -> None:
         """Free the slot + its cache blocks and finish the stream
         (eos / length / cancelled all leave through here)."""
+        parked_before = (self.prefix.parked_blocks
+                         if self.prefix is not None else 0)
         with self._lock:
             self._slots[i] = None
             self.cache.allocator.release(slot.blocks)
@@ -1095,12 +1156,15 @@ class DecodeEngine:
             self._update_pool_gauges()
             self._lock.notify_all()   # blocks freed: admit the queue head
         req = slot.req
+        self._note_blocks_released(len(slot.blocks), parked_before,
+                                   "free", rid=req.rid, reason=reason)
         if _capacity.enabled():
             self.stats.capacity_tracker().note_done(1)
         if _tenant.enabled():
             _tenant.account(
                 req.tenant,
                 cancellations=1 if reason == "cancelled" else 0,
+                resident_kv_bytes=-(len(slot.blocks) * self._block_bytes),
                 latency_ms=(time.monotonic() - req.t_enq) * 1e3)
         if req.tl is not None:
             lat = self.stats.latency()
@@ -1127,31 +1191,130 @@ class DecodeEngine:
         req.handle._finish(reason)
 
     def _release(self, req: DecodeRequest, slot_idx, error) -> None:
+        parked_before = (self.prefix.parked_blocks
+                         if self.prefix is not None else 0)
+        released = 0
         with self._lock:
             for i, s in enumerate(self._slots):
                 if s is not None and s.req is req:
                     self.cache.allocator.release(s.blocks)
+                    released += len(s.blocks)
                     self._tables[i, :] = 0
                     self._slots[i] = None
                     self.stats.leaves.inc()
             self.stats.blocks_free.set(self.cache.allocator.free_blocks)
             self.stats.active.set(sum(x is not None for x in self._slots))
             self._update_pool_gauges()
+        if released:
+            self._note_blocks_released(released, parked_before, "free",
+                                       rid=req.rid, reason="error")
+            if _tenant.enabled():
+                _tenant.account(req.tenant, resident_kv_bytes=-(
+                    released * self._block_bytes))
         req.handle._fail(error)
 
     def _fail_all(self, error) -> None:
+        parked_before = (self.prefix.parked_blocks
+                         if self.prefix is not None else 0)
+        released = 0
         with self._lock:
             slots, self._slots = (list(self._slots),
                                   [None] * self.max_slots)
             for s in slots:
                 if s is not None:
                     self.cache.allocator.release(s.blocks)
+                    released += len(s.blocks)
                     self.stats.leaves.inc()
             self._tables[:] = 0
             self._update_pool_gauges()
+        if released:
+            self._note_blocks_released(released, parked_before, "free",
+                                       reason="fail_all")
         for s in slots:
             if s is not None:
+                if _tenant.enabled():
+                    _tenant.account(s.req.tenant, resident_kv_bytes=-(
+                        len(s.blocks) * self._block_bytes))
                 s.req.handle._fail(error)
+
+    # -- memory anatomy ----------------------------------------------------
+    def _mem_pool_snapshot(self) -> dict:
+        """The MemoryLedger callback: this engine's KV pool bytes by
+        state.  Lock-light (counter reads race admission by at most one
+        block — the ledger is a snapshot, not a barrier)."""
+        alloc = self.cache.allocator
+        parked = (self.prefix.parked_blocks
+                  if self.prefix is not None else 0)
+        bb = self._block_bytes
+        resident = sum(s is not None for s in self._slots)
+        out = {"reserved": self.cache.nbytes,
+               "used": alloc.referenced_blocks * bb,
+               "parked": parked * bb,
+               "block_bytes": bb,
+               "blocks": {"size": self.cache.num_blocks,
+                          "free": alloc.free_blocks,
+                          "referenced": alloc.referenced_blocks,
+                          "parked": parked},
+               "resident_streams": resident}
+        if resident:
+            out["bytes_per_resident_stream"] = (
+                alloc.referenced_blocks * bb // resident)
+        return out
+
+    def _mem_pool_audit(self) -> int:
+        """The leak sentinel's refcount invariant: blocks neither free
+        nor referenced nor parked nor the trash block — must be 0."""
+        parked = (self.prefix.parked_blocks
+                  if self.prefix is not None else 0)
+        return self.cache.allocator.leaked(parked)
+
+    def _note_blocks_released(self, n_blocks: int, parked_before: int,
+                              kind: str, **extra) -> None:
+        """File block-release events: blocks the prefix cache kept
+        (refcount hit zero while advertised) park, the rest free."""
+        if self._mem_pool is None or n_blocks <= 0:
+            return
+        parked_now = (self.prefix.parked_blocks
+                      if self.prefix is not None else 0)
+        d = min(max(parked_now - parked_before, 0), n_blocks)
+        bb = self._block_bytes
+        if d:
+            _memory.note_event("park", self._mem_pool, d * bb)
+        if n_blocks - d:
+            _memory.note_event(kind, self._mem_pool,
+                               (n_blocks - d) * bb, **extra)
+
+    def _admit_headroom_ok(self, need_blocks: int) -> bool:
+        """Overcommit admission's measured-bytes consult: admit only
+        while the ledger's byte view of the pool agrees there is room
+        (reserved − used; parked bytes are reclaimable so they count
+        as headroom).  Attribution that disagrees with the allocator
+        would be a bug, so this is a cross-check, not a second
+        allocator — and it only exists when the ledger does."""
+        p = _memory.get(self._mem_pool)
+        if p is None:
+            return True
+        s = p.snapshot()
+        return s["reserved"] - s["used"] >= need_blocks * self._block_bytes
+
+    def _recover_oom(self, error) -> bool:
+        """OOM forensics + recovery: a RESOURCE_EXHAUSTED escaping the
+        step dispatch dumps a named post-mortem (full ledger, top
+        holders, event tail) and — when the refcounted lifecycle is on
+        and a stream is live — sheds the NEWEST stream through the
+        existing preemption path (counted), so the engine keeps
+        serving instead of failing every slot.  Returns False (caller
+        falls through to _fail_all) when unarmed or not an OOM."""
+        if self._mem_pool is None or not _memory.is_oom(error):
+            return False
+        _memory.oom_forensics(error, "decode_step")
+        if not self._refc or not any(s is not None for s in self._slots):
+            return False
+        self._preempt_newest()
+        _obs_stats.scope(f"decode.{self.name}").counter(
+            "oom_recovered", "RESOURCE_EXHAUSTED step dispatches "
+            "survived by preempting the newest stream").inc()
+        return True
 
     # -- observability -----------------------------------------------------
     def decodez(self) -> dict:
@@ -1260,5 +1423,7 @@ class DecodeEngine:
             self._closed = True
             self._lock.notify_all()
         self._thread.join(timeout=timeout)
+        if self._mem_pool is not None:
+            _memory.unregister(self._mem_pool)
         _debug_server.unregister_decodez(self.name)
         _capacity.unregister(f"decode.{self.name}")

@@ -44,6 +44,7 @@ from ..distributed import serde, transport
 from ..observability import audit as _audit
 from ..observability import canary as _canary
 from ..observability import flight as _flight
+from ..observability import memory as _memory
 from ..serving.batcher import Draining, Overloaded, RequestTooLong
 
 # one msg-type namespace across every service: transport 1-14,
@@ -319,6 +320,12 @@ class DecodeServer:
             dig = _audit.recent_digests()
             if dig is not None and model in dig:
                 out["digests"] = {model: dig[model]}
+            # memory anatomy rides the same lease (present iff
+            # FLAGS_memory_attribution and pools registered): measured
+            # KV-pool byte headroom for the ElasticController
+            mem = _memory.lease_rider()
+            if mem is not None:
+                out.update(mem)
             return out
         return data
 

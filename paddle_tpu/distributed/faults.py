@@ -37,6 +37,14 @@ kinds
                       ``corrupt:serving_reply:n=1`` corrupts the first
                       reply; the divergence sentinel / canary prober
                       must then detect AND name the replica).
+    ``oom``           device-memory hook (``oom_fault``): raise a
+                      realistic ``RESOURCE_EXHAUSTED`` out-of-memory
+                      error at a matching dispatch site
+                      (``decode_step``, ``serving_dispatch``) — the
+                      memory plane's chaos hook, so OOM forensics and
+                      the decode engine's preempt-and-recover path are
+                      drillable without real HBM pressure
+                      (``oom:decode_step:n=3`` OOMs the third step).
 
 target
     an RPC message name (``send_vars``, ``batch_barrier``, ``get_task``,
@@ -86,14 +94,15 @@ REFUSE_ACCEPT = "refuse_accept"
 DISKFULL = "diskfull"
 IO_ERR = "io_err"
 CORRUPT = "corrupt"
+OOM = "oom"
 _KINDS = (DROP_CONN, DELAY, KILL_AFTER, REFUSE_ACCEPT, DISKFULL, IO_ERR,
-          CORRUPT)
+          CORRUPT, OOM)
 # kinds the file-write hook honors (a wildcard drop_conn rule must not
 # be consumed — or fired — by a write site it can't apply to)
 _IO_KINDS = (DISKFULL, IO_ERR, DELAY, KILL_AFTER)
 # kinds only a dedicated dispatcher may consume — a wire/event hook
 # must neither fire them nor burn their budget
-_SITE_KINDS = (DISKFULL, IO_ERR, CORRUPT)
+_SITE_KINDS = (DISKFULL, IO_ERR, CORRUPT, OOM)
 
 _lock = threading.Lock()
 _runtime_rules: List["Rule"] = []
@@ -399,6 +408,42 @@ def corrupt_array(arr, bits: int = 1):
         idx = elem * itemsize + (itemsize // 2 + b // 8) % itemsize
         view[idx] ^= np.uint8(1 << (7 - (b % 8)))
     return a
+
+
+class InjectedResourceExhausted(RuntimeError):
+    """The injected OOM: stringifies exactly like an XLA
+    ``XlaRuntimeError`` out-of-memory status (``RESOURCE_EXHAUSTED:
+    Out of memory while trying to allocate N bytes``), so every
+    handler that pattern-matches the real error — the memory plane's
+    :func:`~paddle_tpu.observability.memory.is_oom`, the decode
+    engine's preempt-and-recover path — takes its production branch."""
+
+    def __init__(self, target: str, nbytes: int = 1 << 30):
+        self.target = target
+        self.nbytes = int(nbytes)
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: Out of memory while trying to "
+            f"allocate {self.nbytes} bytes (injected fault at "
+            f"{target})")
+
+
+def oom_fault(target: str) -> None:
+    """Hook at a device-dispatch site (the decode engine's step loop,
+    the serving batcher's predictor dispatch).  A matching ``oom``
+    rule RAISES :class:`InjectedResourceExhausted` exactly where a
+    real XLA allocation failure would surface, so OOM forensics and
+    recovery run against the real error path, not a mock.  Like
+    ``io_fault``, this is the ONLY dispatcher for the kind."""
+    if not active():
+        return
+    now = time.monotonic()
+    with _lock:
+        rules = list(_runtime_rules)
+    for r in rules + _flag_rules():
+        if r.kind == OOM and r.matches(target, "server", now) \
+                and r.fire():
+            _fired(r, target)
+            raise InjectedResourceExhausted(target)
 
 
 def accept_fault() -> bool:

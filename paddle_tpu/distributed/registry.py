@@ -54,6 +54,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from . import transport
 from ..observability import canary as _canary
+from ..observability import memory as _memory
 from ..observability import flight as _flight
 from ..observability import slo as _slo
 from ..observability import stats as _obs_stats
@@ -240,7 +241,9 @@ class RegistryService:
                         standby=hb.get("standby"), slo=hb.get("slo"),
                         slo_rules=hb.get("slo_rules"),
                         canary=hb.get("canary"),
-                        canary_targets=hb.get("canary_targets"))
+                        canary_targets=hb.get("canary_targets"),
+                        memory=hb.get("memory"),
+                        memory_pools=hb.get("memory_pools"))
                 return transport.OK, b"{}"
             ttl = float(body["ttl"])
             now = time.monotonic()
@@ -316,7 +319,9 @@ class RegistryService:
                     standby=hb.get("standby"), slo=hb.get("slo"),
                     slo_rules=hb.get("slo_rules"),
                     canary=hb.get("canary"),
-                    canary_targets=hb.get("canary_targets"))
+                    canary_targets=hb.get("canary_targets"),
+                    memory=hb.get("memory"),
+                    memory_pools=hb.get("memory_pools"))
             # plain primary registrations keep the PR-5 empty response
             # byte-identical; only HA registrations carry an answer
             return (transport.OK,
@@ -543,6 +548,12 @@ class Heartbeat:
         canary_dim = _canary.health_dimension()
         if canary_dim:
             hb.update(canary_dim)
+        # memory dimension (observability/memory.py): a process running
+        # the leak sentinel stamps its last refcount-audit verdict on
+        # every heartbeat; flag off adds nothing (payload byte-identical)
+        mem_dim = _memory.health_dimension()
+        if mem_dim:
+            hb.update(mem_dim)
         if self.health_fn is not None:
             try:
                 hb.update(self.health_fn() or {})

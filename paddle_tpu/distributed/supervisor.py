@@ -406,6 +406,10 @@ class Supervisor:
         # {headroom_frac, binding_phase, ...}} — empty when no replica
         # publishes it, so flags-off /fleetz is unchanged
         self._headroom: Dict[str, dict] = {}
+        # measured memory headroom harvested from the same lease DATA
+        # payloads (FLAGS_memory_attribution at the replicas): {lease
+        # key: {memory_headroom_frac, memory_bytes, ...}}
+        self._mem_headroom: Dict[str, dict] = {}
         # SLO-breach observation (heartbeat slo dimension): per-worker
         # consecutive-poll streaks, and the confirmed-breach set after
         # spec.hysteresis agreeing observations
@@ -618,6 +622,7 @@ class Supervisor:
             roles = {}
             now = time.monotonic()
             headroom = dict(self._headroom)
+            mem_headroom = dict(self._mem_headroom)
             canary_streaks = dict(self._canary_streak)
             for r, rs in self.spec.roles.items():
                 window = [t for t in self._deaths.get(r, ())
@@ -638,6 +643,19 @@ class Supervisor:
                              if k.startswith(prefix)]
                     if fracs:
                         roles[r]["headroom_frac"] = min(fracs)
+                    # measured memory next to modeled capacity: the
+                    # tightest replica's byte headroom, plus a leak
+                    # flag when any replica's refcount audit failed
+                    mfracs = [v["memory_headroom_frac"]
+                              for k, v in mem_headroom.items()
+                              if k.startswith(prefix)
+                              and "memory_headroom_frac" in v]
+                    if mfracs:
+                        roles[r]["memory_headroom_frac"] = min(mfracs)
+                    if any(v.get("memory_leak")
+                           for k, v in mem_headroom.items()
+                           if k.startswith(prefix)):
+                        roles[r]["memory_leak"] = True
                     # the worst live canary-fail streak among this
                     # role's announce keys (absent when all pass, so
                     # flags-off status is unchanged)
@@ -660,6 +678,8 @@ class Supervisor:
             out["divergence"] = divergence
         if headroom:
             out["headroom"] = headroom
+        if mem_headroom:
+            out["memory_headroom"] = mem_headroom
         root = self.spec.checkpoint_root
         if root:
             out["checkpoint"] = {
@@ -803,6 +823,7 @@ class Supervisor:
         leases = {k: v["endpoint"]
                   for k, v in (snap.get("leases") or {}).items()}
         headroom = {}
+        mem_headroom = {}
         digests = {}
         for key, data in (snap.get("data") or {}).items():
             if not isinstance(data, dict):
@@ -811,6 +832,12 @@ class Supervisor:
                 headroom[key] = {k: data[k] for k in
                                  ("headroom_frac", "binding_phase",
                                   "predicted_max_qps") if k in data}
+            if "memory_headroom_frac" in data or "memory_bytes" in data:
+                mem_headroom[key] = {k: data[k] for k in
+                                     ("memory_headroom_frac",
+                                      "memory_bytes",
+                                      "memory_parked_bytes",
+                                      "memory_leak") if k in data}
             if isinstance(data.get("digests"), dict):
                 digests[key] = data["digests"]
         # the sentinel proper: group digest riders ACROSS replicas and
@@ -819,6 +846,7 @@ class Supervisor:
         with self.lock:
             self._leases = leases
             self._headroom = headroom
+            self._mem_headroom = mem_headroom
             self._health = health
             self._observe_slo_locked(health)
             # detect (canary streak) is noted before name (divergence

@@ -30,6 +30,7 @@ from . import audit as _audit
 from . import canary as _canary
 from . import capacity as _capacity
 from . import history as _history
+from . import memory as _memory
 from . import stats as _stats
 from . import tenant as _tenant
 from . import trace as _trace
@@ -68,6 +69,11 @@ def local_snapshot_payload() -> bytes:
     aud = _audit.export_state()
     if aud is not None:
         state["audit"] = aud
+    # memory-anatomy rider (FLAGS_memory_attribution): the full ledger
+    # (pool snapshots + per-device reconciliation) rides the same pull
+    mem = _memory.export_state()
+    if mem is not None:
+        state["memory"] = mem
     return json.dumps(state).encode("utf-8")
 
 
@@ -119,6 +125,10 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
     # feed the cross-worker divergence sentinel
     canary_pw: Dict[str, dict] = {}
     audit_pw: Dict[str, dict] = {}
+    # memory ledgers stay per-worker AND roll into a fleet view
+    # (pool bytes summed, unattributed residual kept per worker — a
+    # summed residual would hide which host is leaking)
+    memory_pw: Dict[str, dict] = {}
     for worker in sorted(per_worker):
         state = per_worker[worker]
         if isinstance(state.get("history"), dict):
@@ -131,6 +141,8 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
             canary_pw[worker] = state["canary"]
         if isinstance(state.get("audit"), dict):
             audit_pw[worker] = state["audit"]
+        if isinstance(state.get("memory"), dict):
+            memory_pw[worker] = state["memory"]
         for name, m in state.get("metrics", {}).items():
             kind = m.get("kind")
             if kind == "counter":
@@ -164,6 +176,9 @@ def merge_snapshots(per_worker: Mapping[str, dict]) -> dict:
                          "fleet": _canary.merge_states(canary_pw)}
     if audit_pw:
         out["audit"] = _audit.merge_states(audit_pw)
+    if memory_pw:
+        out["memory"] = {"per_worker": memory_pw,
+                         "fleet": _memory.merge_states(memory_pw)}
     return out
 
 

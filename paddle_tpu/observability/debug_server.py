@@ -434,6 +434,21 @@ class _Handler(BaseHTTPRequestHandler):
                             else json.dumps(_tenant.tenantz(), indent=2))
                 self._reply(200, body,
                             "text/plain" if text else "application/json")
+            elif path == "/allocz":
+                # the memory-anatomy plane (observability/memory.py):
+                # per-pool HBM/host/disk attribution ledger, per-device
+                # PJRT reconciliation, allocation event ring.  JSON by
+                # default, ?text=1 for the human rendering
+                # (tools/dump_metrics.py --allocz is the operator CLI)
+                from urllib.parse import parse_qs
+                from . import memory as _memory
+                q = parse_qs(query)
+                text = q.get("text", ["0"])[0] not in ("0", "", "false")
+                body = (_memory.allocz_text() if text
+                        else json.dumps(_memory.allocz(), indent=2,
+                                        default=repr))
+                self._reply(200, body,
+                            "text/plain" if text else "application/json")
             elif path == "/canaryz":
                 # the correctness-anatomy plane (observability/
                 # canary.py + audit.py): golden-probe streak table plus
@@ -495,6 +510,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "/capacityz  (phase utilization + headroom; "
                      "?text=1)",
                      "/tenantz  (per-tenant usage metering; ?text=1)",
+                     "/allocz  (memory-attribution ledger + event ring; "
+                     "?text=1)",
                      "/canaryz  (golden canary streaks + divergence "
                      "audit; ?text=1)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),

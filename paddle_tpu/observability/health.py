@@ -45,7 +45,8 @@ def _flag(name: str, default: float) -> float:
 class _WorkerEntry:
     __slots__ = ("name", "role", "step", "last_error", "trainer_id",
                  "ttl", "last_seen", "heartbeats", "standby", "slo",
-                 "slo_rules", "canary", "canary_targets")
+                 "slo_rules", "canary", "canary_targets", "memory",
+                 "memory_pools")
 
     def __init__(self, name: str):
         self.name = name
@@ -73,6 +74,12 @@ class _WorkerEntry:
         # threshold.  None = worker runs no prober (the pre-canary wire)
         self.canary = None
         self.canary_targets = None
+        # memory dimension (observability/memory.py): "ok"/"leak" as
+        # reported by the worker's own leak sentinel (refcount audits
+        # over its registered pools), plus the leaking pool names.
+        # None = worker runs no memory attribution (the pre-memory wire)
+        self.memory = None
+        self.memory_pools = None
 
 
 class HealthTable:
@@ -120,7 +127,8 @@ class HealthTable:
                 last_error: Optional[str] = None,
                 trainer_id: Optional[int] = None,
                 standby=None, slo=None, slo_rules=None,
-                canary=None, canary_targets=None) -> None:
+                canary=None, canary_targets=None,
+                memory=None, memory_pools=None) -> None:
         """File one heartbeat (idempotent re-registration included)."""
         with self._lock:
             e = self._workers.get(name)
@@ -142,6 +150,8 @@ class HealthTable:
             e.slo_rules = slo_rules
             e.canary = canary
             e.canary_targets = canary_targets
+            e.memory = memory
+            e.memory_pools = memory_pools
             e.last_seen = time.monotonic()
             e.heartbeats += 1
 
@@ -204,6 +214,10 @@ class HealthTable:
                 ent["canary"] = e.canary
                 if e.canary_targets:
                     ent["canary_targets"] = list(e.canary_targets)
+            if e.memory is not None:
+                ent["memory"] = e.memory
+                if e.memory_pools:
+                    ent["memory_pools"] = list(e.memory_pools)
             out[e.name] = ent
         sc = _stats.scope("health")
         sc.gauge("workers_healthy").set(tallies[HEALTHY])

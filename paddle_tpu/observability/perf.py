@@ -377,7 +377,14 @@ def sample_device_memory(set_gauges: bool = True) -> dict:
 def memz() -> dict:
     # a read-only GET must not change the exported metric surface:
     # gauges only when the perf plane is opted in
-    return sample_device_memory(set_gauges=enabled())
+    out = sample_device_memory(set_gauges=enabled())
+    # memory-anatomy fold-in (FLAGS_memory_attribution): who owns the
+    # bytes the PJRT numbers report.  Lazy import + flag guard keep the
+    # flag-off page byte-identical.
+    from . import memory as _memory
+    if _memory.enabled():
+        out["attribution"] = _memory.ledger(set_gauges=False)
+    return out
 
 
 def profilez() -> dict:
@@ -411,6 +418,20 @@ def memz_text(d: Optional[dict] = None) -> str:
     lines.append(f"  host rss: {_fmt_bytes(d.get('host_rss_bytes'))}")
     if "error" in d:
         lines.append(f"  error: {d['error']}")
+    led = d.get("attribution")
+    if isinstance(led, dict):
+        lines.append("  attribution (FLAGS_memory_attribution):")
+        for name, p in sorted((led.get("pools") or {}).items()):
+            lines.append(
+                f"    {name} [{p.get('kind')}]: "
+                f"used={_fmt_bytes(p.get('used'))} "
+                f"parked={_fmt_bytes(p.get('parked'))} "
+                f"reserved={_fmt_bytes(p.get('reserved'))}")
+        for dev, rec in sorted((led.get("devices") or {}).items()):
+            lines.append(
+                f"    {dev}: in_use={_fmt_bytes(rec.get('bytes_in_use'))} "
+                f"attributed={_fmt_bytes(rec.get('attributed'))} "
+                f"unattributed={_fmt_bytes(rec.get('unattributed_bytes'))}")
     return "\n".join(lines) + "\n"
 
 

@@ -64,7 +64,7 @@ _MAX_ID_LEN = 64        # clip abusive ids (attribution, not storage)
 _LAT_RING = 128         # per-tenant recent-latency samples for p99
 
 _DIMS = ("requests", "rows", "prefill_tokens", "decode_tokens",
-         "cancellations", "device_ms")
+         "cancellations", "device_ms", "resident_kv_bytes")
 
 
 def enabled() -> bool:
@@ -111,9 +111,14 @@ class TenantMeter:
                 rows: int = 0, prefill_tokens: int = 0,
                 decode_tokens: int = 0, cancellations: int = 0,
                 device_ms: float = 0.0,
+                resident_kv_bytes: float = 0.0,
                 latency_ms: Optional[float] = None) -> None:
         """Fold one observation into the tenant's entry (admitting or
-        evicting per the space-saving discipline)."""
+        evicting per the space-saving discipline).
+        ``resident_kv_bytes`` is a signed DELTA (blocks held × block
+        bytes, + at admission / block growth, − at retire/preempt), so
+        the dimension reads as the tenant's CURRENT resident KV
+        footprint — "whose bytes", next to device_ms's "whose time"."""
         tid = self._clip(tenant)
         with self._lock:
             ent = self._table.get(tid)
@@ -138,6 +143,7 @@ class TenantMeter:
             d["decode_tokens"] += decode_tokens
             d["cancellations"] += cancellations
             d["device_ms"] += device_ms
+            d["resident_kv_bytes"] += resident_kv_bytes
             if latency_ms is not None:
                 ent.lat.append(float(latency_ms))
 
@@ -224,24 +230,29 @@ def tenantz_text(payload: Optional[dict] = None) -> str:
              f"tracked={payload.get('tracked')} "
              f"evictions={payload.get('evictions')}"]
     hdr = ("tenant", "reqs", "rows", "prefill_tok", "decode_tok",
-           "cancel", "device_ms", "p99_ms")
-    lines.append("{:<18}{:>8}{:>8}{:>12}{:>11}{:>8}{:>12}{:>9}".format(*hdr))
+           "cancel", "device_ms", "kv_bytes", "p99_ms")
+    lines.append(
+        "{:<18}{:>8}{:>8}{:>12}{:>11}{:>8}{:>12}{:>10}{:>9}".format(*hdr))
     ordered = sorted(tenants,
                      key=lambda t: -tenants[t].get("device_ms", 0.0))
     for tid in ordered:
         r = tenants[tid]
-        lines.append("{:<18}{:>8}{:>8}{:>12}{:>11}{:>8}{:>12}{:>9}".format(
-            tid[:17], r.get("requests", 0), r.get("rows", 0),
-            r.get("prefill_tokens", 0), r.get("decode_tokens", 0),
-            r.get("cancellations", 0), r.get("device_ms", 0.0),
-            r.get("p99_ms", "-")))
+        lines.append(
+            "{:<18}{:>8}{:>8}{:>12}{:>11}{:>8}{:>12}{:>10}{:>9}".format(
+                tid[:17], r.get("requests", 0), r.get("rows", 0),
+                r.get("prefill_tokens", 0), r.get("decode_tokens", 0),
+                r.get("cancellations", 0), r.get("device_ms", 0.0),
+                r.get("resident_kv_bytes", 0), r.get("p99_ms", "-")))
     other = payload.get(OTHER)
     if other:
-        lines.append("{:<18}{:>8}{:>8}{:>12}{:>11}{:>8}{:>12}{:>9}".format(
-            OTHER, other.get("requests", 0), other.get("rows", 0),
-            other.get("prefill_tokens", 0), other.get("decode_tokens", 0),
-            other.get("cancellations", 0), other.get("device_ms", 0.0),
-            "-"))
+        lines.append(
+            "{:<18}{:>8}{:>8}{:>12}{:>11}{:>8}{:>12}{:>10}{:>9}".format(
+                OTHER, other.get("requests", 0), other.get("rows", 0),
+                other.get("prefill_tokens", 0),
+                other.get("decode_tokens", 0),
+                other.get("cancellations", 0),
+                other.get("device_ms", 0.0),
+                other.get("resident_kv_bytes", 0), "-"))
     return "\n".join(lines) + "\n"
 
 

@@ -349,7 +349,7 @@ def local_trace_snapshot(limit: Optional[int] = None) -> dict:
         host = _socket.gethostname()
     except OSError:  # pragma: no cover
         host = "?"
-    return {"version": _SNAPSHOT_VERSION,
+    snap = {"version": _SNAPSHOT_VERSION,
             "pid": os.getpid(),
             "host": host,
             "role": _process_role(),
@@ -358,6 +358,16 @@ def local_trace_snapshot(limit: Optional[int] = None) -> dict:
             "total_recorded": total_spans_recorded(),
             "lanes": _profiler.lane_names(),
             "spans": spans(limit=limit)}
+    # memory-anatomy counter lanes (FLAGS_memory_attribution): per-pool
+    # resident/parked byte series rebuilt from the allocation event
+    # ring, rendered by stitch_chrome_trace as ph:"C" counter tracks.
+    # Lazy import — memory.py must stay importable without trace.py.
+    from . import memory as _memory
+    if _memory.enabled():
+        counters = _memory.counter_series()
+        if counters:
+            snap["counters"] = counters
+    return snap
 
 
 def local_snapshot_payload(limit: Optional[int] = None) -> bytes:
@@ -408,4 +418,13 @@ def stitch_chrome_trace(per_worker: Mapping[str, dict]) -> dict:
                            "tid": int(lane), "args": {"name": str(lname)}})
         for s in snap.get("spans", []):
             events.append(_span_chrome_event(s, pid))
+        # memory counter lanes: one ph:"C" track per pool, resident +
+        # parked bytes stacked, sharing the span timeline's wall-clock
+        # microsecond axis (both derive from time.time())
+        for c in snap.get("counters", []):
+            events.append({"ph": "C", "name": f"mem:{c.get('pool')}",
+                           "pid": pid, "tid": 0,
+                           "ts": c.get("ts_us", 0.0),
+                           "args": {"resident": c.get("resident", 0),
+                                    "parked": c.get("parked", 0)}})
     return {"traceEvents": events, "displayTimeUnit": "ms"}

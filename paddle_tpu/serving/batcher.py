@@ -47,6 +47,7 @@ from ..core.types import np_dtype
 from ..distributed import faults as _faults
 from ..observability import capacity as _capacity
 from ..observability import debug_server as _debug_server
+from ..observability import memory as _memory
 from ..observability import phase as _phase
 from ..observability import tenant as _tenant
 from ..observability import stats as _obs_stats
@@ -440,6 +441,14 @@ class DynamicBatcher:
         # DISPATCH ORDER while the scheduler assembles the next batch
         self._done_q: deque = deque()
         self._done_cv = threading.Condition()
+        # memory anatomy (FLAGS_memory_attribution): batch staging —
+        # queued request feeds plus batches awaiting completion — is a
+        # host-side byte holder; flag off, no pool, no series
+        self._mem_pool: Optional[str] = None
+        if _memory.enabled():
+            self._mem_pool = f"serving_staging.{name}"
+            _memory.pool(self._mem_pool, "host",
+                         self._mem_pool_snapshot)
         self._sched = threading.Thread(
             target=self._sched_loop, daemon=True,
             name=f"serving-sched-{name}")
@@ -448,6 +457,21 @@ class DynamicBatcher:
             name=f"serving-complete-{name}")
         self._sched.start()
         self._completer.start()
+
+    def _mem_pool_snapshot(self) -> dict:
+        """MemoryLedger callback: bytes staged in the request queue
+        plus batches dispatched but not yet completed (their request
+        feeds are held until the reply slices out)."""
+        with self._cv:
+            queued = sum(sum(a.nbytes for a in r.feed.values())
+                         for r in self._q)
+            q_reqs = len(self._q)
+        with self._done_cv:
+            inflight = [t[0] for t in self._done_q]
+        staged = sum(sum(a.nbytes for a in r.feed.values())
+                     for take in inflight for r in take)
+        return {"used": queued + staged, "queued_bytes": queued,
+                "inflight_bytes": staged, "queued_requests": q_reqs}
 
     # -- request side ------------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
@@ -609,8 +633,12 @@ class DynamicBatcher:
             # chaos hook: a `delay:serving_dispatch` rule sleeps HERE,
             # inside the dispatch phase — the latency-anatomy test
             # injects a known-slow phase and asserts attribution names
-            # it.  Flag-free path: one cheap active() guard
+            # it.  Flag-free path: one cheap active() guard.  An
+            # `oom:serving_dispatch` rule raises RESOURCE_EXHAUSTED
+            # where a real device allocation failure would, so the
+            # forensics path below is drillable without HBM pressure
             _faults.event("serving_dispatch")
+            _faults.oom_fault("serving_dispatch")
             with _obs_trace.start_span("serving::dispatch", cat="serving",
                                        root=False,
                                        tags={"model": self.name,
@@ -626,6 +654,11 @@ class DynamicBatcher:
                         r.tl.stamp("dispatch", t=t_disp)
             err = None
         except Exception as e:
+            # OOM forensics: a RESOURCE_EXHAUSTED escaping the dispatch
+            # dumps the full ledger + top holders + event tail BEFORE
+            # the error re-raises through the request futures (no-op
+            # unless FLAGS_memory_attribution and an actual OOM)
+            _memory.oom_forensics(e, "serving_dispatch")
             outs, err = None, e
         if cap is not None and t_disp is not None:
             # the scheduler thread's busy legs: ONE span per batch
@@ -736,6 +769,8 @@ class DynamicBatcher:
         self._sched.join(timeout=timeout)
         self._completer.join(timeout=timeout)
         _capacity.unregister(f"serving.{self.stats.model}")
+        if self._mem_pool is not None:
+            _memory.unregister(self._mem_pool)
 
     def queue_rows(self) -> int:
         with self._cv:

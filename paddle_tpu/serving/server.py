@@ -47,6 +47,7 @@ from ..distributed import serde, transport
 from ..observability import audit as _audit
 from ..observability import canary as _canary
 from ..observability import debug_server as _debug_server
+from ..observability import memory as _memory
 
 # message types: 21/22 keep the one-namespace msg-type space clear of
 # transport 1-14, master 16-20, and the observability pulls 24/25
@@ -380,6 +381,12 @@ class ModelServer:
             dig = _audit.recent_digests()
             if dig is not None and model in dig:
                 out["digests"] = {model: dig[model]}
+            # memory anatomy rides the same lease (present iff
+            # FLAGS_memory_attribution and pools registered): the
+            # ElasticController reads measured byte headroom per role
+            mem = _memory.lease_rider()
+            if mem is not None:
+                out.update(mem)
             return out
         return data
 

@@ -64,7 +64,8 @@ METRIC_KEYS = (
 # async-snapshot step-loop overhead fraction): the delta sign flips for
 # classification, the reported delta stays raw
 LOWER_BETTER_KEYS = frozenset({"ckpt_overhead_frac", "recovery_mttr_s",
-                               "decode_ttft_ms_p99", "canary_failures"})
+                               "decode_ttft_ms_p99", "canary_failures",
+                               "kv_bytes_per_token"})
 
 # lower-better keys in ABSOLUTE units (seconds, not a fraction): their
 # delta is relative when the baseline is positive — a 3 s -> 3.5 s MTTR
@@ -89,7 +90,8 @@ SECONDARY_GATE_KEYS = ("decode_ttft_ms_p99", "canary_failures",
 # recorded per config when present in either round (the evidence
 # chain keeps capacity headroom + canary probe cost round-over-round),
 # never classified, never part of the verdict
-INFORMATIONAL_KEYS = ("headroom_frac", "canary_overhead_frac")
+INFORMATIONAL_KEYS = ("headroom_frac", "canary_overhead_frac",
+                      "kv_bytes_per_token", "unattributed_bytes")
 
 DEFAULT_THRESHOLD = 0.10
 

@@ -21,6 +21,8 @@ Operator companion to ``paddle_tpu/observability/debug_server.py``
     python tools/dump_metrics.py 8085 --tenantz --text  # tenant table
     python tools/dump_metrics.py 8085 --canaryz       # canary + audit
     python tools/dump_metrics.py 8085 --canaryz --text  # streak table
+    python tools/dump_metrics.py 8085 --allocz        # memory ledger
+    python tools/dump_metrics.py 8085 --allocz --text   # pool table
 
 JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
 through (optionally filtered with ``--grep``) so the output pastes
@@ -113,10 +115,15 @@ def main(argv=None) -> int:
                     help="fetch the correctness page (/canaryz: golden "
                          "canary per-target pass/fail streaks plus the "
                          "divergence-audit digest ring)")
+    ap.add_argument("--allocz", action="store_true",
+                    help="fetch the memory-attribution page (/allocz: "
+                         "per-pool reserved/used/parked ledger, per-"
+                         "device PJRT reconciliation with the "
+                         "unattributed residual, allocation event ring)")
     ap.add_argument("--text", action="store_true",
                     help="with --memz/--profilez/--capacityz/--tenantz/"
-                         "--canaryz: the human text rendering (?text=1) "
-                         "instead of JSON")
+                         "--canaryz/--allocz: the human text rendering "
+                         "(?text=1) instead of JSON")
     ap.add_argument("port", type=int,
                     help="the worker's FLAGS_debug_server_port")
     ap.add_argument("pages", nargs="*", default=list(DEFAULT_PAGES),
@@ -127,7 +134,8 @@ def main(argv=None) -> int:
     rc = 0
     if args.tracez or args.flight or args.memz or args.profilez or \
             args.decodez or args.sloz or args.varz or \
-            args.capacityz or args.tenantz or args.canaryz:
+            args.capacityz or args.tenantz or args.canaryz or \
+            args.allocz:
         pages = []
         if args.tracez:
             pages.append("tracez?raw=1" if args.raw else "tracez")
@@ -151,6 +159,8 @@ def main(argv=None) -> int:
             pages.append("tenantz" + suffix)
         if args.canaryz:
             pages.append("canaryz" + suffix)
+        if args.allocz:
+            pages.append("allocz" + suffix)
         for page in pages:
             try:
                 body = fetch(args.host, args.port, page,

@@ -178,9 +178,12 @@ def main(argv=None) -> int:
 def _print_role_table(out: dict) -> None:
     """Per-role summary under the JSON card: liveness, SLO breaches,
     — when replicas publish capacity (FLAGS_capacity_attribution) —
-    the tightest replica's headroom next to the SLO column, and — when
+    the tightest replica's headroom next to the SLO column, — when
     the golden canary runs (FLAGS_canary_probe) — the worst live
-    canary-fail streak (`-` = all replicas passing)."""
+    canary-fail streak (`-` = all replicas passing), and — when
+    replicas publish memory (FLAGS_memory_attribution) — the tightest
+    replica's measured byte headroom (`leak!` = a refcount audit
+    failed somewhere in the role)."""
     fleets = out if all(isinstance(v, dict) and "roles" in v
                         for v in out.values()) and out else {"": out}
     for fname, status in fleets.items():
@@ -191,20 +194,28 @@ def _print_role_table(out: dict) -> None:
         print()
         title = f"fleet {status.get('fleet', fname) or fname}"
         print(f"{title}  [{status.get('state', '?')}]")
-        print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}{:>9}".format(
+        print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}{:>9}{:>9}".format(
             "role", "count", "target", "hold", "slo_breach", "headroom",
-            "canary"))
+            "canary", "mem"))
         for r in sorted(roles):
             rs = roles[r]
             n_slo = sum(1 for w in slo if str(w).startswith(f"{r}-"))
             hr = rs.get("headroom_frac")
             streak = rs.get("canary_fail_streak")
-            print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}{:>9}".format(
+            mem = rs.get("memory_headroom_frac")
+            if rs.get("memory_leak"):
+                mem_cell = "leak!"
+            elif isinstance(mem, (int, float)):
+                mem_cell = f"{mem:.1%}"
+            else:
+                mem_cell = "-"
+            print("{:<14}{:>7}{:>8}{:>8}{:>12}{:>11}{:>9}{:>9}".format(
                 r, rs.get("count", "?"), rs.get("target", "?"),
                 "yes" if rs.get("hold") else "-",
                 n_slo or "-",
                 f"{hr:.1%}" if isinstance(hr, (int, float)) else "-",
-                f"fail:{streak}" if streak else "-"))
+                f"fail:{streak}" if streak else "-",
+                mem_cell))
 
 
 if __name__ == "__main__":

@@ -62,6 +62,10 @@ MODULES = [
     # format, digest scheme and rider shapes drift loudly
     "paddle_tpu.observability.canary",
     "paddle_tpu.observability.audit",
+    # the memory-attribution plane (per-pool HBM ledger, event ring,
+    # leak sentinel, OOM forensics): frozen so the ledger/rider shapes
+    # and the /allocz payload drift loudly
+    "paddle_tpu.observability.memory",
     "golden",          # tools/golden.py (tools/ on sys.path here)
     "bench_compare",   # tools/bench_compare.py (tools/ on sys.path here)
     "runlog_report",   # tools/runlog_report.py

@@ -953,8 +953,11 @@ def _write_bench_trace(out):
         out["trace_error"] = repr(e)[:200]
 
 
-def _serving_predictor(kind, seed=1):
-    """Forward-only predictor for the serving bench (in-process)."""
+def _serving_predictor(kind, seed=1, int8=False):
+    """Forward-only predictor for the serving bench (in-process).
+    ``int8=True`` runs the fusion + quantize_int8 calibration passes
+    (the create_predictor enable_int8() pipeline) on the built
+    program before wrapping it."""
     from paddle_tpu.core.executor import Executor, Scope, scope_guard
     from paddle_tpu.inference.predictor import Predictor
 
@@ -989,9 +992,14 @@ def _serving_predictor(kind, seed=1):
     scope, exe = Scope(), Executor()
     with scope_guard(scope):
         exe.run(startup)
+        from paddle_tpu.inference import passes as P
         if nhwc:
-            from paddle_tpu.inference import passes as P
             P.convert_to_nhwc(prog, scope, keep_vars=[out.name])
+        if int8:
+            # the enable_int8() pipeline order: fusion first so the
+            # int8 epilogue absorbs bias + activation
+            P.fuse_fc_act(prog, scope, keep_vars=[out.name])
+            P.quantize_int8(prog, scope, keep_vars=[out.name])
     return Predictor(prog, feed_names, [out.name], scope)
 
 
@@ -1307,6 +1315,58 @@ def _bench_serving_inner():
     out["canary_overhead_frac"] = round(_canary.overhead_frac(), 6)
     out["canary_failures"] = (sum(
         s["failures"] for s in cp.streaks().values()) if cp else 0)
+
+    # -- int8 serving arm (fused-dequant quantized matmul) ----------------
+    # same two models through the quantize_int8 calibration pipeline:
+    # accuracy parity (argmax agreement vs the f32 predictor — the
+    # declared bar below), batched QPS, and the zero-steady-state-
+    # recompile pin.  quant_accuracy_delta gates as a secondary in
+    # tools/bench_compare.py (lower-better: a parity collapse is a
+    # regression even when QPS holds)
+    from paddle_tpu.kernels import quant as _quant
+    INT8_PARITY_BAR = 0.05
+    int8_res = {}
+    worst = 0.0
+    for kind in ("mnist", "transformer"):
+        pred_f = _serving_predictor(kind)
+        pred_q = _serving_predictor(kind, int8=True)
+        reqs = [_serving_request(kind, rng) for _ in range(64)]
+        agree, total = 0, 0
+        for feed in reqs:
+            a = np.asarray(pred_f.run(feed)[0])
+            b = np.asarray(pred_q.run(feed)[0])
+            ia = a.reshape(-1, a.shape[-1]).argmax(-1)
+            ib = b.reshape(-1, b.shape[-1]).argmax(-1)
+            agree += int((ia == ib).sum())
+            total += ia.size
+        delta = 1.0 - agree / max(total, 1)
+        worst = max(worst, delta)
+        mgr8 = ModelManager()
+        mgr8.load(f"{kind}_int8", "1", predictor=pred_q, warm=True,
+                  buckets=BUCKETS, activate=True, max_delay_ms=4.0,
+                  max_queue_rows=8192)
+        mgr8.infer(f"{kind}_int8", reqs[0], timeout=600)
+        before = _exec_counters()
+        qps8, p508, p998, err8 = _serving_load(
+            lambda feed, _k=kind: mgr8.submit(f"{_k}_int8", feed),
+            [reqs[i % 64] for i in range(256)], GEN_CLIENTS,
+            window=WINDOW)
+        after = _exec_counters()
+        rec8 = {k.split(".", 1)[1]: after[k] - before[k] for k in after}
+        mgr8.close()
+        assert all(v == 0 for v in rec8.values()), rec8
+        int8_res[kind] = {
+            "batched_qps": qps8, "p50_ms": p508, "p99_ms": p998,
+            "argmax_delta": round(delta, 4), "dropped": len(err8),
+            "recompiles_in_window": rec8,
+        }
+    # fallback counters over the whole arm: how many quantized matmuls
+    # launched vs fell back (quant.* — the /quantz payload's counters)
+    int8_res["quant_counters"] = dict(_quant._COUNTERS)
+    assert worst <= INT8_PARITY_BAR, (worst, INT8_PARITY_BAR)
+    out["int8"] = int8_res
+    out["quant_accuracy_delta"] = round(worst, 4)
+    out["quant_parity_bar"] = INT8_PARITY_BAR
     return out
 
 
@@ -1864,6 +1924,183 @@ def _bench_decode_prefix_inner():
     assert out["prefix_speedup"] >= 2.0, out["prefix_speedup"]
     assert out["ttft_speedup"] >= 2.0, out["ttft_speedup"]
     assert oc_on["occupancy"] >= 0.9, oc_on["occupancy"]
+    if jax.default_backend() != "tpu":
+        out["analysis"] = True
+    return out
+
+
+def bench_decode_kv_int8():
+    """Quantized KV residency (``FLAGS_decode_kv_dtype=int8``) vs the
+    fp32 cache at the SAME pool byte budget, under overcommit.
+
+    The int8 cache stores paged blocks as int8 codes plus a
+    per-block-per-head scale pool, cutting bytes-per-block ~4x
+    (codes are a quarter of f32; the scale rows are noise), so the same
+    HBM budget holds ~4x the blocks and overcommit admits far more
+    resident sequences before preempting.  Two legs, identical offered
+    load and identical pool BYTES (the int8 engine gets the block count
+    that budget buys):
+
+    - measured: decode tokens/s, mean resident sequences per decode
+      step over the run (live-lane counters), kv_bytes_per_token
+      (dtype-aware: engine block bytes include the scale pools), and
+      greedy divergence vs the fp32 run — the first token must match
+      (prefill attention runs on fresh f32 K/V either way) and the
+      per-stream matched-prefix fraction is reported (quantization
+      noise compounds over a greedy chain; the BOUND is the exact
+      first token + the reported tail).
+    - pinned: byte ratio <= 0.55, resident-sequence gain >= 1.8, all
+      streams complete both ways, zero steady-state recompiles, zero
+      leaked blocks.
+
+    Off-TPU this is CPU policy evidence (``analysis: true``, the
+    bench_decode precedent — the paged kernel's VMEM dequant is the
+    on-chip capture, ROADMAP item 1 'decode_kv_int8' row)."""
+    from paddle_tpu.core import flags as _flags
+
+    _flags.set_flags({"phase_attribution": True,
+                      "memory_attribution": True})
+    try:
+        return _bench_decode_kv_int8_inner()
+    finally:
+        _flags.set_flags({"phase_attribution": False,
+                          "memory_attribution": False})
+        from paddle_tpu.observability import memory as _memory
+        _memory.reset()
+
+
+def _bench_decode_kv_int8_inner():
+    import threading
+
+    import jax
+
+    from paddle_tpu.decode import (DecodeEngine, LMConfig, SamplingParams,
+                                   TransformerLM)
+    from paddle_tpu.decode.cache import PagedKVCache
+
+    impl = "xla" if jax.default_backend() != "tpu" else None
+    cfg = LMConfig(vocab=256, d_model=128, n_head=4, d_ffn=256, n_layer=2,
+                   max_seq_len=256)
+    lm = TransformerLM(cfg)
+    params = lm.init_params(seed=5)
+    BS, SLOTS, N, M, P = 16, 16, 24, 48, 16
+    FULL = (P + M + BS - 1) // BS              # 4 blocks per full stream
+    POOL_F32 = 1 + 4 * FULL                    # fp32: ~4 resident streams
+    BUCKETS = (16, 32, 64)
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, cfg.vocab, P).astype("int32")
+               for _ in range(N)]
+
+    def run(dtype, num_blocks):
+        eng = DecodeEngine(lm, params, name=f"bkv_{dtype}",
+                           max_slots=SLOTS, block_tokens=BS,
+                           num_blocks=num_blocks,
+                           prefill_buckets=BUCKETS, max_queue=N + 4,
+                           attn_impl=impl, prefix_cache=False,
+                           overcommit=True, cache_dtype=dtype)
+        # warm every prefill bucket (preemption re-prefill lengths
+        # P..P+M-1 snap onto the same ladder) plus the decode step
+        for b in BUCKETS:
+            eng.generate(np.full(b - 2, 1, np.int32), max_new_tokens=2)
+        lat = eng.stats.lat
+        before = _exec_counters()
+        live0 = lat.live_slot_steps.value
+        steps0 = eng.stats.steps.value
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, SamplingParams(max_new_tokens=M))
+                   for p in prompts]
+        results = [h.result(timeout=600) for h in handles]
+        wall = time.perf_counter() - t0
+        after = _exec_counters()
+        steps = eng.stats.steps.value - steps0
+        out = {
+            "tps": sum(r["n_tokens"] for r in results) / wall,
+            "tokens": [r["tokens"] for r in results],
+            "completed": sum(1 for r in results
+                             if r["finish"] == "length"),
+            # mean live slots per decode step: the residency the pool
+            # byte budget actually sustained over the run
+            "resident_mean": ((lat.live_slot_steps.value - live0)
+                              / max(steps, 1)),
+            "kv_bytes_per_token": round(eng._block_bytes / BS, 3),
+            "pool_bytes": eng.cache.nbytes,
+            "num_blocks": eng.cache.num_blocks,
+            "preempts": eng._pstats.preempts.value,
+            "leaked": eng.cache.allocator.leaked(),
+            "recompiles": {k.split(".", 1)[1]: after[k] - before[k]
+                           for k in after},
+        }
+        eng.close()
+        return out
+
+    f32 = run("float32", POOL_F32)
+    # same byte budget: how many int8 blocks (codes + scale rows) the
+    # fp32 pool's bytes buy
+    probe = PagedKVCache(cfg.n_layer, cfg.n_head, cfg.head_dim, 2, BS,
+                         dtype="int8")
+    POOL_I8 = max(int(f32["pool_bytes"] // (probe.nbytes // 2)), 2)
+    q = run("int8", POOL_I8)
+
+    assert q["pool_bytes"] <= f32["pool_bytes"], (q["pool_bytes"],
+                                                  f32["pool_bytes"])
+    assert f32["completed"] == N and q["completed"] == N
+    assert f32["leaked"] == 0 and q["leaked"] == 0
+    for leg in (f32, q):
+        assert all(v == 0 for v in leg["recompiles"].values()), \
+            leg["recompiles"]
+    byte_ratio = q["kv_bytes_per_token"] / f32["kv_bytes_per_token"]
+    resident_gain = q["resident_mean"] / max(f32["resident_mean"], 1e-9)
+    # greedy divergence vs the fp32 run (the uninterrupted truth:
+    # preemption resume is token-exact).  The first token samples
+    # inside prefill on fresh f32 K/V, so it is exact by construction;
+    # later tokens read the quantized cache and may drift
+    matched = []
+    first_mismatch = 0
+    for a, b in zip(q["tokens"], f32["tokens"]):
+        if a[:1] != b[:1]:
+            first_mismatch += 1
+        m = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            m += 1
+        matched.append(m / max(len(b), 1))
+    assert first_mismatch == 0, \
+        f"{first_mismatch} streams diverged at the (exact) first token"
+
+    out = {
+        "note": "CPU in-process: isolates the quantized-cache residency "
+                "policy; on-chip capture pending tunnel (ROADMAP item 1 "
+                "'decode_kv_int8' row)",
+        "model": cfg.to_dict(),
+        "requests": N, "prompt_tokens": P, "max_new": M,
+        "slots": SLOTS, "block_tokens": BS,
+        "pool_bytes": f32["pool_bytes"],
+        "blocks_fp32": f32["num_blocks"],
+        "blocks_int8": q["num_blocks"],
+        # headline
+        "decode_tokens_per_sec": round(q["tps"], 1),
+        "fp32_tokens_per_sec": round(f32["tps"], 1),
+        # lower-better + informational in bench_compare
+        "kv_bytes_per_token": q["kv_bytes_per_token"],
+        "kv_bytes_per_token_fp32": f32["kv_bytes_per_token"],
+        "kv_byte_ratio": round(byte_ratio, 4),
+        "resident_mean_int8": round(q["resident_mean"], 2),
+        "resident_mean_fp32": round(f32["resident_mean"], 2),
+        "resident_gain": round(resident_gain, 2),
+        "preempts_fp32": f32["preempts"],
+        "preempts_int8": q["preempts"],
+        "greedy_divergence": {
+            "first_token_mismatches": first_mismatch,
+            "matched_prefix_frac_mean": round(
+                sum(matched) / max(len(matched), 1), 4),
+            "matched_prefix_frac_min": round(min(matched), 4),
+            "fully_matched_streams": sum(1 for m in matched if m >= 1.0),
+        },
+        "recompiles_in_window": q["recompiles"],
+    }
+    assert byte_ratio <= 0.55, byte_ratio
+    assert resident_gain >= 1.8, resident_gain
     if jax.default_backend() != "tpu":
         out["analysis"] = True
     return out
@@ -2550,6 +2787,9 @@ CONFIG_TABLE = [
     # refcounted block lifecycle: shared-prefix dedup + overcommit
     # preemption legs (CPU policy evidence off-TPU, like decode)
     ("decode_prefix", bench_decode_prefix, 420, False),
+    # quantized KV cache residency: int8 blocks + scale pools vs fp32
+    # at the same pool bytes (CPU policy evidence off-TPU, like decode)
+    ("decode_kv_int8", bench_decode_kv_int8, 420, False),
     ("pipeline", bench_pipeline, 900, False),
     ("compile_cache", bench_compile_cache, 600, False),
     ("checkpoint", bench_checkpoint, 600, False),

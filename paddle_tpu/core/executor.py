@@ -1245,14 +1245,20 @@ class Executor:
         value can lie in both directions: flipped since the trace, or
         on for a program with no sparse lookups); anything whose
         lowering emitted no fused kernels re-raises untouched."""
+        from ..kernels import quant as _quant_kernels
         from ..kernels import sparse as _sparse_kernels
         cell = entry.fused_used
-        if entry.fused_disabled or not (cell and cell.get("sparse_fused")):
+        if entry.fused_disabled or not (
+                cell and (cell.get("sparse_fused")
+                          or cell.get("int8_fused"))):
             raise exc
         if any(isinstance(v, jax.Array) and v.is_deleted()
                for v in donated_state):
             raise exc
-        _sparse_kernels.count_runtime_disable()
+        if cell.get("sparse_fused"):
+            _sparse_kernels.count_runtime_disable()
+        if cell.get("int8_fused"):
+            _quant_kernels.count_runtime_disable()
         mk = self._entry_builder(entry, program, build_fn)
         jitted = jax.jit(mk(disable_sparse_fused=True), donate_argnums=(1,))
         entry.jitted = jitted

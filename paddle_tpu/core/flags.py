@@ -334,6 +334,39 @@ define_flag("decode_overcommit", False,
             "uninterrupted run (counter-hash sampling is positional). "
             "Latched when a DecodeEngine is built; off (default): "
             "full reservation at admission, byte-identical")
+define_flag("decode_kv_dtype", "float32",
+            "storage dtype of the paged decode KV cache "
+            "(paddle_tpu/decode/cache.py PagedKVCache): 'int8' stores "
+            "key/value blocks quantized to int8 with per-block-per-head "
+            "abs-max scales in a parallel f32 scale pool, quartering the "
+            "KV bytes per token (~0.53x incl. scales) so overcommit "
+            "admission fits ~2x the resident sequences per HBM byte; the "
+            "paged decode-attention kernel dequantizes blocks in VMEM "
+            "(counted XLA dequantize-gather fallback on any build "
+            "fault).  Prefix-cache hashing, COW forking, preemption/"
+            "re-prefill and the block-pool accounting move block IDS "
+            "only, so they operate on quantized blocks unchanged — the "
+            "scale pool rides the same block axis (COW copies the scale "
+            "row with the block).  Latched when a DecodeEngine is "
+            "built; 'float32' (default) keeps the cache layout, state "
+            "threading and metric surface byte-identical")
+define_flag("int8_inference", False,
+            "serving-plane kill-switch default for int8 inference: when "
+            "on, create_predictor appends the 'quantize_int8' "
+            "calibration pass (inference/passes.py) to every "
+            "AnalysisConfig as if enable_int8() had been called — "
+            "per-out-channel weight scales derived from QAT fake-quant "
+            "stats when present (else post-training abs-max over the "
+            "weight scope), activations quantized dynamically (or with "
+            "the QAT moving-average scale), and calibrated mul/fused_fc "
+            "ops lowered through the fused-dequant int8 Pallas matmul "
+            "(kernels/quant.py; int8xint8->int32 accumulation, dequant+"
+            "bias+activation epilogue).  Non-TPU backends run the "
+            "kernel in interpret mode; odd shapes or build faults take "
+            "the counted XLA dequantized path (quant.* counters — a "
+            "fault can never fail a dispatch).  Off (default): only "
+            "configs that explicitly call enable_int8() quantize; "
+            "programs without the pass lower byte-identically")
 define_flag("phase_attribution", False,
             "per-request latency-phase attribution for the serving and "
             "decode planes (observability/phase.py): each request "

@@ -128,11 +128,24 @@ def lower_ops(ctx: LowerContext, program: Program, block: Block, env: Dict) -> D
     fusion = (_sparse_kernels.plan_lookup_fusion(block)
               if _sparse_kernels.enabled_for(ctx) else None)
 
+    # int8 inference peephole: mul/fused_fc ops the quantize_int8
+    # calibration pass stamped (quant_int8 attr + WInt8/WScale sidecar
+    # inputs) lower through the fused-dequant int8 Pallas matmul
+    # (kernels/quant.py).  Activation is attr-driven — an uncalibrated
+    # program builds no plan and lowers byte-identically.
+    from ..kernels import quant as _quant_kernels
+    int8_plan = (_quant_kernels.plan_int8(block)
+                 if _quant_kernels.enabled_for(ctx) else None)
+
     for pos, op in enumerate(block.ops):
         if op.type in SKIP_OPS:
             continue
         if fusion is not None and fusion.covers(pos) and fusion.lower(pos, env):
             ctx.sparse_fused_used = True
+            continue
+        if int8_plan is not None and int8_plan.covers(pos) \
+                and int8_plan.lower(pos, env):
+            ctx.int8_fused_used = True
             continue
         if op.type in CONTROL_FLOW_OPS:
             try:
@@ -179,17 +192,19 @@ def build_block_fn(program: Program, plan: BlockPlan, training: bool = True,
     """Return fn(feed_vals, donated_state, const_state, rng) ->
     (fetch_vals, new_persist_vals, rng_out).
 
-    ``disable_sparse_fused``: lower WITHOUT the FLAGS_sparse_fused_kernel
-    Pallas paths even when the flag is on — the executor's dispatch-fault
-    recovery re-lowers a step this way when its compile died with the
-    fused kernels in it (kernels/sparse.py counted-fallback contract)."""
+    ``disable_sparse_fused``: lower WITHOUT the fused Pallas paths (the
+    sparse-embedding kernels AND the int8 inference peephole) even when
+    enabled — the executor's dispatch-fault recovery re-lowers a step
+    this way when its compile died with fused kernels in it
+    (kernels/sparse.py / kernels/quant.py counted-fallback contract)."""
     block = program.blocks[plan.block_idx]
     donated, const = plan.donated_reads, plan.const_reads
-    # trace-time latch: did THIS lowering actually emit fused sparse
-    # kernels?  The executor's dispatch-fault recovery gates on it (the
-    # flag alone lies in both directions: it may have changed since the
-    # entry traced, and a flag-on program may contain no sparse lookups)
-    used = {"sparse_fused": False}
+    # trace-time latch: did THIS lowering actually emit fused sparse /
+    # int8 kernels?  The executor's dispatch-fault recovery gates on it
+    # (the flag alone lies in both directions: it may have changed since
+    # the entry traced, and a flag-on program may contain no sparse
+    # lookups)
+    used = {"sparse_fused": False, "int8_fused": False}
 
     def fn(feed_vals, donated_state, const_state, rng):
         # host-side timing of the op-by-op jax trace: runs once per XLA
@@ -203,6 +218,7 @@ def build_block_fn(program: Program, plan: BlockPlan, training: bool = True,
         ctx = LowerContext(block=block, mesh=mesh, lower_block_fn=lower_sub,
                            training=training)
         ctx.disable_sparse_fused = disable_sparse_fused
+        ctx.disable_int8_fused = disable_sparse_fused
         ctx.set_rng(rng)
         env: Dict = {}
         env.update(zip(plan.feed_names, feed_vals))
@@ -211,6 +227,8 @@ def build_block_fn(program: Program, plan: BlockPlan, training: bool = True,
         lower_ops(ctx, program, block, env)
         if getattr(ctx, "sparse_fused_used", False):
             used["sparse_fused"] = True
+        if getattr(ctx, "int8_fused_used", False):
+            used["int8_fused"] = True
         fetches = [env[n] for n in plan.fetch_names]
         new_state = [env[n] for n in plan.persist_writes]
         if t0 is not None:

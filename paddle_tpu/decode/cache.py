@@ -279,7 +279,19 @@ class PrefixCache:
 class PagedKVCache:
     """The device arrays (module doc).  ``state()`` hands the [k, v]
     list to ``Executor.run_callable``; ``update()`` swaps in the
-    returned (donated-in-place) handles."""
+    returned (donated-in-place) handles.
+
+    ``dtype="int8"`` (``FLAGS_decode_kv_dtype``) stores blocks
+    quantized: k/v pools become int8 and two parallel f32 scale pools
+    ``[num_layers, num_blocks, n_head]`` carry one abs-max scale per
+    (block, head) — the qdq convention of ``kernels/quant.py``
+    (``x ~= q * s / 127``).  ``state()`` then threads
+    ``[k, v, k_scale, v_scale]`` so every dispatch moves the scale
+    rows with the blocks (COW block copies copy the scale row through
+    the same dim-1 block axis).  Everything host-side — the allocator,
+    prefix cache, block tables — moves block IDS only and is unchanged.
+    The f32 default keeps ``state()``, ``nbytes`` and the block layout
+    byte-identical to the unquantized build."""
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_blocks: int, block_tokens: Optional[int] = None,
@@ -294,29 +306,59 @@ class PagedKVCache:
         if self.block_tokens < 1:
             raise ValueError(f"block_tokens must be >= 1, got "
                              f"{self.block_tokens}")
+        self.dtype = str(dtype)
+        self.quantized = self.dtype == "int8"
         shape = (self.num_layers, self.num_blocks, self.block_tokens,
                  self.num_heads, self.head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        if self.quantized:
+            self.k = jnp.zeros(shape, jnp.int8)
+            self.v = jnp.zeros(shape, jnp.int8)
+            sshape = (self.num_layers, self.num_blocks, self.num_heads)
+            self.k_scale = jnp.zeros(sshape, jnp.float32)
+            self.v_scale = jnp.zeros(sshape, jnp.float32)
+        else:
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
+            self.k_scale = None
+            self.v_scale = None
         self.allocator = BlockAllocator(self.num_blocks)
 
     @property
     def nbytes(self) -> int:
-        return int(self.k.size) * self.k.dtype.itemsize * 2
+        """ACTUAL pool bytes: dtype-aware block storage plus the scale
+        pools when quantized — what the MemoryLedger pool and the
+        per-tenant resident_kv_bytes attribute (a quantized cache must
+        not report fp32-sized blocks)."""
+        n = int(self.k.size) * self.k.dtype.itemsize * 2
+        if self.k_scale is not None:
+            n += int(self.k_scale.size) * self.k_scale.dtype.itemsize * 2
+        return n
 
     def state(self) -> list:
+        if self.quantized:
+            return [self.k, self.v, self.k_scale, self.v_scale]
         return [self.k, self.v]
 
     def update(self, new_state: list) -> None:
-        self.k, self.v = new_state
+        if self.quantized:
+            self.k, self.v, self.k_scale, self.v_scale = new_state
+        else:
+            self.k, self.v = new_state
 
     def max_context(self, max_blocks_per_seq: int) -> int:
         return max_blocks_per_seq * self.block_tokens
 
     def snapshot(self) -> dict:
-        return {
+        snap = {
             "num_blocks": self.num_blocks,
             "block_tokens": self.block_tokens,
             "free_blocks": self.allocator.free_blocks,
             "bytes": self.nbytes,
         }
+        if self.quantized:
+            # new keys only under the flag: the f32 snapshot surface
+            # stays byte-identical
+            snap["dtype"] = self.dtype
+            snap["scale_bytes"] = int(
+                self.k_scale.size) * self.k_scale.dtype.itemsize * 2
+        return snap

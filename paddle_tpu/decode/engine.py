@@ -81,6 +81,7 @@ from .model import TransformerLM
 from ..core import flags as _flags
 from ..core.executor import Executor
 from ..distributed import faults as _faults
+from ..kernels import quant as _quant_kernels
 from ..observability import audit as _audit
 from ..observability import capacity as _capacity
 from ..observability import debug_server as _debug_server
@@ -431,7 +432,7 @@ class DecodeEngine:
                  executor: Optional[Executor] = None,
                  capture_logits: bool = False,
                  attn_impl: Optional[str] = None,
-                 cache_dtype: str = "float32",
+                 cache_dtype: Optional[str] = None,
                  prefix_cache: Optional[bool] = None,
                  overcommit: Optional[bool] = None):
         self.model = model
@@ -448,6 +449,11 @@ class DecodeEngine:
         self.max_blocks_per_seq = blocks_for(cfg.max_seq_len, bs)
         if num_blocks is None:
             num_blocks = 1 + self.max_slots * self.max_blocks_per_seq
+        # KV storage dtype latches at engine build (the compiled state
+        # shape): ctor arg wins, else FLAGS_decode_kv_dtype; the
+        # "float32" default keeps the flags-off pool byte-identical
+        if cache_dtype is None:
+            cache_dtype = str(_flags.get_flags("decode_kv_dtype"))
         self.cache = PagedKVCache(cfg.n_layer, cfg.n_head, cfg.head_dim,
                                   num_blocks, bs, dtype=cache_dtype)
         ladder = (prefill_buckets if prefill_buckets is not None
@@ -507,6 +513,16 @@ class DecodeEngine:
         # so every event-filing site is one attribute check
         self._block_bytes = self.cache.nbytes // max(self.cache.num_blocks,
                                                      1)
+        if self.cache.quantized:
+            # /quantz: advertise the quantized pool (dtype-aware bytes
+            # per block INCLUDING the parallel scale pools)
+            _quant_kernels.note_kv_cache(name, {
+                "dtype": self.cache.dtype,
+                "num_blocks": self.cache.num_blocks,
+                "block_tokens": bs,
+                "bytes_per_block": self._block_bytes,
+                "pool_bytes": self.cache.nbytes,
+            })
         self._mem_pool: Optional[str] = None
         if _memory.enabled():
             self._mem_pool = f"decode_kv.{name}"
@@ -744,10 +760,15 @@ class DecodeEngine:
         bucket = self.prefill_ladder.snap(P)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :P] = req.prompt
-        model = self.model
+        model, quantized = self.model, self.cache.quantized
 
         def build():
             def fn(feed, state, const):
+                if quantized:
+                    kc, vc, ks, vs, tok, logits = model.prefill(
+                        const, state[0], state[1], *feed,
+                        ks=state[2], vs=state[3])
+                    return [tok, logits], [kc, vc, ks, vs]
                 kc, vc, tok, logits = model.prefill(
                     const, state[0], state[1], *feed)
                 return [tok, logits], [kc, vc]
@@ -809,7 +830,7 @@ class DecodeEngine:
         seq = slot.seq if slot.seq is not None else req.prompt
         L = int(seq.size)
         start = slot.cached_tokens
-        model = self.model
+        model, quantized = self.model, self.cache.quantized
         if start > 0:
             n = L - start
             bucket = self._resume_ladder.snap(n)
@@ -818,6 +839,12 @@ class DecodeEngine:
 
             def build():
                 def fn(feed, state, const):
+                    if quantized:
+                        kc, vc, ks, vs, tok, logits = \
+                            model.prefill_suffix(
+                                const, state[0], state[1], *feed,
+                                ks=state[2], vs=state[3])
+                        return [tok, logits], [kc, vc, ks, vs]
                     kc, vc, tok, logits = model.prefill_suffix(
                         const, state[0], state[1], *feed)
                     return [tok, logits], [kc, vc]
@@ -839,6 +866,11 @@ class DecodeEngine:
 
             def build():
                 def fn(feed, state, const):
+                    if quantized:
+                        kc, vc, ks, vs, tok, logits = model.prefill(
+                            const, state[0], state[1], *feed,
+                            ks=state[2], vs=state[3])
+                        return [tok, logits], [kc, vc, ks, vs]
                     kc, vc, tok, logits = model.prefill(
                         const, state[0], state[1], *feed)
                     return [tok, logits], [kc, vc]
@@ -944,9 +976,15 @@ class DecodeEngine:
         if not live:
             return
         model, impl = self.model, self._attn_impl
+        quantized = self.cache.quantized
 
         def build():
             def fn(feed, state, const):
+                if quantized:
+                    kc, vc, ks, vs, toks, logits = model.decode_step(
+                        const, state[0], state[1], *feed,
+                        attn_impl=impl, ks=state[2], vs=state[3])
+                    return [toks, logits], [kc, vc, ks, vs]
                 kc, vc, toks, logits = model.decode_step(
                     const, state[0], state[1], *feed, attn_impl=impl)
                 return [toks, logits], [kc, vc]
@@ -1108,14 +1146,14 @@ class DecodeEngine:
 
     def _copy_block(self, src: int, dst: int) -> None:
         """Device block-copy (the COW fork): one tiny jitted callable
-        on the donated cache state — K/V never round-trip to host."""
+        on the donated cache state — K/V never round-trip to host.
+        Every cache pool (codes AND, when quantized, the per-block
+        scale pools) keeps its block axis at dim 1, so one generic
+        loop forks them all — a forked block carries its scales."""
         def build():
             def fn(feed, state, const):
                 s, d = feed
-                k, v = state
-                k = k.at[:, d].set(k[:, s])
-                v = v.at[:, d].set(v[:, s])
-                return [], [k, v]
+                return [], [a.at[:, d].set(a[:, s]) for a in state]
             return fn
 
         _, new_state = self._exe.run_callable(

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..kernels.attention import decode_attention, paged_attention_xla
+from ..kernels.quant import (QMAX, SCALE_EPS, kv_dequantize, kv_head_amax,
+                             kv_quantize)
 
 _LN_EPS = 1e-5
 # static top-k ceiling compiled into the sampling epilogue: per-slot k
@@ -201,26 +203,97 @@ class TransformerLM:
         """rows [N, H, Dh] into cache[layer] at (block, offset) pairs."""
         return cache.at[layer, blocks, offsets].set(rows)
 
+    @staticmethod
+    def _scatter_kv_q(cache, scales, layer, blocks, offsets, rows, slot,
+                      valid, block_table):
+        """Quantized bulk scatter (prefill / suffix prefill): rows
+        [T, H, Dh] land as int8 codes with one fresh abs-max scale per
+        (destination block, head).
+
+        ``slot`` [T] is each row's index into ``block_table`` (clamped
+        for pad lanes), ``valid`` [T] masks real prompt lanes.  The
+        per-block scale is the max over the VALID rows bound for that
+        table slot; untouched slots (the already-resident prefix of a
+        suffix prefill, and pad slots) keep their existing scale —
+        prefill only ever writes FRESH blocks (suffix starts are
+        block-aligned: prefix-cache hits and preemption resume both
+        hand back whole blocks), so no stored code needs rescaling
+        here."""
+        T = rows.shape[0]
+        MB = block_table.shape[0]
+        ha = kv_head_amax(rows) * valid[:, None].astype(jnp.float32)
+        onehot = jnp.logical_and(
+            slot[:, None] == jnp.arange(MB, dtype=jnp.int32)[None, :],
+            valid[:, None])                              # [T, MB]
+        blk_amax = jnp.max(
+            jnp.where(onehot[:, :, None], ha[:, None, :], 0.0),
+            axis=0)                                      # [MB, H]
+        touched = jnp.any(onehot, axis=0)                # [MB]
+        old = scales[layer][block_table]                 # [MB, H]
+        new = jnp.where(touched[:, None],
+                        jnp.maximum(blk_amax, SCALE_EPS), old)
+        scales = scales.at[layer, block_table].set(new)
+        q = kv_quantize(rows, new[slot])                 # [T, H, Dh] int8
+        cache = cache.at[layer, blocks, offsets].set(q)
+        return cache, scales
+
+    @staticmethod
+    def _append_kv_q(cache, scales, layer, blocks, offsets, rows):
+        """Quantized single-row append (decode step): rows [S, H, Dh],
+        one per slot, each into its OWN block (writable blocks are
+        refcount-1 exclusive; shared blocks were COW-forked by the
+        engine before this dispatch — inactive slots all target trash
+        block 0, whose content and scale are never read unmasked).
+
+        When a new row grows a (block, head)'s abs-max the block's
+        stored codes requantize to the new scale in VMEM-register math
+        (``round(q * old/new)`` — at most half a code of drift per
+        growth, and the scale only ever grows over a block's
+        residency, so drift is bounded by the growth count, not the
+        token count)."""
+        S = rows.shape[0]
+        ha = kv_head_amax(rows)                          # [S, H]
+        old = scales[layer, blocks]                      # [S, H]
+        new = jnp.maximum(old, ha)                       # [S, H]
+        blk = cache[layer, blocks]                       # [S, bs, H, Dh]
+        ratio = jnp.where(new > 0.0,
+                          old / jnp.maximum(new, SCALE_EPS), 1.0)
+        blk = jnp.clip(jnp.round(blk.astype(jnp.float32)
+                                 * ratio[:, None, :, None]),
+                       -QMAX, QMAX).astype(jnp.int8)
+        q = kv_quantize(rows, new)                       # [S, H, Dh]
+        blk = blk.at[jnp.arange(S), offsets].set(q)
+        cache = cache.at[layer, blocks].set(blk)
+        scales = scales.at[layer, blocks].set(new)
+        return cache, scales
+
     # -- prefill -----------------------------------------------------------
     def prefill(self, plist, kc, vc, tokens, length, block_table,
-                seed, temperature, top_k):
+                seed, temperature, top_k, ks=None, vs=None):
         """tokens [1, Tb] (bucket-padded), length [] int32, block_table
-        [MB] int32 → (kc', vc', next_token [] int32, logits [V]).
+        [MB] int32 → (kc', vc', next_token [] int32, logits [V]) — or,
+        with the int8 scale pools ``ks``/``vs`` threaded (quantized
+        cache), (kc', vc', ks', vs', next_token, logits).
 
         One full causal forward over the padded prompt; every real
         position's K/V lands in the request's blocks, pad positions
         land in trash block 0 (their attention contribution is masked
-        by ``length`` either way).  The FIRST generated token samples
-        here, so a joining request streams its first token without
-        waiting for a decode step."""
+        by ``length`` either way).  Prefill attention always runs on
+        the fresh f32 K/V computed THIS dispatch — quantization only
+        affects what the cache stores, so the first token is exact
+        either way.  The FIRST generated token samples here, so a
+        joining request streams its first token without waiting for a
+        decode step."""
         cfg = self.config
         p = self._unpack(plist)
         Tb = tokens.shape[1]
         bs = kc.shape[2]
+        MB = block_table.shape[0]
         sc = float(1.0 / np.sqrt(cfg.head_dim))
         pos_idx = jnp.arange(Tb, dtype=jnp.int32)
         valid = pos_idx < length
-        blocks = jnp.where(valid, block_table[pos_idx // bs], 0)
+        slot = jnp.minimum(pos_idx // bs, MB - 1)
+        blocks = jnp.where(valid, block_table[slot], 0)
         offsets = pos_idx % bs
         qi = jnp.arange(Tb)
         mask = jnp.logical_and(qi[:, None] >= qi[None, :],
@@ -228,8 +301,16 @@ class TransformerLM:
         h = p["emb"][tokens] * (cfg.d_model ** 0.5) + self._pos[:Tb]
         for i in range(cfg.n_layer):
             q, k, v = self._qkv(p, i, h)          # [1, Tb, H, Dh]
-            kc = self._scatter_kv(kc, i, blocks, offsets, k[0])
-            vc = self._scatter_kv(vc, i, blocks, offsets, v[0])
+            if ks is None:
+                kc = self._scatter_kv(kc, i, blocks, offsets, k[0])
+                vc = self._scatter_kv(vc, i, blocks, offsets, v[0])
+            else:
+                kc, ks = self._scatter_kv_q(kc, ks, i, blocks, offsets,
+                                            k[0], slot, valid,
+                                            block_table)
+                vc, vs = self._scatter_kv_q(vc, vs, i, blocks, offsets,
+                                            v[0], slot, valid,
+                                            block_table)
             s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                            k.astype(jnp.float32)) * sc
             s = jnp.where(mask[:, None], s, -1e30)
@@ -242,17 +323,23 @@ class TransformerLM:
         tok = _sample(logits[None], seed[None],
                       jnp.zeros((1,), jnp.int32), temperature[None],
                       top_k[None])[0]
-        return kc, vc, tok, logits
+        if ks is None:
+            return kc, vc, tok, logits
+        return kc, vc, ks, vs, tok, logits
 
     # -- suffix prefill (prefix-cache hits / preemption resume) ------------
     def prefill_suffix(self, plist, kc, vc, tokens, start, length,
-                       block_table, seed, temperature, top_k):
+                       block_table, seed, temperature, top_k,
+                       ks=None, vs=None):
         """tokens [1, Sb] (bucket-padded suffix), start [] int32 (how
         many leading positions are already resident in the cache —
         block-aligned prefix-cache hits), length [] int32 (total real
         sequence length; the suffix is positions start..length-1),
         block_table [MB] int32 → (kc', vc', next_token [] int32,
-        logits [V]).
+        logits [V]); with the int8 scale pools ``ks``/``vs`` threaded,
+        (kc', vc', ks', vs', next_token, logits) and the gathered
+        context (cached prefix INCLUDED) is dequantized per block
+        before the dense masked attention.
 
         The prompt's cached prefix is NOT recomputed: suffix K/V is
         scattered into the request's blocks first, then — because
@@ -281,7 +368,8 @@ class TransformerLM:
         pos = start + lane
         safe_pos = jnp.minimum(jnp.where(valid, pos, 0),
                                cfg.max_seq_len - 1)
-        blocks = jnp.where(valid, block_table[safe_pos // bs], 0)
+        slot = jnp.minimum(safe_pos // bs, MB - 1)
+        blocks = jnp.where(valid, block_table[slot], 0)
         offsets = safe_pos % bs
         tpos = jnp.arange(MB * bs, dtype=jnp.int32)
         mask = tpos[None, :] <= safe_pos[:, None]   # [Sb, MB*bs]
@@ -289,12 +377,26 @@ class TransformerLM:
              + self._pos[safe_pos])
         for i in range(cfg.n_layer):
             q, k, v = self._qkv(p, i, h)          # [Sb, H, Dh]
-            kc = self._scatter_kv(kc, i, blocks, offsets, k)
-            vc = self._scatter_kv(vc, i, blocks, offsets, v)
-            ck = kc[i][block_table].reshape(MB * bs, cfg.n_head,
-                                            cfg.head_dim)
-            cv = vc[i][block_table].reshape(MB * bs, cfg.n_head,
-                                            cfg.head_dim)
+            if ks is None:
+                kc = self._scatter_kv(kc, i, blocks, offsets, k)
+                vc = self._scatter_kv(vc, i, blocks, offsets, v)
+                ck = kc[i][block_table].reshape(MB * bs, cfg.n_head,
+                                                cfg.head_dim)
+                cv = vc[i][block_table].reshape(MB * bs, cfg.n_head,
+                                                cfg.head_dim)
+            else:
+                kc, ks = self._scatter_kv_q(kc, ks, i, blocks, offsets,
+                                            k, slot, valid, block_table)
+                vc, vs = self._scatter_kv_q(vc, vs, i, blocks, offsets,
+                                            v, slot, valid, block_table)
+                ck = kv_dequantize(
+                    kc[i][block_table],
+                    ks[i][block_table][:, None, :]).reshape(
+                        MB * bs, cfg.n_head, cfg.head_dim)
+                cv = kv_dequantize(
+                    vc[i][block_table],
+                    vs[i][block_table][:, None, :]).reshape(
+                        MB * bs, cfg.n_head, cfg.head_dim)
             s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
                            ck.astype(jnp.float32)) * sc
             s = jnp.where(mask[None], s, -1e30)
@@ -306,16 +408,21 @@ class TransformerLM:
         tok = _sample(logits[None], seed[None],
                       jnp.zeros((1,), jnp.int32), temperature[None],
                       top_k[None])[0]
-        return kc, vc, tok, logits
+        if ks is None:
+            return kc, vc, tok, logits
+        return kc, vc, ks, vs, tok, logits
 
     # -- decode step (the continuous-batching hot dispatch) ----------------
     def decode_step(self, plist, kc, vc, tokens, positions, block_tables,
-                    seeds, steps, temperature, top_k, attn_impl=None):
+                    seeds, steps, temperature, top_k, attn_impl=None,
+                    ks=None, vs=None):
         """tokens [S] int32 (each slot's last token), positions [S]
         int32 (where that token sits), block_tables [S, MB] int32,
         seeds [S] uint32 + steps [S] int32 (per-request sampling
         identity — see :func:`_sample`) → (kc', vc', next_tokens [S],
-        logits [S, V]).
+        logits [S, V]); with the int8 scale pools ``ks``/``vs``
+        threaded, (kc', vc', ks', vs', next_tokens, logits) and the
+        paged attention dequantizes per-block-per-head in the kernel.
 
         Writes each slot's K/V at (position // bs, position % bs) via
         its block table, then attends over positions 0..position
@@ -333,14 +440,23 @@ class TransformerLM:
         h = p["emb"][tokens] * (cfg.d_model ** 0.5) + self._pos[positions]
         for i in range(cfg.n_layer):
             q, k, v = self._qkv(p, i, h)          # [S, H, Dh]
-            kc = self._scatter_kv(kc, i, blocks, offsets, k)
-            vc = self._scatter_kv(vc, i, blocks, offsets, v)
-            ctx = decode_attention(q, kc[i], vc[i], block_tables, cl,
-                                   impl=attn_impl)
+            if ks is None:
+                kc = self._scatter_kv(kc, i, blocks, offsets, k)
+                vc = self._scatter_kv(vc, i, blocks, offsets, v)
+                ctx = decode_attention(q, kc[i], vc[i], block_tables,
+                                       cl, impl=attn_impl)
+            else:
+                kc, ks = self._append_kv_q(kc, ks, i, blocks, offsets, k)
+                vc, vs = self._append_kv_q(vc, vs, i, blocks, offsets, v)
+                ctx = decode_attention(q, kc[i], vc[i], block_tables,
+                                       cl, impl=attn_impl,
+                                       k_scale=ks[i], v_scale=vs[i])
             h = self._post_attn(p, i, h, ctx.astype(h.dtype))
         logits = h @ p["out_proj"]
         toks = _sample(logits, seeds, steps, temperature, top_k)
-        return kc, vc, toks, logits
+        if ks is None:
+            return kc, vc, toks, logits
+        return kc, vc, ks, vs, toks, logits
 
 
 def _hash_uniform(seeds, steps, kk):

@@ -396,6 +396,134 @@ def fuse_fc_gru(program: Program, scope=None, keep_vars=()) -> int:
     return _fuse_fc_rnn(program, scope, keep_vars, "gru", "fusion_gru", 3)
 
 
+# ---------------------------------------------------------------------------
+# int8 serving calibration (ROADMAP item 3 leg (a)): derive scales from
+# QAT fake-quant stats (or post-training weight abs-max), stamp
+# mul/fused_fc ops for the fused-dequant int8 Pallas matmul peephole
+# (kernels/quant.py Int8Plan, consulted by core/lowering.py).
+# ---------------------------------------------------------------------------
+
+# the epilogue set kernels/quant.py implements; a fused_fc with any
+# other activation (or act attrs) stays f32
+_INT8_ACTS = {"", "relu", "sigmoid", "tanh", "gelu"}
+
+_FAKE_QUANT_OPS = ("fake_quantize_abs_max",
+                   "fake_channel_wise_quantize_abs_max",
+                   "fake_quantize_moving_average_abs_max")
+
+
+def quantize_int8(program: Program, scope, keep_vars=()) -> int:
+    """Calibrate the program for int8 inference (AnalysisConfig
+    ``enable_int8()``; run by create_predictor like every pass).
+
+    Two steps, mirroring the reference's freeze path:
+
+    1. QAT fake-quant ops (``contrib/quantize.py`` inserted them) fold
+       OUT of the graph: consumers rewire to the raw var, and a
+       moving-average quantizer's calibrated running scale
+       (``InScale``, frozen by training) is harvested as the consumer's
+       static activation scale.  abs_max quantizers are dynamic by
+       design (quantize_transpiler.py:96) — their consumers quantize
+       from the batch abs-max at dispatch, same math, no graph op.
+    2. Every mul/fused_fc whose weight is a 2-D persistable scope var
+       gains the int8 stamp: the weight is quantized NOW (per-out-
+       channel abs-max — finer than the QAT per-tensor scale, and free
+       at pass time) into ``<w>@INT8`` / ``<w>@INT8_SCALE`` sidecar
+       scope vars + ``quant_int8``/``in_scale`` attrs.  The original
+       f32 weight stays in scope so the per-op fallback (and a
+       fault-recovery re-lower) keeps the untouched reference path.
+
+    Returns the number of ops calibrated."""
+    from ..kernels import quant as Q
+
+    block = program.global_block
+    # -- 1) fold fake-quant ops, harvesting calibrated scales ----------
+    in_scale_of: Dict[str, float] = {}
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type not in _FAKE_QUANT_OPS:
+            i += 1
+            continue
+        out = op.output("Out")[0]
+        if out in keep_vars:
+            i += 1
+            continue
+        src = op.input("X")[0]
+        scale = 0.0  # dynamic (batch abs-max at dispatch)
+        if op.type == "fake_quantize_moving_average_abs_max" \
+                and scope is not None and op.input("InScale"):
+            sv = scope.find_var(op.input("InScale")[0])
+            if sv is not None:
+                scale = float(np.asarray(sv).reshape(-1)[0])
+        for c in block.ops:
+            if c is op:
+                continue
+            c.inputs = {slot: [src if n == out else n for n in names]
+                        for slot, names in c.inputs.items()}
+        in_scale_of[src] = scale
+        del block.ops[i]
+        program._version += 1
+
+    # -- 2) stamp calibrated FC ops ------------------------------------
+    count = 0
+    for op in block.ops:
+        if op.type not in ("mul", "fused_fc"):
+            continue
+        if op.attrs.get("quant_int8"):
+            continue  # already calibrated (pass re-run)
+        w_slot = "Y" if op.type == "mul" else "W"
+        w_names = op.inputs.get(w_slot) or []
+        if len(w_names) != 1 or scope is None:
+            continue
+        w_name = w_names[0]
+        wv = scope.find_var(w_name)
+        if wv is None:
+            continue
+        w = np.asarray(wv)
+        if w.ndim != 2:
+            continue
+        if int(op.attrs.get("y_num_col_dims", 1)) != 1:
+            continue
+        act = op.attrs.get("act", "") or "" if op.type == "fused_fc" else ""
+        # op_role rides every op's attrs (bookkeeping, not an
+        # activation parameter); any OTHER act attr means the epilogue
+        # can't reproduce the activation exactly
+        if act not in _INT8_ACTS or (
+                op.type == "fused_fc"
+                and any(k != "op_role"
+                        for k in (op.attrs.get("act_attrs") or {}))):
+            continue
+        q, scales = Q.quantize_weight(w)
+        qi_name = f"{w_name}@INT8"
+        qs_name = f"{w_name}@INT8_SCALE"
+        block.create_var(name=qi_name, shape=tuple(w.shape), dtype="int8",
+                         persistable=True)
+        block.create_var(name=qs_name, shape=(int(w.shape[1]),),
+                         dtype="float32", persistable=True)
+        scope.set_var(qi_name, q)
+        scope.set_var(qs_name, scales)
+        x_name = op.input("X")[0]
+        in_scale = float(in_scale_of.get(x_name, 0.0))
+        op.inputs["WInt8"] = [qi_name]
+        op.inputs["WScale"] = [qs_name]
+        op.attrs["quant_int8"] = True
+        op.attrs["in_scale"] = in_scale
+        program._version += 1
+        count += 1
+        Q.note_calibration({
+            "op": op.type,
+            "weight": w_name,
+            "shape": [int(d) for d in w.shape],
+            "act": act,
+            "in_scale": in_scale,  # 0.0 = dynamic per-dispatch
+            "w_scale_min": float(scales.min()),
+            "w_scale_max": float(scales.max()),
+            "clip_fraction": Q.clip_fraction(q),
+        })
+    return count
+
+
 # what the fused_elemwise_activation LOWERING implements (nn_ops.py
 # unary dict) — narrower than _FUSABLE_ACTS, and attr-free
 _ELEWISE_ACTS = {"relu", "sigmoid", "tanh"}

@@ -66,6 +66,20 @@ class AnalysisConfig:
         if name not in self._passes:
             self._passes.append(name)
 
+    def enable_int8(self) -> None:
+        """Calibrate the loaded model for int8 serving (the reference's
+        MkldnnQuantizer/TensorRT-int8 config knob, TPU-shaped): appends
+        the ``quantize_int8`` pass, which folds QAT fake-quant ops into
+        harvested scales (post-training weight abs-max when no QAT
+        stats exist) and stamps mul/fused_fc ops for the fused-dequant
+        int8 Pallas matmul (``kernels/quant.py``).  AFTER the fusion
+        passes — fuse_fc_act must build fused_fc ops first so the int8
+        epilogue absorbs bias+activation too."""
+        self.add_pass("quantize_int8")
+
+    def int8_enabled(self) -> bool:
+        return "quantize_int8" in self._passes
+
 
 NativeConfig = AnalysisConfig
 
@@ -171,6 +185,12 @@ def create_predictor(config: AnalysisConfig) -> Predictor:
     # inference programs run in test mode: stamp is_test on stateful ops
     P.apply_is_test(program)
     fetch_names = [v.name for v in fetch_vars]
+    # FLAGS_int8_inference: fleet-wide default-on switch for the int8
+    # calibration pass, as if every config called enable_int8().  Off
+    # (default): only explicit enable_int8() configs quantize
+    from ..core import flags as _flags
+    if _flags.get_flags("int8_inference") and config.ir_optim:
+        config.enable_int8()
     for name in config.pass_names():
         # fetch targets count as external uses: never fused away/rewritten
         getattr(P, name)(program, scope, keep_vars=fetch_names)

@@ -939,9 +939,15 @@ _ring_flash.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 # cache (the decode plane's hot op — paddle_tpu/decode)
 # ---------------------------------------------------------------------------
 
-def _decode_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
-                        m_scr, l_scr, acc_scr, *,
-                        block_tokens: int, sm_scale: float):
+# int8 KV dequant factor: quantized cache blocks store
+# round(x / s * 127) codes (the kernels/quant.py scale convention),
+# so x ≈ code * s / 127
+_INV_QMAX = 1.0 / 127.0
+
+
+def _decode_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, *rest,
+                        block_tokens: int, sm_scale: float,
+                        quantized: bool = False):
     """Grid (S, max_blocks): slot-major, blocks sequential minor — the
     online-softmax state for one slot lives in VMEM scratch across its
     block iterations (the flash discipline applied to the block TABLE
@@ -951,9 +957,19 @@ def _decode_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
     frontier are skipped (index maps clamp to the frontier block, so
     the pipeline issues no copies for them either).
 
+    ``quantized``: the cache blocks are int8 codes and two extra [1, H]
+    scale refs follow the v ref (per-block-per-head abs-max from the
+    parallel scale pool, same block-table index map) — the block is
+    dequantized IN VMEM right after the copy lands (``code * s/127``),
+    so HBM traffic per block is halved while scores still run in f32.
+
     Scores run in f32 natural units (a decode step is dispatch-bound,
     not VPU-bound — the flash kernel's exp2/ones-lane folds buy nothing
     at one query row per slot and would cost clarity)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     s = pl.program_id(0)
     j = pl.program_id(1)
     cl = cl_ref[s]
@@ -970,6 +986,9 @@ def _decode_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32) * sm_scale        # [H, D]
         k_blk = k_ref[0].astype(jnp.float32)               # [bs, H, D]
         v_blk = v_ref[0].astype(jnp.float32)
+        if quantized:
+            k_blk = k_blk * (ks_ref[0][None, :, None] * _INV_QMAX)
+            v_blk = v_blk * (vs_ref[0][None, :, None] * _INV_QMAX)
         # per-head scores over this block's tokens: [H, bs]
         scores = jax.lax.dot_general(
             q, k_blk, (((1,), (2,)), ((0,), (1,))),
@@ -996,20 +1015,30 @@ def _decode_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention_xla(q, k_cache, v_cache, block_tables, context_lens,
-                        sm_scale=None):
+                        sm_scale=None, k_scale=None, v_scale=None):
     """XLA gather fallback for :func:`decode_attention` (always
     available; also the parity reference the kernel is pinned to).
 
     q: [S, H, D]; k_cache/v_cache: [N_blocks, bs, H, D] (one layer);
     block_tables: [S, MB] int32; context_lens: [S] int32 → [S, H, D].
+    With ``k_scale``/``v_scale`` ([N_blocks, H] f32, the int8 cache's
+    parallel scale pools) the gathered codes are dequantized before the
+    softmax — same math as the kernel's VMEM dequant.
     """
     if sm_scale is None:
         sm_scale = float(1.0 / np.sqrt(q.shape[-1]))
     S, H, D = q.shape
     bs = k_cache.shape[1]
     MB = block_tables.shape[1]
-    k = k_cache[block_tables].reshape(S, MB * bs, H, D)
-    v = v_cache[block_tables].reshape(S, MB * bs, H, D)
+    k = k_cache[block_tables]                    # [S, MB, bs, H, D]
+    v = v_cache[block_tables]
+    if k_scale is not None:
+        k = (k.astype(jnp.float32)
+             * (k_scale[block_tables][:, :, None, :, None] * _INV_QMAX))
+        v = (v.astype(jnp.float32)
+             * (v_scale[block_tables][:, :, None, :, None] * _INV_QMAX))
+    k = k.reshape(S, MB * bs, H, D)
+    v = v.reshape(S, MB * bs, H, D)
     s = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     pos = jnp.arange(MB * bs, dtype=jnp.int32)
@@ -1021,12 +1050,13 @@ def paged_attention_xla(q, k_cache, v_cache, block_tables, context_lens,
 
 
 def _paged_attn_pallas(q, k_cache, v_cache, block_tables, context_lens,
-                       sm_scale, interpret):
+                       sm_scale, interpret, k_scale=None, v_scale=None):
     S, H, D = q.shape
     bs = k_cache.shape[1]
     MB = block_tables.shape[1]
     bt = block_tables.astype(jnp.int32)
     cl = context_lens.astype(jnp.int32)
+    quantized = k_scale is not None
 
     def kv_map(s, j, bt, cl):
         # clamp skipped past-frontier blocks to the frontier block: the
@@ -1034,14 +1064,27 @@ def _paged_attn_pallas(q, k_cache, v_cache, block_tables, context_lens,
         jc = jnp.minimum(j, jnp.maximum((cl[s] - 1) // bs, 0))
         return (bt[s, jc], 0, 0, 0)
 
+    def scale_map(s, j, bt, cl):
+        jc = jnp.minimum(j, jnp.maximum((cl[s] - 1) // bs, 0))
+        return (bt[s, jc], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda s, j, bt, cl: (s, 0, 0)),
+        pl.BlockSpec((1, bs, H, D), kv_map),
+        pl.BlockSpec((1, bs, H, D), kv_map),
+    ]
+    operands = [bt, cl, q, k_cache, v_cache]
+    if quantized:
+        # per-block-per-head scale rows ride the same prefetched block
+        # table as the code blocks they dequantize
+        in_specs += [pl.BlockSpec((1, H), scale_map),
+                     pl.BlockSpec((1, H), scale_map)]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, MB),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda s, j, bt, cl: (s, 0, 0)),
-            pl.BlockSpec((1, bs, H, D), kv_map),
-            pl.BlockSpec((1, bs, H, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, D), lambda s, j, bt, cl: (s, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, 1), jnp.float32),
@@ -1050,13 +1093,13 @@ def _paged_attn_pallas(q, k_cache, v_cache, block_tables, context_lens,
         ],
     )
     kernel = functools.partial(_decode_attn_kernel, block_tokens=bs,
-                               sm_scale=sm_scale)
+                               sm_scale=sm_scale, quantized=quantized)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
         interpret=interpret,
-    )(bt, cl, q, k_cache, v_cache)
+    )(*operands)
 
 
 def _count_decode(name: str, n: int = 1) -> None:
@@ -1071,7 +1114,8 @@ _decode_attn_broken = False
 
 
 def decode_attention(q, k_cache, v_cache, block_tables, context_lens,
-                     sm_scale=None, interpret=None, impl=None):
+                     sm_scale=None, interpret=None, impl=None,
+                     k_scale=None, v_scale=None):
     """Paged decode attention: one query token per request against its
     gathered block list (scalar-prefetch block tables — module doc,
     ``_decode_attn_kernel``).
@@ -1080,6 +1124,12 @@ def decode_attention(q, k_cache, v_cache, block_tables, context_lens,
     block_tokens, H, D] for ONE layer; block_tables: [S, MB] int32
     cache-block ids per slot; context_lens: [S] int32 valid tokens per
     slot (positions ≥ context_len masked).  Returns [S, H, D].
+
+    ``k_scale``/``v_scale``: [N_blocks, H] f32 per-block-per-head
+    abs-max pools when the cache stores int8 codes
+    (``FLAGS_decode_kv_dtype=int8``); both paths dequantize with
+    ``code * s/127`` — the kernel in VMEM after the block copy lands,
+    the XLA fallback after the gather.
 
     ``impl``: None (pallas with counted XLA fallback — the
     kernels/sparse.py contract), "xla" (force the gather path),
@@ -1097,19 +1147,22 @@ def decode_attention(q, k_cache, v_cache, block_tables, context_lens,
     if impl == "xla" or not _HAVE_PALLAS or \
             (impl is None and _decode_attn_broken):
         return paged_attention_xla(q, k_cache, v_cache, block_tables,
-                                   context_lens, sm_scale)
+                                   context_lens, sm_scale,
+                                   k_scale=k_scale, v_scale=v_scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     try:
         return _paged_attn_pallas(q, k_cache, v_cache, block_tables,
-                                  context_lens, sm_scale, interpret)
+                                  context_lens, sm_scale, interpret,
+                                  k_scale=k_scale, v_scale=v_scale)
     except Exception:
         if impl == "pallas":
             raise
         _decode_attn_broken = True
         _count_decode("attn_fallbacks")
         return paged_attention_xla(q, k_cache, v_cache, block_tables,
-                                   context_lens, sm_scale)
+                                   context_lens, sm_scale,
+                                   k_scale=k_scale, v_scale=v_scale)
 
 
 def _ring_xla(q, k, v, kv_mask, axis_name, causal=False, sm_scale=None,

@@ -449,6 +449,22 @@ class _Handler(BaseHTTPRequestHandler):
                                         default=repr))
                 self._reply(200, body,
                             "text/plain" if text else "application/json")
+            elif path == "/quantz":
+                # the low-precision-serving plane (kernels/quant.py):
+                # per-layer calibration records (scales, clip
+                # fractions), quantized-matmul launch/fallback
+                # counters, quantized KV cache pools.  JSON by
+                # default, ?text=1 for the human rendering
+                # (tools/dump_metrics.py --quantz is the operator CLI)
+                from urllib.parse import parse_qs
+                from ..kernels import quant as _quant
+                q = parse_qs(query)
+                text = q.get("text", ["0"])[0] not in ("0", "", "false")
+                body = (_quant.quantz_text() if text
+                        else json.dumps(_quant.quantz(), indent=2,
+                                        default=repr))
+                self._reply(200, body,
+                            "text/plain" if text else "application/json")
             elif path == "/canaryz":
                 # the correctness-anatomy plane (observability/
                 # canary.py + audit.py): golden-probe streak table plus
@@ -512,6 +528,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "/tenantz  (per-tenant usage metering; ?text=1)",
                      "/allocz  (memory-attribution ledger + event ring; "
                      "?text=1)",
+                     "/quantz  (int8 calibration, quantized matmul "
+                     "fallbacks, KV dtype; ?text=1)",
                      "/canaryz  (golden canary streaks + divergence "
                      "audit; ?text=1)",
                      "/chaosz  (?inject=<spec> arm faults, ?clear=1)", ""]),

@@ -36,13 +36,18 @@ from ..distributed import serde, transport
 class ServingClient:
     def __init__(self, endpoints: Optional[Sequence[str]] = None,
                  registry_ep: Optional[str] = None, trainer_id: int = 0,
-                 refresh_s: float = 2.0, cooldown_s: float = 2.0):
+                 refresh_s: float = 2.0, cooldown_s: float = 2.0,
+                 connect_timeout_s: float = 5.0):
         if not endpoints and not registry_ep:
             raise ValueError("ServingClient needs endpoints or registry_ep")
         self._static = list(endpoints or [])
         self.registry_ep = registry_ep
         self.refresh_s = refresh_s
         self.cooldown_s = cooldown_s
+        # interactive inference must not ride out the transport's
+        # trainer-bring-up connect grace on a dead replica: bound each
+        # connect attempt and let failover rotate instead
+        self.connect_timeout_s = connect_timeout_s
         self._client = transport.RPCClient(trainer_id)
         self._lock = threading.Lock()
         self._rr: Dict[str, int] = {}            # model -> round-robin idx
@@ -130,8 +135,9 @@ class ServingClient:
         for i in range(len(eps)):
             ep = eps[(start + i) % len(eps)]
             try:
-                body = self._client._raw_request(ep, _server.INFER, model,
-                                                 payload)
+                body = self._client._raw_request(
+                    ep, _server.INFER, model, payload,
+                    connect_timeout=self.connect_timeout_s)
             except ConnectionError as e:
                 self._bench(ep)
                 last_exc = e

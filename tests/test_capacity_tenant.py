@@ -297,7 +297,7 @@ def test_infer_wire_tenant_optional_byte_identity():
         [("y", np.zeros((1, 3), "float32"))])
 
     class _CaptureRPC:
-        def _raw_request(self, ep, tag, model, payload):
+        def _raw_request(self, ep, tag, model, payload, **kw):
             if isinstance(payload, (list, tuple)):
                 payload = b"".join(bytes(b) for b in payload)
             captured.append(bytes(payload))

@@ -65,7 +65,8 @@ METRIC_KEYS = (
 # classification, the reported delta stays raw
 LOWER_BETTER_KEYS = frozenset({"ckpt_overhead_frac", "recovery_mttr_s",
                                "decode_ttft_ms_p99", "canary_failures",
-                               "kv_bytes_per_token"})
+                               "kv_bytes_per_token",
+                               "quant_accuracy_delta"})
 
 # lower-better keys in ABSOLUTE units (seconds, not a fraction): their
 # delta is relative when the baseline is positive — a 3 s -> 3.5 s MTTR
@@ -84,7 +85,7 @@ LOWER_BETTER_RELATIVE_KEYS = frozenset({"recovery_mttr_s",
 # config) gates the same way: a dedup hit-rate collapse is a capacity
 # regression even when the round's throughput happened to hold
 SECONDARY_GATE_KEYS = ("decode_ttft_ms_p99", "canary_failures",
-                       "prefix_hit_rate")
+                       "prefix_hit_rate", "quant_accuracy_delta")
 
 # informational keys carried through the comparison WITHOUT gating:
 # recorded per config when present in either round (the evidence

@@ -23,6 +23,7 @@ Operator companion to ``paddle_tpu/observability/debug_server.py``
     python tools/dump_metrics.py 8085 --canaryz --text  # streak table
     python tools/dump_metrics.py 8085 --allocz        # memory ledger
     python tools/dump_metrics.py 8085 --allocz --text   # pool table
+    python tools/dump_metrics.py 8085 --quantz        # int8 calibration
 
 JSON pages (healthz/statusz/stepz) are re-indented; /metrics is passed
 through (optionally filtered with ``--grep``) so the output pastes
@@ -120,10 +121,16 @@ def main(argv=None) -> int:
                          "per-pool reserved/used/parked ledger, per-"
                          "device PJRT reconciliation with the "
                          "unattributed residual, allocation event ring)")
+    ap.add_argument("--quantz", action="store_true",
+                    help="fetch the low-precision-serving page (/quantz: "
+                         "per-layer int8 calibration scales + clip "
+                         "fractions, quantized-matmul launch/fallback "
+                         "counters, quantized KV cache dtype + "
+                         "bytes/block)")
     ap.add_argument("--text", action="store_true",
                     help="with --memz/--profilez/--capacityz/--tenantz/"
-                         "--canaryz/--allocz: the human text rendering "
-                         "(?text=1) instead of JSON")
+                         "--canaryz/--allocz/--quantz: the human text "
+                         "rendering (?text=1) instead of JSON")
     ap.add_argument("port", type=int,
                     help="the worker's FLAGS_debug_server_port")
     ap.add_argument("pages", nargs="*", default=list(DEFAULT_PAGES),
@@ -135,7 +142,7 @@ def main(argv=None) -> int:
     if args.tracez or args.flight or args.memz or args.profilez or \
             args.decodez or args.sloz or args.varz or \
             args.capacityz or args.tenantz or args.canaryz or \
-            args.allocz:
+            args.allocz or args.quantz:
         pages = []
         if args.tracez:
             pages.append("tracez?raw=1" if args.raw else "tracez")
@@ -161,6 +168,8 @@ def main(argv=None) -> int:
             pages.append("canaryz" + suffix)
         if args.allocz:
             pages.append("allocz" + suffix)
+        if args.quantz:
+            pages.append("quantz" + suffix)
         for page in pages:
             try:
                 body = fetch(args.host, args.port, page,

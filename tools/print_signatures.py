@@ -140,6 +140,10 @@ MODULES = [
     # gather/update entry points + the lowering peephole planner): frozen
     # so the optimizer-wiring contract drifts loudly
     "paddle_tpu.kernels.sparse",
+    # the low-precision serving surface (fused-dequant int8 matmul,
+    # calibration plan, KV qdq helpers, /quantz payload): frozen so the
+    # scale semantics and fallback contract drift loudly
+    "paddle_tpu.kernels.quant",
     # the sharded-checkpoint plane (manifest/store/reshard/snapshot/
     # elastic) + its operator CLI: frozen so the on-disk format and the
     # restore-planner contract drift loudly
